@@ -1,0 +1,92 @@
+"""DeepSpeed-TPU: a TPU-native large-model training & inference framework.
+
+Capability parity with DeepSpeed (reference snapshot v0.12.4), redesigned
+for TPU: JAX/XLA/pjit for the compute path, one named device mesh
+(data/seq/pipe/expert/model) for every parallelism flavor, Pallas kernels
+for the hot ops, GSPMD placement instead of hook machinery for ZeRO.
+
+Public API parity with ``deepspeed/__init__.py``: :func:`initialize`
+(:64 in the reference) returning ``(engine, optimizer, dataloader,
+lr_scheduler)``, :func:`init_inference` (:269), and
+:func:`add_config_arguments` (:246).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+from .version import __version__  # noqa: F401
+from .config import Config, ConfigError, add_config_arguments  # noqa: F401
+from .parallel.mesh import Topology, get_topology, initialize_topology, set_topology  # noqa: F401
+from .runtime.engine import TrainEngine
+from .runtime.dataloader import DataLoader, RepeatingLoader  # noqa: F401
+from . import comm  # noqa: F401
+from .comm.comm import init_distributed  # noqa: F401
+from .utils.logging import logger, log_dist  # noqa: F401
+
+
+def initialize(*,
+               loss_fn: Optional[Callable] = None,
+               params: Any = None,
+               model: Any = None,
+               config: Any = None,
+               config_params: Any = None,
+               optimizer: Any = None,
+               lr_scheduler: Any = None,
+               training_data: Any = None,
+               topology: Optional[Topology] = None,
+               tp_specs: Any = None,
+               collate_fn: Optional[Callable] = None,
+               rng: Any = None,
+               model_args: Tuple = (),
+               ) -> Tuple[TrainEngine, Any, Any, Any]:
+    """Bring up a training engine. Parity with ``deepspeed.initialize``
+    (reference deepspeed/__init__.py:64) — returns
+    ``(engine, optimizer, dataloader, lr_scheduler)``.
+
+    TPU-native model protocol: pass either
+      * ``loss_fn(params, batch, rng) -> loss | (loss, aux)`` plus ``params``
+        (any pytree), or
+      * ``model`` — an object with ``.init(rng, *model_args)`` and a
+        ``.loss(params, batch, rng)`` method (every model in
+        ``deepspeed_tpu.models`` implements this; flax modules wrap in one
+        line via :func:`deepspeed_tpu.models.api.from_flax`).
+
+    ``config`` is a dict or a path to a DeepSpeed-style JSON file.
+    """
+    cfg = Config.from_any(config if config is not None else config_params)
+    if topology is None:
+        topology = Topology.build(cfg.mesh)
+    set_topology(topology)
+    init_distributed()
+
+    if loss_fn is None:
+        if model is None or not hasattr(model, "loss"):
+            raise ValueError("initialize() needs loss_fn+params, or a model exposing .loss()")
+        loss_fn = model.loss
+    if params is None:
+        if model is None or not hasattr(model, "init"):
+            raise ValueError("initialize() needs params, or a model exposing .init()")
+        import jax
+
+        params = model.init(rng if rng is not None else jax.random.PRNGKey(cfg.train_seed), *model_args)
+    if tp_specs is None and model is not None and hasattr(model, "partition_specs"):
+        tp_specs = model.partition_specs(params, topology)
+
+    engine = TrainEngine(
+        loss_fn=loss_fn, params=params, config=cfg, topology=topology,
+        optimizer=optimizer, lr_scheduler=lr_scheduler, tp_specs=tp_specs, model=model)
+
+    dataloader = None
+    if training_data is not None:
+        dataloader = DataLoader(training_data, cfg.train_batch_size, topology,
+                                seed=cfg.train_seed, collate_fn=collate_fn)
+    return engine, engine.optimizer, dataloader, engine.lr_schedule
+
+
+def init_inference(model: Any = None, config: Any = None, **kwargs):
+    """Parity with ``deepspeed.init_inference`` (reference __init__.py:269)."""
+    from .inference.engine import InferenceEngine, InferenceConfig
+
+    icfg = InferenceConfig.from_any(config, **kwargs)
+    return InferenceEngine(model=model, config=icfg)

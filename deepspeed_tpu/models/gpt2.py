@@ -1,0 +1,53 @@
+"""GPT-2 / OPT family configs.
+
+Parity target: reference containers for gpt2/gptj/gptneo/opt
+(module_inject/containers/) and the OPT FastGen implementation
+(inference/v2/model_implementations/opt). LayerNorm + learned positions +
+GELU MLP + biases + tied embeddings on the shared Transformer core.
+"""
+
+from __future__ import annotations
+
+from .transformer import Transformer, TransformerConfig
+
+
+def gpt2_config(size: str = "small", **overrides) -> TransformerConfig:
+    presets = {
+        "tiny": dict(vocab_size=50257, d_model=256, n_layers=4, n_heads=8, max_seq_len=512),
+        "small": dict(vocab_size=50257, d_model=768, n_layers=12, n_heads=12, max_seq_len=1024),
+        "medium": dict(vocab_size=50257, d_model=1024, n_layers=24, n_heads=16, max_seq_len=1024),
+        "large": dict(vocab_size=50257, d_model=1280, n_layers=36, n_heads=20, max_seq_len=1024),
+        "xl": dict(vocab_size=50257, d_model=1600, n_layers=48, n_heads=25, max_seq_len=1024),
+    }
+    if size not in presets:
+        raise ValueError(f"unknown gpt2 size '{size}'; have {sorted(presets)}")
+    kw = dict(presets[size])
+    kw.update(norm="layer", activation="gelu", position="learned",
+              tie_embeddings=True, use_bias=True, norm_eps=1e-5)
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def opt_config(size: str = "1.3b", **overrides) -> TransformerConfig:
+    presets = {
+        "125m": dict(vocab_size=50272, d_model=768, n_layers=12, n_heads=12),
+        "1.3b": dict(vocab_size=50272, d_model=2048, n_layers=24, n_heads=32),
+        "6.7b": dict(vocab_size=50272, d_model=4096, n_layers=32, n_heads=32),
+        "13b": dict(vocab_size=50272, d_model=5120, n_layers=40, n_heads=40),
+        "30b": dict(vocab_size=50272, d_model=7168, n_layers=48, n_heads=56),
+    }
+    if size not in presets:
+        raise ValueError(f"unknown opt size '{size}'; have {sorted(presets)}")
+    kw = dict(presets[size])
+    kw.update(max_seq_len=2048, norm="layer", activation="gelu", position="learned",
+              tie_embeddings=True, use_bias=True, norm_eps=1e-5)
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def GPT2(size: str = "small", **overrides) -> Transformer:
+    return Transformer(gpt2_config(size, **overrides))
+
+
+def OPT(size: str = "1.3b", **overrides) -> Transformer:
+    return Transformer(opt_config(size, **overrides))

@@ -1,0 +1,40 @@
+"""Llama-2 family configs (flagship model).
+
+Parity target: the reference serves Llama via HF + kernel injection
+(module_inject/containers/llama.py, inference/v2/model_implementations/
+llama_v2) — here Llama is a first-class native model on the shared
+:class:`~deepspeed_tpu.models.transformer.Transformer` core (RMSNorm + RoPE
++ gated-SiLU + GQA, pre-norm, tied-or-untied head).
+"""
+
+from __future__ import annotations
+
+from .transformer import Transformer, TransformerConfig
+
+
+def llama_config(size: str = "7b", **overrides) -> TransformerConfig:
+    presets = {
+        "tiny": dict(vocab_size=32000, d_model=256, n_layers=4, n_heads=8, n_kv_heads=8,
+                     max_seq_len=512),
+        "160m": dict(vocab_size=32000, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12,
+                     max_seq_len=2048),
+        "1b": dict(vocab_size=32000, d_model=2048, n_layers=22, n_heads=32, n_kv_heads=4,
+                   d_ff=5632, max_seq_len=2048),
+        "7b": dict(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=32,
+                   d_ff=11008, max_seq_len=4096),
+        "13b": dict(vocab_size=32000, d_model=5120, n_layers=40, n_heads=40, n_kv_heads=40,
+                    d_ff=13824, max_seq_len=4096),
+        "70b": dict(vocab_size=32000, d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                    d_ff=28672, max_seq_len=4096),
+    }
+    if size not in presets:
+        raise ValueError(f"unknown llama size '{size}'; have {sorted(presets)}")
+    kw = dict(presets[size])
+    kw.update(norm="rms", activation="silu_glu", position="rope",
+              tie_embeddings=False, use_bias=False)
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def Llama(size: str = "7b", **overrides) -> Transformer:
+    return Transformer(llama_config(size, **overrides))

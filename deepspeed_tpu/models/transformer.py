@@ -1,0 +1,317 @@
+"""Decoder-only transformer core.
+
+This is the model substrate the reference gets from HuggingFace + kernel
+injection (``deepspeed/module_inject/containers/{llama,gptneo,opt,...}`` and
+FastGen's ``inference/v2/model_implementations/``). Built TPU-first:
+
+* **Stacked layer parameters + ``lax.scan`` over depth** — one compiled
+  block regardless of layer count (compile time O(1) in depth, XLA pipelines
+  the scan); the reference's per-layer Python modules have no TPU analog.
+* **Tensor parallelism as PartitionSpecs** — Megatron-style column/row
+  sharding over the ``model`` mesh axis is *data placement* here, not code:
+  :meth:`Transformer.partition_specs` returns the spec tree and GSPMD
+  inserts the one all-reduce per block the reference's AutoTP patches into
+  forward (module_inject/auto_tp.py).
+* **Sequence parallelism (Ulysses)** via ``parallel/ulysses.py`` — enabled
+  when the mesh's ``seq`` axis > 1.
+* fp32 accumulation in norms/softmax/logits; bf16 everywhere else.
+
+Families supported via :class:`TransformerConfig`: Llama/Mistral-style
+(RMSNorm + RoPE + gated-SiLU MLP + GQA), GPT-2/OPT-style (LayerNorm +
+learned positions + GELU MLP, optional biases), with tied or untied
+embeddings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention, flash_attention
+from ..ops.norms import layer_norm, rms_norm
+from ..ops.rotary import apply_rotary, rope_frequencies
+
+
+@dataclass
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # GQA; None => MHA
+    d_ff: Optional[int] = None        # default 4*d (gelu) or 8/3*d rounded (glu)
+    max_seq_len: int = 2048
+    norm: str = "rms"                 # rms | layer
+    activation: str = "silu_glu"      # silu_glu | gelu
+    position: str = "rope"            # rope | learned
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+    use_bias: bool = False
+    norm_eps: float = 1e-6
+    remat: bool = True                # activation checkpointing per block
+    use_flash: bool = True
+    logits_softcap: float = 0.0
+    z_loss: float = 0.0
+
+    def __post_init__(self):
+        if self.n_kv_heads is None:
+            self.n_kv_heads = self.n_heads
+        if self.d_ff is None:
+            if self.activation == "silu_glu":
+                self.d_ff = int(8 * self.d_model / 3 / 128 + 1) * 128
+            else:
+                self.d_ff = 4 * self.d_model
+        assert self.d_model % self.n_heads == 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def param_count(self) -> int:
+        d, f, v, n = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+        hd = self.head_dim
+        attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd + self.n_heads * hd * d
+        mlp = (3 if self.activation == "silu_glu" else 2) * d * f
+        norms = (2 * d) * n + d
+        emb = v * d * (1 if self.tie_embeddings else 2)
+        return n * (attn + mlp) + norms + emb
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Forward+backward FLOPs/token (standard 6N + attention term)."""
+        return 6.0 * self.param_count() + 12.0 * self.n_layers * self.d_model * seq_len
+
+
+class Transformer:
+    """Functional model: ``init`` -> params pytree; ``apply`` -> logits;
+    ``loss`` -> scalar; ``partition_specs`` -> TP placement."""
+
+    def __init__(self, config: TransformerConfig):
+        self.config = config
+
+    # ------------------------------------------------------------------
+    def init(self, rng, dtype=jnp.float32) -> Dict[str, Any]:
+        c = self.config
+        hd = c.head_dim
+        k = iter(jax.random.split(rng, 16))
+
+        def dense(key, shape, scale=None):
+            scale = scale if scale is not None else 1.0 / np.sqrt(shape[-2] if len(shape) > 1 else shape[-1])
+            return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+        n = c.n_layers
+        layers: Dict[str, Any] = {
+            "attn_norm_w": jnp.ones((n, c.d_model), dtype),
+            "wq": dense(next(k), (n, c.d_model, c.n_heads * hd)),
+            "wk": dense(next(k), (n, c.d_model, c.n_kv_heads * hd)),
+            "wv": dense(next(k), (n, c.d_model, c.n_kv_heads * hd)),
+            "wo": dense(next(k), (n, c.n_heads * hd, c.d_model), scale=1.0 / np.sqrt(c.d_model * 2 * n)),
+            "mlp_norm_w": jnp.ones((n, c.d_model), dtype),
+            "w_up": dense(next(k), (n, c.d_model, c.d_ff)),
+            "w_down": dense(next(k), (n, c.d_ff, c.d_model), scale=1.0 / np.sqrt(c.d_ff * 2 * n)),
+        }
+        if c.activation == "silu_glu":
+            layers["w_gate"] = dense(next(k), (n, c.d_model, c.d_ff))
+        if c.norm == "layer":
+            layers["attn_norm_b"] = jnp.zeros((n, c.d_model), dtype)
+            layers["mlp_norm_b"] = jnp.zeros((n, c.d_model), dtype)
+        if c.use_bias:
+            layers["bq"] = jnp.zeros((n, c.n_heads * hd), dtype)
+            layers["bk"] = jnp.zeros((n, c.n_kv_heads * hd), dtype)
+            layers["bv"] = jnp.zeros((n, c.n_kv_heads * hd), dtype)
+            layers["bo"] = jnp.zeros((n, c.d_model), dtype)
+            layers["b_up"] = jnp.zeros((n, c.d_ff), dtype)
+            layers["b_down"] = jnp.zeros((n, c.d_model), dtype)
+
+        params: Dict[str, Any] = {
+            "tok_embed": dense(next(k), (c.vocab_size, c.d_model), scale=1.0),
+            "layers": layers,
+            "final_norm_w": jnp.ones((c.d_model,), dtype),
+        }
+        if c.norm == "layer":
+            params["final_norm_b"] = jnp.zeros((c.d_model,), dtype)
+        if c.position == "learned":
+            params["pos_embed"] = dense(next(k), (c.max_seq_len, c.d_model), scale=0.02)
+        if not c.tie_embeddings:
+            params["lm_head"] = dense(next(k), (c.d_model, c.vocab_size))
+        return params
+
+    # ------------------------------------------------------------------
+    def _norm(self, x, w, b=None):
+        if self.config.norm == "rms":
+            return rms_norm(x, w, self.config.norm_eps)
+        return layer_norm(x, w, b, self.config.norm_eps)
+
+    def _block(self, x, lp, angles, positions, kv_cache=None):
+        """One transformer block. x: [b, s, d]. Returns (x, new_kv)."""
+        c = self.config
+        hd = c.head_dim
+        b, s, _ = x.shape
+
+        h = self._norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"))
+        q = h @ lp["wq"]
+        kk = h @ lp["wk"]
+        vv = h @ lp["wv"]
+        if c.use_bias:
+            q, kk, vv = q + lp["bq"], kk + lp["bk"], vv + lp["bv"]
+        q = q.reshape(b, s, c.n_heads, hd)
+        kk = kk.reshape(b, s, c.n_kv_heads, hd)
+        vv = vv.reshape(b, s, c.n_kv_heads, hd)
+        if c.position == "rope":
+            q = apply_rotary(q, angles, positions)
+            kk = apply_rotary(kk, angles, positions)
+
+        new_kv = None
+        if kv_cache is not None:
+            ck, cv, cache_pos = kv_cache
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, kk, cache_pos, axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, vv, cache_pos, axis=1)
+            new_kv = (ck, cv)
+            valid = jnp.arange(ck.shape[1])[None, :] < (cache_pos + s)
+            mask = valid[None, None, :, :] if False else valid[None, None, None, :]
+            attn = dot_product_attention(q, ck, cv, causal=(s > 1), mask=mask)
+        elif c.use_flash:
+            attn = flash_attention(q, kk, vv, causal=True)
+        else:
+            attn = dot_product_attention(q, kk, vv, causal=True)
+
+        attn = attn.reshape(b, s, c.n_heads * hd) @ lp["wo"]
+        if c.use_bias:
+            attn = attn + lp["bo"]
+        x = x + attn
+
+        h = self._norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
+        if c.activation == "silu_glu":
+            up = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+        else:
+            up = h @ lp["w_up"]
+            if c.use_bias:
+                up = up + lp["b_up"]
+            up = jax.nn.gelu(up)
+        down = up @ lp["w_down"]
+        if c.use_bias:
+            down = down + lp["b_down"]
+        return x + down, new_kv
+
+    def apply(self, params, tokens, positions=None, kv_caches=None, cache_pos=None):
+        """Forward. tokens: [b, s] int32 -> logits [b, s, vocab] (fp32).
+
+        ``kv_caches``: optional stacked (k,v) cache [n_layers, b, max_s, hkv, hd]
+        pair for decode; returns (logits, new_caches) then.
+        """
+        c = self.config
+        x = params["tok_embed"][tokens]  # [b, s, d]
+        compute_dtype = params["layers"]["wq"].dtype
+        x = x.astype(compute_dtype)
+        if c.position == "learned":
+            s = tokens.shape[1]
+            if positions is None:
+                pos_emb = params["pos_embed"][:s]
+            else:
+                pos_emb = params["pos_embed"][positions]
+            x = x + pos_emb.astype(compute_dtype)
+        angles = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta) \
+            if c.position == "rope" else None
+
+        block = self._block
+        if c.remat and kv_caches is None:
+            block = jax.checkpoint(block, static_argnums=())
+
+        if kv_caches is None:
+            def scan_fn(carry, lp):
+                y, _ = block(carry, lp, angles, positions, None)
+                return y, None
+
+            x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+            new_caches = None
+        else:
+            ks, vs = kv_caches
+
+            def scan_fn(carry, layer_in):
+                lp, ck, cv = layer_in
+                y, (nk, nv) = self._block(carry, lp, angles, positions, (ck, cv, cache_pos))
+                return y, (nk, nv)
+
+            x, (nks, nvs) = jax.lax.scan(scan_fn, x, (params["layers"], ks, vs))
+            new_caches = (nks, nvs)
+
+        x = self._norm(x, params["final_norm_w"], params.get("final_norm_b"))
+        w_out = params["tok_embed"].T if c.tie_embeddings else params["lm_head"]
+        logits = (x @ w_out.astype(x.dtype)).astype(jnp.float32)
+        if c.logits_softcap > 0:
+            logits = jnp.tanh(logits / c.logits_softcap) * c.logits_softcap
+        if new_caches is not None:
+            return logits, new_caches
+        return logits
+
+    # ------------------------------------------------------------------
+    def loss(self, params, batch, rng=None):
+        """Next-token cross entropy. batch: {"input_ids": [b, s]} with
+        optional "labels" (shifted internally when absent) and "loss_mask"."""
+        tokens = batch["input_ids"]
+        if "labels" in batch:
+            inputs, targets = tokens, batch["labels"]
+        else:
+            inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = self.apply(params, inputs)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        mask = batch.get("loss_mask")
+        if mask is not None:
+            mask = mask[:, : nll.shape[1]].astype(jnp.float32)
+            loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            loss = jnp.mean(nll)
+        if self.config.z_loss > 0:
+            z = jax.scipy.special.logsumexp(logits, axis=-1)
+            loss = loss + self.config.z_loss * jnp.mean(jnp.square(z))
+        return loss
+
+    # ------------------------------------------------------------------
+    def partition_specs(self, params, topo=None) -> Dict[str, Any]:
+        """Tensor-parallel PartitionSpecs over the 'model' axis.
+
+        Megatron-style: column-parallel QKV/up/gate (shard output features),
+        row-parallel O/down (shard input features), vocab-sharded embedding.
+        This is the training-TP capability the reference delegates to an
+        external mpu (SURVEY.md §2.2 "TP (training)") and implements for
+        inference as AutoTP (module_inject/auto_tp.py) — here it is native.
+        """
+        c = self.config
+        layer_specs = {
+            "attn_norm_w": P(None, None),
+            "wq": P(None, None, "model"),
+            "wk": P(None, None, "model"),
+            "wv": P(None, None, "model"),
+            "wo": P(None, "model", None),
+            "mlp_norm_w": P(None, None),
+            "w_up": P(None, None, "model"),
+            "w_down": P(None, "model", None),
+        }
+        if c.activation == "silu_glu":
+            layer_specs["w_gate"] = P(None, None, "model")
+        if c.norm == "layer":
+            layer_specs["attn_norm_b"] = P(None, None)
+            layer_specs["mlp_norm_b"] = P(None, None)
+        if c.use_bias:
+            layer_specs.update({
+                "bq": P(None, "model"), "bk": P(None, "model"), "bv": P(None, "model"),
+                "bo": P(None, None), "b_up": P(None, "model"), "b_down": P(None, None),
+            })
+        specs: Dict[str, Any] = {
+            "tok_embed": P("model", None),
+            "layers": layer_specs,
+            "final_norm_w": P(None),
+        }
+        if c.norm == "layer":
+            specs["final_norm_b"] = P(None)
+        if c.position == "learned":
+            specs["pos_embed"] = P(None, None)
+        if not c.tie_embeddings:
+            specs["lm_head"] = P(None, "model")
+        return specs

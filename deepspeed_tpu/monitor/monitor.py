@@ -1,0 +1,88 @@
+"""Experiment monitoring.
+
+Capability parity with the reference's ``deepspeed/monitor/`` —
+``MonitorMaster`` (monitor.py:29) fanning out to TensorBoard
+(tensorboard.py:13), CSV (csv_monitor.py:12) and W&B (wandb.py:12) writers;
+the engine posts loss/lr/grad-norm events at step boundaries
+(engine.py:2146-:2167 ``_write_monitor``).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, List, Optional, Tuple
+
+from ..config import MonitorConfig
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]  # (name, value, step)
+
+
+class CsvMonitor:
+    def __init__(self, output_path: str, job_name: str):
+        self.dir = os.path.join(output_path or "csv_monitor", job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._files = {}
+
+    def write_events(self, events: List[Event]) -> None:
+        for name, value, step in events:
+            fname = os.path.join(self.dir, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class TensorBoardMonitor:
+    def __init__(self, output_path: str, job_name: str):
+        self.writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # cpu torch is available
+
+            self.writer = SummaryWriter(log_dir=os.path.join(output_path or "tensorboard", job_name))
+        except Exception as e:
+            logger.warning(f"tensorboard writer unavailable ({e}); events dropped")
+
+    def write_events(self, events: List[Event]) -> None:
+        if self.writer is None:
+            return
+        for name, value, step in events:
+            self.writer.add_scalar(name, value, step)
+        self.writer.flush()
+
+
+class WandbMonitor:
+    def __init__(self, project: Optional[str], team: Optional[str], group: Optional[str]):
+        self.run = None
+        try:
+            import wandb  # not in the image; gated
+
+            self.run = wandb.init(project=project, entity=team, group=group)
+        except Exception as e:
+            logger.warning(f"wandb unavailable ({e}); events dropped")
+
+    def write_events(self, events: List[Event]) -> None:
+        if self.run is None:
+            return
+        for name, value, step in events:
+            self.run.log({name: value}, step=step)
+
+
+class MonitorMaster:
+    """Fan-out monitor (reference monitor/monitor.py:29)."""
+
+    def __init__(self, config: MonitorConfig):
+        self.writers: List[Any] = []
+        if config.csv_enabled:
+            self.writers.append(CsvMonitor(config.csv_output_path, config.csv_job_name))
+        if config.tensorboard_enabled:
+            self.writers.append(TensorBoardMonitor(config.tensorboard_output_path, config.tensorboard_job_name))
+        if config.wandb_enabled:
+            self.writers.append(WandbMonitor(config.wandb_project, config.wandb_team, config.wandb_group))
+
+    def write_events(self, events: List[Event]) -> None:
+        for w in self.writers:
+            w.write_events(events)

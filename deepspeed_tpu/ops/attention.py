@@ -1,0 +1,82 @@
+"""Attention ops.
+
+Replaces the reference's attention kernel zoo — fused softmax/attention CUDA
+kernels (``csrc/transformer/*.cu``), inference ``softmax_context``
+(``ops/transformer/inference/op_binding/softmax_context.py``), the Evoformer
+CUTLASS fMHA (``csrc/deepspeed4science/evoformer_attn/``) — with one
+TPU-first surface:
+
+* :func:`dot_product_attention` — jnp reference path; XLA already produces a
+  flash-style fused softmax on TPU for moderate sequence lengths.
+* :func:`flash_attention` — Pallas blocked/online-softmax kernel
+  (``ops/pallas/flash_attention.py``) for long sequences; falls back to the
+  jnp path off-TPU or for tiny shapes.
+* GQA/MQA handled by K/V head broadcasting (n_kv_heads <= n_heads).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _repeat_kv(k, n_rep: int):
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.repeat(k, n_rep, axis=2)
+
+
+def dot_product_attention(q, k, v, *, causal: bool = True,
+                          mask: Optional[jnp.ndarray] = None,
+                          scale: Optional[float] = None,
+                          logits_dtype=jnp.float32):
+    """Reference attention. q: [b, sq, hq, d]; k/v: [b, skv, hkv, d].
+
+    Softmax in fp32 (the reference kernels do the same via float accumulators
+    in attn_softmax_v2). Causal masking uses absolute positions aligned to
+    the *end* of the KV sequence so decode (sq=1, skv=cache_len) works.
+    """
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    assert hq % hkv == 0, f"query heads {hq} not a multiple of kv heads {hkv}"
+    k = _repeat_kv(k, hq // hkv)
+    v = _repeat_kv(v, hq // hkv)
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(logits_dtype) * scale
+    if causal:
+        q_pos = jnp.arange(sq)[:, None] + (skv - sq)
+        k_pos = jnp.arange(skv)[None, :]
+        causal_mask = q_pos >= k_pos  # [sq, skv]
+        logits = jnp.where(causal_mask[None, None], logits, jnp.finfo(logits_dtype).min)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(logits_dtype).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Blocked flash attention. Dispatches to the Pallas TPU kernel when
+    running on TPU with compatible shapes; jnp reference otherwise."""
+    if _use_pallas(q):
+        from .pallas.flash_attention import flash_attention as _pallas_flash
+
+        return _pallas_flash(q, k, v, causal=causal, scale=scale,
+                             block_q=block_q, block_k=block_k)
+    return dot_product_attention(q, k, v, causal=causal, scale=scale)
+
+
+def _use_pallas(q) -> bool:
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return False
+    if platform not in ("tpu",):
+        return False
+    b, s, h, d = q.shape
+    return s >= 128 and d % 128 == 0 or d in (64, 128, 256)

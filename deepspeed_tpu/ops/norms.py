@@ -1,0 +1,31 @@
+"""Normalization ops.
+
+Replaces the reference's fused CUDA norm kernels
+(``csrc/transformer/inference/csrc/layer_norm.cu`` / ``rms_norm.cu`` and the
+FastGen v2 ``cuda_layer_norm`` / ``cuda_rms_norm`` modules). On TPU these are
+bandwidth-bound elementwise+reduction patterns that XLA fuses into the
+surrounding matmul epilogue/prologue, so the jnp forms below compile to the
+same fused program the reference hand-writes; a Pallas variant exists in
+``ops/pallas/fused_norm.py`` for cases XLA can't fuse (quantized epilogues).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    """RMSNorm (pre-norm Llama style). fp32 accumulation regardless of input
+    dtype, matching the reference kernels' internal float accumulators."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (y * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def layer_norm(x, weight, bias, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (y * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)

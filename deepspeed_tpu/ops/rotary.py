@@ -1,0 +1,34 @@
+"""Rotary position embeddings.
+
+Replaces the reference's CUDA rotary kernels
+(``csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu`` and FastGen's
+``linear_blocked_kv_rotary``). Pure jnp: XLA fuses the sin/cos modulation
+into the QK projection epilogue.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0):
+    """Precompute [max_len, head_dim/2] angle table."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    return jnp.outer(t, inv_freq)  # [max_len, head_dim//2]
+
+
+def apply_rotary(x, angles, positions=None):
+    """Apply RoPE. x: [..., seq, n_heads, head_dim]; angles: [max_len, hd/2];
+    positions: optional [..., seq] int32 (for KV-cache decode offsets)."""
+    if positions is None:
+        seq = x.shape[-3]
+        ang = angles[:seq]  # [seq, hd/2]
+        ang = ang[(None,) * (x.ndim - 3) + (slice(None), None, slice(None))]
+    else:
+        ang = angles[positions]  # [..., seq, hd/2]
+        ang = ang[..., None, :]  # broadcast over heads
+    sin, cos = jnp.sin(ang), jnp.cos(ang)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,483 @@
+"""Training engine.
+
+Capability parity with the reference's ``DeepSpeedEngine``
+(``runtime/engine.py:175`` — forward :1761 / backward :1902 / step :2100,
+gradient accumulation, allreduce, mixed precision, checkpoint save/load,
+monitor + timer integration), redesigned TPU-first:
+
+* The fwd/bwd/step trio and all of ZeRO's hook machinery compile into ONE
+  jitted, donated ``train_step`` containing a ``lax.scan`` over gradient-
+  accumulation microbatches, gradient sharding constraints (ZeRO), global-
+  norm clipping, loss scaling, and the fused optimizer update. XLA inserts
+  and overlaps every collective the reference issues by hand.
+* DeepSpeed's imperative micro-batch API (``forward``/``backward``/``step``
+  per microbatch with ``is_gradient_accumulation_boundary``) is preserved as
+  a compatibility path that accumulates gradient shards across jitted calls
+  and applies the same update at the boundary.
+* ZeRO stages 0-3 are placement policies from ``parallel/zero.py`` — there
+  is no separate optimizer wrapper class per stage (reference
+  stage_1_and_2.py / stage3.py / bf16_optimizer.py / fused_optimizer.py all
+  collapse here).
+
+Mixed precision follows the BF16_Optimizer design (reference
+runtime/bf16_optimizer.py:30): fp32 master params live in the (ZeRO-sharded)
+param tree; compute casts to bf16/fp16 at the loss-fn boundary. fp16 adds
+dynamic loss scaling (runtime/fp16/loss_scaler.py parity in
+``runtime/loss_scaler.py``).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..config import Config
+from ..parallel.mesh import Topology
+from ..parallel.zero import ZeroShardingRules
+from ..utils.logging import log_dist, logger
+from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from . import loss_scaler as ls
+from .checkpoint import CheckpointEngine, consolidate_full_state, validate_tag_consistency
+from .lr_schedules import Schedule, build_schedule, constant_lr
+from .optimizers import Transform, as_transform, build_optimizer
+
+LossFn = Callable[..., Any]  # (params, batch, rng) -> loss | (loss, aux)
+
+
+def _normalize_loss_fn(loss_fn: LossFn) -> Callable[[Any, Any, Any], Tuple[Any, Dict[str, Any]]]:
+    def wrapped(params, batch, rng):
+        out = loss_fn(params, batch, rng)
+        if isinstance(out, tuple):
+            loss, aux = out
+            if not isinstance(aux, dict):
+                aux = {"aux": aux}
+        else:
+            loss, aux = out, {}
+        return loss, aux
+
+    return wrapped
+
+
+def _cast_tree(tree: Any, dtype) -> Any:
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def global_norm(tree: Any) -> jnp.ndarray:
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(tree)]
+    if not leaves:
+        return jnp.zeros([], jnp.float32)
+    return jnp.sqrt(jnp.asarray(leaves).sum())
+
+
+class TrainEngine:
+    """The TPU-native DeepSpeedEngine."""
+
+    def __init__(self, *,
+                 loss_fn: LossFn,
+                 params: Any,
+                 config: Config,
+                 topology: Optional[Topology] = None,
+                 optimizer: Optional[Any] = None,
+                 lr_scheduler: Optional[Any] = None,
+                 tp_specs: Optional[Any] = None,
+                 model: Optional[Any] = None,
+                 donate: bool = True):
+        self.config = config
+        self.model = model
+        self.topo = topology or Topology.build(config.mesh)
+        self._raw_loss_fn = loss_fn
+        self.loss_fn = _normalize_loss_fn(loss_fn)
+        self.tp_specs = tp_specs
+        self._donate = donate
+
+        # -- batch arithmetic (reference config._configure_train_batch_size)
+        config.resolve_batch_config(self.topo.data_parallel_size)
+        log_dist(
+            f"batch config: train_batch={config.train_batch_size} "
+            f"micro_batch={config.train_micro_batch_size_per_gpu} "
+            f"gas={config.gradient_accumulation_steps} dp={self.topo.data_parallel_size}"
+        )
+
+        # -- ZeRO placement rules
+        self.zero_rules = ZeroShardingRules(self.topo, config.zero)
+        param_shapes = jax.eval_shape(lambda p: p, params)
+        self.param_shardings = self.zero_rules.param_shardings(param_shapes, tp_specs)
+        self.grad_shardings = self.zero_rules.grad_shardings(param_shapes, tp_specs)
+
+        # master params: fp32 (BF16_Optimizer design); compute dtype applied in loss
+        params = _cast_tree(params, jnp.float32)
+        self.params = jax.device_put(params, self.param_shardings)
+
+        # -- optimizer + schedule
+        base_lr = float(config.optimizer.params.get("lr", 1e-3))
+        if lr_scheduler is not None and callable(lr_scheduler):
+            self.lr_schedule: Schedule = lr_scheduler
+        elif config.scheduler.type:
+            self.lr_schedule = build_schedule(config.scheduler.type, config.scheduler.params, base_lr)
+        else:
+            self.lr_schedule = constant_lr(base_lr)
+        if optimizer is not None:
+            self.optimizer: Transform = as_transform(optimizer)
+        else:
+            self.optimizer = build_optimizer(config.optimizer.type, config.optimizer.params,
+                                             lr_schedule=self.lr_schedule)
+
+        opt_shape = jax.eval_shape(self.optimizer.init, params)
+        self.opt_state_shardings = self.zero_rules.opt_state_shardings(opt_shape)
+        self.opt_state = jax.jit(
+            self.optimizer.init, out_shardings=self.opt_state_shardings
+        )(self.params)
+
+        # -- loss scaling state
+        if config.fp16.enabled:
+            if config.fp16.dynamic_loss_scale:
+                self.scaler_state = ls.make_state(config.fp16.initial_scale_power, config.fp16.hysteresis)
+            else:
+                self.scaler_state = ls.static_state(config.fp16.loss_scale)
+        else:
+            self.scaler_state = ls.static_state(1.0)
+
+        self.compute_dtype = config.compute_dtype
+        self.global_steps = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self.rng = jax.random.PRNGKey(config.train_seed)
+
+        # -- bookkeeping / observability
+        self.timers = SynchronizedWallClockTimer()
+        self.tput = ThroughputTimer(batch_size=config.train_batch_size,
+                                    steps_per_output=config.steps_per_print)
+        self.monitor = None
+        if config.monitor.enabled:
+            from ..monitor.monitor import MonitorMaster
+
+            self.monitor = MonitorMaster(config.monitor)
+        self.ckpt_engine = CheckpointEngine(async_save=config.checkpoint.async_save)
+
+        # compat micro-step accumulation state
+        self._acc_grads: Optional[Any] = None
+        self._last_loss = None
+
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._micro_grad_fn = None
+        self._apply_update_fn = None
+
+    # ==================================================================
+    # properties (parity with engine.py:468-:869 accessors)
+    @property
+    def train_batch_size(self) -> int:
+        return self.config.train_batch_size
+
+    @property
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self.config.train_micro_batch_size_per_gpu
+
+    @property
+    def gradient_accumulation_steps(self) -> int:
+        return self.config.gradient_accumulation_steps
+
+    @property
+    def zero_optimization_stage(self) -> int:
+        return self.config.zero.stage
+
+    @property
+    def data_parallel_world_size(self) -> int:
+        return self.topo.data_parallel_size
+
+    @property
+    def world_size(self) -> int:
+        return self.topo.world_size
+
+    @property
+    def gradient_clipping(self) -> float:
+        return self.config.gradient_clipping
+
+    def get_lr(self) -> float:
+        return float(self.lr_schedule(jnp.asarray(self.global_steps)))
+
+    def get_loss_scale(self) -> float:
+        return float(self.scaler_state.scale)
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return (self.micro_steps + 1) % self.gradient_accumulation_steps == 0
+
+    # ==================================================================
+    # core jitted programs
+    def _loss_and_grads(self, params, batch, rng, scale):
+        """One microbatch: grads of (scaled) loss wrt fp32 master params,
+        computed in the compute dtype."""
+
+        def scaled_loss(p):
+            loss, aux = self.loss_fn(_cast_tree(p, self.compute_dtype), batch, rng)
+            return loss.astype(jnp.float32) * scale, (loss, aux)
+
+        grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(params)
+        return grads, loss, aux
+
+    def _build_train_step(self):
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        clip = cfg.gradient_clipping
+        fp16 = cfg.fp16.enabled
+        dynamic = fp16 and cfg.fp16.dynamic_loss_scale
+        optimizer = self.optimizer
+
+        def train_step(params, opt_state, scaler_state, rng, batch):
+            scale = scaler_state.scale if fp16 else jnp.ones([], jnp.float32)
+
+            def micro(carry, mb):
+                acc, rng = carry
+                rng, sub = jax.random.split(rng)
+                grads, loss, _aux = self._loss_and_grads(params, mb, sub, scale)
+                grads = jax.lax.with_sharding_constraint(grads, self.grad_shardings)
+                acc_g, acc_loss = acc
+                acc_g = jax.tree_util.tree_map(lambda a, g: a + g.astype(jnp.float32), acc_g, grads)
+                return ((acc_g, acc_loss + loss.astype(jnp.float32)), rng), None
+
+            if gas > 1:
+                # [global_batch, ...] -> [gas, global_batch/gas, ...]
+                mb_batch = jax.tree_util.tree_map(
+                    lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch)
+                zero_acc = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, jnp.float32), jax.eval_shape(lambda p: p, params))
+                zero_acc = jax.lax.with_sharding_constraint(zero_acc, self.grad_shardings)
+                (carry, rng), _ = jax.lax.scan(
+                    micro, ((zero_acc, jnp.zeros([], jnp.float32)), rng), mb_batch)
+                grads, loss_sum = carry
+                inv = 1.0 / gas
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                loss = loss_sum * inv
+            else:
+                rng, sub = jax.random.split(rng)
+                grads, loss, _aux = self._loss_and_grads(params, batch, sub, scale)
+                grads = jax.lax.with_sharding_constraint(
+                    jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads),
+                    self.grad_shardings)
+
+            new_params, new_opt, new_scaler, gnorm, skipped = self._update(
+                params, opt_state, scaler_state, grads, scale,
+                clip=clip, fp16=fp16, dynamic=dynamic, optimizer=optimizer)
+            metrics = {
+                "loss": loss,
+                "grad_norm": gnorm,
+                "loss_scale": new_scaler.scale,
+                "skipped": skipped,
+            }
+            return new_params, new_opt, new_scaler, rng, metrics
+
+        donate = (0, 1, 2) if self._donate else ()
+        return jax.jit(train_step, donate_argnums=donate)
+
+    def _update(self, params, opt_state, scaler_state, grads, scale, *,
+                clip, fp16, dynamic, optimizer):
+        """Unscale, clip, step — shared by fused and compat paths."""
+        cfg = self.config
+        if fp16:
+            grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+            finite = ls.grads_finite(grads)
+        else:
+            finite = jnp.asarray(True)
+        gnorm = global_norm(grads)
+        if clip > 0:
+            factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        # overflow => keep old params/opt state (reference: skipped step)
+        if fp16:
+            new_params = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(finite, n, o), new_params, params)
+            new_opt = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(finite, n, o) if hasattr(n, "dtype") else n,
+                new_opt, opt_state)
+        new_scaler = ls.update(
+            scaler_state, finite, dynamic=dynamic,
+            scale_window=cfg.fp16.loss_scale_window,
+            min_scale=cfg.fp16.min_loss_scale,
+            consecutive_hysteresis=cfg.fp16.consecutive_hysteresis,
+            init_hysteresis=cfg.fp16.hysteresis)
+        new_params = jax.lax.with_sharding_constraint(new_params, self.param_shardings)
+        skipped = jnp.logical_not(finite)
+        return new_params, new_opt, new_scaler, gnorm, skipped
+
+    # ==================================================================
+    # fused fast path
+    def train_batch(self, batch: Any) -> Dict[str, Any]:
+        """One full optimizer step over a global batch of
+        ``train_batch_size`` samples (parity with PipelineEngine.train_batch
+        semantics for the non-pipelined engine)."""
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        self.tput.start()
+        self.params, self.opt_state, self.scaler_state, self.rng, metrics = self._train_step_fn(
+            self.params, self.opt_state, self.scaler_state, self.rng, batch)
+        self.global_steps += 1
+        self.micro_steps += self.gradient_accumulation_steps
+        self.tput.stop(sync_obj=metrics["loss"], report_speed=True)
+        self._write_monitor(metrics)
+        if bool(metrics["skipped"]):
+            self.skipped_steps += 1
+        self._last_loss = metrics["loss"]
+        return metrics
+
+    # ==================================================================
+    # DeepSpeed-compatible micro-step path
+    def forward(self, batch: Any) -> Any:
+        """Compute loss for a microbatch (no grads). Provided for API parity;
+        ``backward`` recomputes through ``jax.grad`` (forward+backward fuse
+        on TPU, so the split exists only at the Python API level)."""
+        loss, _aux = self._jitted_eval()(self.params, batch, self._next_rng())
+        self._last_loss = loss
+        return loss
+
+    def backward(self, batch: Any) -> Any:
+        """Accumulate gradient shards for one microbatch (parity with
+        engine.backward engine.py:1902 + ZeRO IPG accumulation)."""
+        if self._micro_grad_fn is None:
+            self._micro_grad_fn = jax.jit(
+                lambda p, b, r, s: self._loss_and_grads(p, b, r, s)[:2],
+                out_shardings=(self.grad_shardings, None))
+        scale = self.scaler_state.scale if self.config.fp16.enabled else jnp.ones([], jnp.float32)
+        grads, loss = self._micro_grad_fn(self.params, batch, self._next_rng(), scale)
+        if self._acc_grads is None:
+            self._acc_grads = grads
+        else:
+            self._acc_grads = jax.jit(
+                lambda a, g: jax.tree_util.tree_map(jnp.add, a, g),
+                donate_argnums=(0,))(self._acc_grads, grads)
+        self.micro_steps += 1
+        self._last_loss = loss
+        return loss
+
+    def step(self) -> None:
+        """Apply the update at a gradient-accumulation boundary (parity with
+        engine.step engine.py:2100: no-op off-boundary)."""
+        if self.micro_steps % self.gradient_accumulation_steps != 0:
+            return
+        if self._acc_grads is None:
+            logger.warning("step() called with no accumulated gradients")
+            return
+        if self._apply_update_fn is None:
+            optimizer = self.optimizer
+            cfg = self.config
+
+            def apply_update(params, opt_state, scaler_state, grads):
+                inv = 1.0 / cfg.gradient_accumulation_steps
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                scale = scaler_state.scale if cfg.fp16.enabled else jnp.ones([], jnp.float32)
+                return self._update(params, opt_state, scaler_state, grads, scale,
+                                    clip=cfg.gradient_clipping, fp16=cfg.fp16.enabled,
+                                    dynamic=cfg.fp16.enabled and cfg.fp16.dynamic_loss_scale,
+                                    optimizer=optimizer)
+
+            donate = (0, 1, 2, 3) if self._donate else ()
+            self._apply_update_fn = jax.jit(apply_update, donate_argnums=donate)
+
+        self.params, self.opt_state, self.scaler_state, gnorm, skipped = self._apply_update_fn(
+            self.params, self.opt_state, self.scaler_state, self._acc_grads)
+        self._acc_grads = None
+        self.global_steps += 1
+        if bool(skipped):
+            self.skipped_steps += 1
+        self._write_monitor({"loss": self._last_loss, "grad_norm": gnorm,
+                             "loss_scale": self.scaler_state.scale, "skipped": skipped})
+
+    # ==================================================================
+    def eval_batch(self, batch: Any) -> Any:
+        loss, aux = self._jitted_eval()(self.params, batch, self._next_rng())
+        return loss
+
+    def _jitted_eval(self):
+        if self._eval_step_fn is None:
+            def eval_step(params, batch, rng):
+                return self.loss_fn(_cast_tree(params, self.compute_dtype), batch, rng)
+
+            self._eval_step_fn = jax.jit(eval_step)
+        return self._eval_step_fn
+
+    def _next_rng(self):
+        self.rng, sub = jax.random.split(self.rng)
+        return sub
+
+    def _write_monitor(self, metrics: Dict[str, Any]) -> None:
+        if self.global_steps % self.config.steps_per_print == 0:
+            log_dist(
+                f"step={self.global_steps} loss={float(metrics['loss']):.4f} "
+                f"lr={self.get_lr():.3e} grad_norm={float(metrics['grad_norm']):.3f}"
+                + (f" loss_scale={float(metrics['loss_scale']):.0f}" if self.config.fp16.enabled else "")
+            )
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("Train/loss", float(metrics["loss"]), self.global_steps),
+                ("Train/lr", self.get_lr(), self.global_steps),
+                ("Train/grad_norm", float(metrics["grad_norm"]), self.global_steps),
+            ])
+
+    # ==================================================================
+    # checkpointing (parity with engine.save_checkpoint engine.py:3010)
+    def _state_dict(self) -> Dict[str, Any]:
+        return {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "scaler": self.scaler_state,
+            "step": jnp.asarray(self.global_steps, jnp.int32),
+            "rng": self.rng,
+        }
+
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[Dict[str, Any]] = None) -> str:
+        tag = tag if tag is not None else f"global_step{self.global_steps}"
+        validate_tag_consistency(str(tag), self.config.checkpoint.tag_validation)
+        return self.ckpt_engine.save(
+            save_dir, str(tag), self._state_dict(),
+            client_state={**(client_state or {}),
+                          "global_steps": self.global_steps,
+                          "micro_steps": self.micro_steps,
+                          "skipped_steps": self.skipped_steps},
+            config_snapshot=self.config.raw)
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_optimizer_states: bool = True) -> Optional[Dict[str, Any]]:
+        template = jax.tree_util.tree_map(lambda x: x, self._state_dict())
+        result = self.ckpt_engine.load(load_dir, tag, template=template)
+        if result is None:
+            return None
+        state = result["state"]
+        repl = self.topo.replicated()
+        self.params = jax.device_put(state["params"], self.param_shardings)
+        if load_optimizer_states:
+            self.opt_state = jax.device_put(state["opt_state"], self.opt_state_shardings)
+            self.scaler_state = jax.device_put(
+                jax.tree_util.tree_map(jnp.asarray, state["scaler"]), repl)
+        self.global_steps = int(state["step"])
+        self.rng = jax.device_put(jnp.asarray(state["rng"]), repl)
+        client = result["meta"].get("client_state", {})
+        self.micro_steps = int(client.get("micro_steps", self.global_steps * self.gradient_accumulation_steps))
+        self.skipped_steps = int(client.get("skipped_steps", 0))
+        return client
+
+    def save_16bit_model(self, save_dir: str, filename: str = "model_fp16.npz") -> str:
+        """Consolidated 16-bit export (reference engine.save_16bit_model
+        engine.py:3492 + zero_to_fp32 consolidation)."""
+        os.makedirs(save_dir, exist_ok=True)
+        flat = consolidate_full_state(_cast_tree(self.params, jnp.bfloat16))
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(flat)
+        out = {jax.tree_util.keystr(k): np.asarray(v) for k, v in leaves}
+        path = os.path.join(save_dir, filename)
+        np.savez(path, **out)
+        return path
+
+    def get_fp32_state_dict(self) -> Any:
+        return consolidate_full_state(self.params, dtype=np.float32)

@@ -1,0 +1,82 @@
+"""Dynamic loss scaling for fp16.
+
+Capability parity with the reference's ``runtime/fp16/loss_scaler.py:91``
+(DynamicLossScaler: scale-up window, hysteresis backoff, min scale) rebuilt
+as a pure pytree state + update function so the whole overflow check + scale
+adjustment lives inside the jitted train step (the reference does a separate
+allreduce of the overflow flag — stage3.py step; here the finite-check is a
+fused reduction over gradient shards and needs no extra collective beyond
+the psum XLA already inserts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # f32 current loss scale
+    good_steps: jnp.ndarray     # i32 consecutive overflow-free steps
+    hysteresis: jnp.ndarray     # i32 remaining tolerated overflows before backoff
+
+
+def make_state(initial_scale_power: int = 16, hysteresis: int = 2) -> LossScaleState:
+    return LossScaleState(
+        scale=jnp.asarray(2.0 ** initial_scale_power, jnp.float32),
+        good_steps=jnp.zeros([], jnp.int32),
+        hysteresis=jnp.asarray(hysteresis, jnp.int32),
+    )
+
+
+def static_state(loss_scale: float) -> LossScaleState:
+    return LossScaleState(
+        scale=jnp.asarray(loss_scale, jnp.float32),
+        good_steps=jnp.zeros([], jnp.int32),
+        hysteresis=jnp.asarray(1 << 30, jnp.int32),
+    )
+
+
+def grads_finite(grads: Any) -> jnp.ndarray:
+    """All-finite check over a gradient pytree (reference _has_inf_or_nan,
+    stage3.py:2097, inverted)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(True)
+    per_leaf = [jnp.all(jnp.isfinite(g)) for g in leaves]
+    return jnp.stack(per_leaf).all()
+
+
+def update(state: LossScaleState, finite: jnp.ndarray, *,
+           dynamic: bool = True, scale_window: int = 1000,
+           scale_factor: float = 2.0, min_scale: float = 1.0,
+           max_scale: float = 2.0 ** 24,
+           consecutive_hysteresis: bool = False,
+           init_hysteresis: int = 2) -> LossScaleState:
+    """One scaler step. Mirrors DynamicLossScaler.update_scale
+    (loss_scaler.py:91): on overflow consume hysteresis then halve; after
+    ``scale_window`` clean steps double."""
+    if not dynamic:
+        return state
+
+    def on_overflow(s: LossScaleState) -> LossScaleState:
+        hys = s.hysteresis - 1
+        backoff = hys <= 0
+        new_scale = jnp.where(backoff, jnp.maximum(s.scale / scale_factor, min_scale), s.scale)
+        new_hys = jnp.where(backoff, jnp.asarray(init_hysteresis, jnp.int32), hys)
+        return LossScaleState(scale=new_scale, good_steps=jnp.zeros([], jnp.int32), hysteresis=new_hys)
+
+    def on_clean(s: LossScaleState) -> LossScaleState:
+        good = s.good_steps + 1
+        grow = good >= scale_window
+        new_scale = jnp.where(grow, jnp.minimum(s.scale * scale_factor, max_scale), s.scale)
+        new_good = jnp.where(grow, jnp.zeros([], jnp.int32), good)
+        new_hys = jnp.asarray(init_hysteresis, jnp.int32) if consecutive_hysteresis else s.hysteresis
+        return LossScaleState(scale=new_scale, good_steps=new_good, hysteresis=new_hys)
+
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(finite, a, b),
+        on_clean(state), on_overflow(state),
+    )

@@ -1,0 +1,306 @@
+"""Optimizer library.
+
+Capability parity with the reference's optimizer zoo — FusedAdam
+(``csrc/adam/multi_tensor_adam.cu`` + ``ops/adam/fused_adam.py:18``),
+DeepSpeedCPUAdam (``csrc/adam/cpu_adam_impl.cpp``), FusedLamb
+(``csrc/lamb/fused_lamb_cuda.cu``), Lion (``csrc/lion/``), CPUAdagrad
+(``csrc/adagrad/cpu_adagrad.cpp``) — rebuilt as pure-JAX update rules. Under
+``jit`` every update fuses into a handful of elementwise XLA kernels per
+weight shard, which *is* the multi-tensor-apply optimization the reference
+implements by hand in CUDA: no Python-per-tensor loop survives compilation,
+and with ZeRO sharding each device only touches its shard.
+
+The API is optax-compatible (``init(params) -> state``,
+``update(grads, state, params) -> (updates, state)``) so user-supplied optax
+transforms drop in, matching how the reference accepts client torch
+optimizers (engine.py:1197 _configure_optimizer).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+ScalarOrSchedule = Any  # float or callable(step)->float
+
+
+class Transform(NamedTuple):
+    """Minimal optax-style gradient transformation."""
+
+    init: Callable[[Any], Any]
+    update: Callable[..., Tuple[Any, Any]]
+
+
+def _lr_at(lr: ScalarOrSchedule, count):
+    return lr(count) if callable(lr) else lr
+
+
+class AdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any
+    nu: Any
+
+
+def adam(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+         weight_decay: float = 0.0, adam_w_mode: bool = True,
+         bias_correction: bool = True) -> Transform:
+    """Adam/AdamW. Parity with reference FusedAdam (ops/adam/fused_adam.py:18
+    — same knobs: bias_correction, adam_w_mode, weight_decay)."""
+    b1, b2 = betas
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return AdamState(count=jnp.zeros([], jnp.int32),
+                         mu=jax.tree_util.tree_map(zeros, params),
+                         nu=jax.tree_util.tree_map(zeros, params))
+
+    def update(grads, state, params):
+        count = state.count + 1
+        lr_t = _lr_at(lr, count)
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state.mu, grads)
+        nu = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)),
+                                    state.nu, grads)
+        if bias_correction:
+            c1 = 1 - b1 ** count.astype(jnp.float32)
+            c2 = 1 - b2 ** count.astype(jnp.float32)
+        else:
+            c1 = c2 = jnp.ones([], jnp.float32)
+
+        def upd(m, v, p):
+            step = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            if weight_decay:
+                if adam_w_mode:
+                    step = step + weight_decay * p.astype(jnp.float32)
+            return (-lr_t * step).astype(p.dtype)
+
+        if weight_decay and not adam_w_mode:
+            # classic (L2) mode: decay folded into the gradient
+            grads = jax.tree_util.tree_map(lambda g, p: g + weight_decay * p.astype(g.dtype), grads, params)
+            mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state.mu, grads)
+            nu = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)),
+                                        state.nu, grads)
+        updates = jax.tree_util.tree_map(upd, mu, nu, params)
+        return updates, AdamState(count, mu, nu)
+
+    return Transform(init, update)
+
+
+def adamw(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+          weight_decay: float = 0.01) -> Transform:
+    return adam(lr, betas, eps, weight_decay, adam_w_mode=True)
+
+
+class SgdState(NamedTuple):
+    count: jnp.ndarray
+    momentum: Any
+
+
+def sgd(lr: ScalarOrSchedule = 1e-3, momentum: float = 0.0, weight_decay: float = 0.0,
+        nesterov: bool = False) -> Transform:
+    def init(params):
+        mom = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params) \
+            if momentum else None
+        return SgdState(count=jnp.zeros([], jnp.int32), momentum=mom)
+
+    def update(grads, state, params):
+        count = state.count + 1
+        lr_t = _lr_at(lr, count)
+        if weight_decay:
+            grads = jax.tree_util.tree_map(lambda g, p: g + weight_decay * p.astype(g.dtype), grads, params)
+        if momentum:
+            mom = jax.tree_util.tree_map(lambda m, g: momentum * m + g.astype(jnp.float32),
+                                         state.momentum, grads)
+            if nesterov:
+                eff = jax.tree_util.tree_map(lambda g, m: g.astype(jnp.float32) + momentum * m, grads, mom)
+            else:
+                eff = mom
+        else:
+            mom, eff = None, grads
+        updates = jax.tree_util.tree_map(lambda e, p: (-lr_t * e).astype(p.dtype), eff, params)
+        return updates, SgdState(count, mom)
+
+    return Transform(init, update)
+
+
+class LambState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any
+    nu: Any
+
+
+def lamb(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999), eps: float = 1e-6,
+         weight_decay: float = 0.0, min_trust: float = 0.01, max_trust: float = 10.0) -> Transform:
+    """LAMB: layerwise-adaptive Adam. Parity with reference FusedLamb
+    (csrc/lamb/fused_lamb_cuda.cu, ops/lamb/fused_lamb.py) including the
+    trust-ratio clamp (min_coeff/max_coeff there)."""
+    b1, b2 = betas
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return LambState(count=jnp.zeros([], jnp.int32),
+                         mu=jax.tree_util.tree_map(zeros, params),
+                         nu=jax.tree_util.tree_map(zeros, params))
+
+    def update(grads, state, params):
+        count = state.count + 1
+        lr_t = _lr_at(lr, count)
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        c2 = 1 - b2 ** count.astype(jnp.float32)
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state.mu, grads)
+        nu = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)),
+                                    state.nu, grads)
+
+        def upd(m, v, p):
+            u = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            if weight_decay:
+                u = u + weight_decay * p.astype(jnp.float32)
+            w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            u_norm = jnp.linalg.norm(u)
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, min_trust, max_trust),
+                1.0,
+            )
+            return (-lr_t * trust * u).astype(p.dtype)
+
+        updates = jax.tree_util.tree_map(upd, mu, nu, params)
+        return updates, LambState(count, mu, nu)
+
+    return Transform(init, update)
+
+
+class LionState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any
+
+
+def lion(lr: ScalarOrSchedule = 1e-4, betas=(0.9, 0.99), weight_decay: float = 0.0) -> Transform:
+    """Lion. Parity with reference FusedLion/DeepSpeedCPULion (csrc/lion/)."""
+    b1, b2 = betas
+
+    def init(params):
+        return LionState(count=jnp.zeros([], jnp.int32),
+                         mu=jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params))
+
+    def update(grads, state, params):
+        count = state.count + 1
+        lr_t = _lr_at(lr, count)
+
+        def upd(m, g, p):
+            g32 = g.astype(jnp.float32)
+            direction = jnp.sign(b1 * m + (1 - b1) * g32)
+            if weight_decay:
+                direction = direction + weight_decay * p.astype(jnp.float32)
+            return (-lr_t * direction).astype(p.dtype)
+
+        updates = jax.tree_util.tree_map(upd, state.mu, grads, params)
+        mu = jax.tree_util.tree_map(lambda m, g: b2 * m + (1 - b2) * g.astype(jnp.float32), state.mu, grads)
+        return updates, LionState(count, mu)
+
+    return Transform(init, update)
+
+
+class AdagradState(NamedTuple):
+    count: jnp.ndarray
+    accum: Any
+
+
+def adagrad(lr: ScalarOrSchedule = 1e-2, eps: float = 1e-10, weight_decay: float = 0.0,
+            initial_accumulator_value: float = 0.0) -> Transform:
+    """Adagrad. Parity with reference DeepSpeedCPUAdagrad (csrc/adagrad/)."""
+
+    def init(params):
+        return AdagradState(
+            count=jnp.zeros([], jnp.int32),
+            accum=jax.tree_util.tree_map(
+                lambda p: jnp.full_like(p, initial_accumulator_value, dtype=jnp.float32), params),
+        )
+
+    def update(grads, state, params):
+        count = state.count + 1
+        lr_t = _lr_at(lr, count)
+        if weight_decay:
+            grads = jax.tree_util.tree_map(lambda g, p: g + weight_decay * p.astype(g.dtype), grads, params)
+        accum = jax.tree_util.tree_map(lambda a, g: a + jnp.square(g.astype(jnp.float32)), state.accum, grads)
+        updates = jax.tree_util.tree_map(
+            lambda a, g, p: (-lr_t * g.astype(jnp.float32) / (jnp.sqrt(a) + eps)).astype(p.dtype),
+            accum, grads, params)
+        return updates, AdagradState(count, accum)
+
+    return Transform(init, update)
+
+
+# ----------------------------------------------------------------------
+# Registry — parity with engine._configure_basic_optimizer (engine.py:1245)
+# name matching of the reference ("adam", "adamw", "lamb", "lion",
+# "adagrad", "sgd", "onebitadam"...). 1-bit optimizers are realized as the
+# plain rule + quantized gradient collectives (ops/quantization.py), since
+# error-compensated compressed allreduce is a comm-layer concern on TPU.
+
+OPTIMIZER_REGISTRY = {
+    "adam": adam,
+    "adamw": adamw,
+    "fusedadam": adam,
+    "cpuadam": adam,  # offload variant — same math, placement handled by engine
+    "deepspeedcpuadam": adam,
+    "sgd": sgd,
+    "lamb": lamb,
+    "fusedlamb": lamb,
+    "lion": lion,
+    "fusedlion": lion,
+    "cpulion": lion,
+    "adagrad": adagrad,
+    "cpuadagrad": adagrad,
+    "onebitadam": adam,
+    "zerooneadam": adam,
+    "onebitlamb": lamb,
+}
+
+_COMMON_RENAMES = {"learning_rate": "lr", "beta1": None, "beta2": None}
+
+
+def build_optimizer(name: str, params_dict: Optional[dict] = None,
+                    lr_schedule: Optional[Callable] = None) -> Transform:
+    """Build an optimizer from config ``{"type": ..., "params": {...}}``.
+
+    Accepts the reference's param spellings: lr, betas, eps, weight_decay,
+    momentum, bias_correction, adam_w_mode.
+    """
+    key = name.lower().replace("_", "").replace("-", "")
+    if key not in OPTIMIZER_REGISTRY:
+        raise ValueError(f"Unknown optimizer '{name}'. Known: {sorted(set(OPTIMIZER_REGISTRY))}")
+    factory = OPTIMIZER_REGISTRY[key]
+    kwargs = dict(params_dict or {})
+    kwargs.pop("torch_adam", None)
+    kwargs.pop("adamw_mode", None) and kwargs.setdefault("adam_w_mode", True)
+    if "adamw_mode" in (params_dict or {}):
+        kwargs["adam_w_mode"] = bool(params_dict["adamw_mode"])
+    if "freeze_step" in kwargs:  # 1-bit warmup knob — accepted, comm-layer concern
+        kwargs.pop("freeze_step")
+    for k in ("cuda_aware", "comm_backend_name", "coeff_beta", "factor_max", "factor_min", "factor_threshold"):
+        kwargs.pop(k, None)
+    if lr_schedule is not None:
+        kwargs["lr"] = lr_schedule
+    import inspect
+
+    sig = inspect.signature(factory)
+    accepted = {k: v for k, v in kwargs.items() if k in sig.parameters}
+    dropped = set(kwargs) - set(accepted)
+    if dropped:
+        from ..utils.logging import logger
+
+        logger.warning(f"Optimizer '{name}': ignoring unsupported params {sorted(dropped)}")
+    if "betas" in accepted:
+        accepted["betas"] = tuple(accepted["betas"])
+    return factory(**accepted)
+
+
+def as_transform(opt: Any) -> Transform:
+    """Wrap an optax GradientTransformation (or anything with init/update)."""
+    if isinstance(opt, Transform):
+        return opt
+    if hasattr(opt, "init") and hasattr(opt, "update"):
+        return Transform(init=opt.init, update=lambda g, s, p: opt.update(g, s, p))
+    raise TypeError(f"Cannot interpret {opt!r} as an optimizer")

@@ -1,0 +1,59 @@
+"""Rank-aware logging utilities.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py``
+(``logger`` + ``log_dist`` rank-filtered logging), rebuilt for a JAX
+multi-process world where the process index comes from
+``jax.process_index()`` instead of ``torch.distributed.get_rank()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+_LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    if lg.handlers:
+        return lg
+    lg.setLevel(level)
+    lg.propagate = False
+    handler = logging.StreamHandler(stream=sys.stderr)
+    handler.setFormatter(logging.Formatter(_LOG_FORMAT, datefmt="%Y-%m-%d %H:%M:%S"))
+    lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    # Avoid importing jax at module import time (tests set env vars first);
+    # also works before jax.distributed initialization.
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("RANK", "0"))
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (default: rank 0 only).
+
+    ``ranks=[-1]`` logs on every process.
+    """
+    my_rank = _process_index()
+    ranks = list(ranks) if ranks is not None else [0]
+    if -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:  # noqa: B006 - intentional cache
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

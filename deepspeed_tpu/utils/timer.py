@@ -1,0 +1,106 @@
+"""Wall-clock and throughput timers.
+
+Capability parity with the reference's ``deepspeed/utils/timer.py``
+(SynchronizedWallClockTimer + ThroughputTimer driven by EngineTimers,
+engine.py:140). On TPU, synchronization means ``jax.block_until_ready`` on a
+representative array instead of CUDA events.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from .logging import log_dist
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._start: Optional[float] = None
+        self.elapsed_total = 0.0
+        self.count = 0
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+
+    def stop(self, sync_obj: Any = None) -> float:
+        if sync_obj is not None:
+            import jax
+
+            jax.block_until_ready(sync_obj)
+        assert self._start is not None, f"timer {self.name} stopped before start"
+        dt = time.perf_counter() - self._start
+        self.elapsed_total += dt
+        self.count += 1
+        self._start = None
+        return dt
+
+    def mean_ms(self) -> float:
+        return (self.elapsed_total / self.count * 1e3) if self.count else 0.0
+
+    def reset(self) -> None:
+        self.elapsed_total = 0.0
+        self.count = 0
+        self._start = None
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry (reference utils/timer.py same-named class)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names: Optional[List[str]] = None, reset: bool = True) -> str:
+        names = names or list(self.timers)
+        parts = [f"{n}: {self.timers[n].mean_ms():.2f}ms" for n in names if n in self.timers]
+        msg = " | ".join(parts)
+        if msg:
+            log_dist(f"time (ms) | {msg}")
+        if reset:
+            for n in names:
+                if n in self.timers:
+                    self.timers[n].reset()
+        return msg
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPs tracking (reference utils/timer.py ThroughputTimer)."""
+
+    def __init__(self, batch_size: int, steps_per_output: int = 50, monitor_memory: bool = False):
+        self.batch_size = batch_size
+        self.steps_per_output = steps_per_output
+        self.total_samples = 0
+        self.total_time = 0.0
+        self._start = None
+        self.step_count = 0
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+
+    def stop(self, sync_obj: Any = None, report_speed: bool = True) -> None:
+        if self._start is None:
+            return
+        if sync_obj is not None:
+            import jax
+
+            jax.block_until_ready(sync_obj)
+        dt = time.perf_counter() - self._start
+        self._start = None
+        self.step_count += 1
+        self.total_samples += self.batch_size
+        self.total_time += dt
+        if report_speed and self.step_count % self.steps_per_output == 0:
+            log_dist(
+                f"step {self.step_count}: {self.avg_samples_per_sec():.2f} samples/s, "
+                f"{dt * 1e3:.1f} ms/step"
+            )
+
+    def avg_samples_per_sec(self) -> float:
+        return self.total_samples / self.total_time if self.total_time else 0.0

@@ -1,0 +1,8 @@
+#!/bin/bash
+# Test runner: forces the virtual 8-device CPU platform and — critically —
+# skips the axon TPU claim (sitecustomize registers/claims the single TPU at
+# EVERY interpreter start when PALLAS_AXON_POOL_IPS is set; concurrent
+# claims deadlock and CPU tests don't need the chip at all).
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest "${@:-tests/}" -q

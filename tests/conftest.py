@@ -1,0 +1,43 @@
+"""Test harness configuration.
+
+The reference tests "distributed" behavior with N local ranks on one host
+(tests/unit/common.py DistributedTest — SURVEY.md §4). The TPU-native analog:
+force an 8-device virtual CPU platform so every mesh/collective/sharding path
+runs exactly as it would on an 8-chip slice, single process.
+
+Must set env vars BEFORE jax is imported anywhere.
+"""
+
+import os
+
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+from deepspeed_tpu.parallel import mesh as mesh_mod  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    mesh_mod.reset_topology()
+    yield
+    mesh_mod.reset_topology()
+
+
+@pytest.fixture
+def topo8():
+    """All 8 devices on the data axis."""
+    return mesh_mod.Topology.build_virtual({"data": 8})
+
+
+@pytest.fixture
+def topo_2d():
+    """data=4 x model=2 mesh."""
+    return mesh_mod.Topology.build_virtual({"data": 4, "model": 2})

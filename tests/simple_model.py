@@ -1,0 +1,48 @@
+"""Tiny model fixtures (parity with reference tests/unit/simple_model.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_mlp_params(rng, in_dim=8, hidden=16, out_dim=4, n_layers=2):
+    params = {}
+    dims = [in_dim] + [hidden] * (n_layers - 1) + [out_dim]
+    for i in range(len(dims) - 1):
+        rng, k = jax.random.split(rng)
+        params[f"layer_{i}"] = {
+            "w": jax.random.normal(k, (dims[i], dims[i + 1]), jnp.float32) * 0.1,
+            "b": jnp.zeros((dims[i + 1],), jnp.float32),
+        }
+    return params
+
+
+def mlp_apply(params, x):
+    n = len(params)
+    for i in range(n):
+        lyr = params[f"layer_{i}"]
+        x = x @ lyr["w"].astype(x.dtype) + lyr["b"].astype(x.dtype)
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params, batch, rng):
+    x, y = batch["x"], batch["y"]
+    pred = mlp_apply(params, x)
+    return jnp.mean((pred - y.astype(pred.dtype)) ** 2)
+
+
+def random_dataset(n=64, in_dim=8, out_dim=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": rng.normal(size=(n, in_dim)).astype(np.float32),
+        "y": rng.normal(size=(n, out_dim)).astype(np.float32),
+    }
+
+
+def make_batch(n, in_dim=8, out_dim=4, seed=0):
+    ds = random_dataset(n, in_dim, out_dim, seed)
+    return {"x": ds["x"], "y": ds["y"]}

@@ -1,0 +1,114 @@
+"""Config system tests (parity with reference tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config import Config, ConfigError, MeshConfig
+
+
+def test_defaults():
+    cfg = Config.from_any(None)
+    assert cfg.zero.stage == 0
+    assert not cfg.fp16.enabled and not cfg.bf16.enabled
+    assert cfg.gradient_clipping == 0.0
+
+
+def test_batch_resolution_two_of_three():
+    cfg = Config.from_dict({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_config(dp_world_size=4)
+    assert cfg.gradient_accumulation_steps == 4
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_resolution_micro_gas():
+    cfg = Config.from_dict({"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 3})
+    cfg.resolve_batch_config(dp_world_size=8)
+    assert cfg.train_batch_size == 48
+
+
+def test_batch_resolution_only_train_batch():
+    cfg = Config.from_dict({"train_batch_size": 16})
+    cfg.resolve_batch_config(dp_world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_invariant_violation():
+    cfg = Config.from_dict({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 3,
+        "gradient_accumulation_steps": 2,
+    })
+    with pytest.raises(ConfigError):
+        cfg.resolve_batch_config(dp_world_size=4)
+
+
+def test_batch_none_raises():
+    cfg = Config.from_dict({})
+    with pytest.raises(ConfigError):
+        cfg.resolve_batch_config(dp_world_size=1)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ConfigError):
+        Config.from_dict({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+
+def test_zero_config_parsing():
+    cfg = Config.from_dict({
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu"},
+            "stage3_param_persistence_threshold": 100,
+        }
+    })
+    assert cfg.zero.stage == 3
+    assert cfg.zero.offload_optimizer.device == "cpu"
+    assert cfg.zero.offload_optimizer.enabled
+    assert cfg.zero.stage3_param_persistence_threshold == 100
+
+
+def test_zero_invalid_stage():
+    with pytest.raises(ConfigError):
+        Config.from_dict({"zero_optimization": {"stage": 5}})
+
+
+def test_reference_style_full_config():
+    """A realistic ds_config.json parses end-to-end."""
+    cfg = Config.from_dict({
+        "train_batch_size": 64,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 100,
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "betas": [0.9, 0.95], "weight_decay": 0.1}},
+        "scheduler": {"type": "WarmupDecayLR",
+                      "params": {"warmup_num_steps": 100, "total_num_steps": 1000, "warmup_max_lr": 3e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "reduce_bucket_size": 5e8},
+        "wall_clock_breakdown": False,
+    })
+    assert cfg.optimizer.type == "adamw"
+    assert cfg.bf16.enabled
+    assert cfg.zero.reduce_bucket_size == int(5e8)
+    import jax.numpy as jnp
+
+    assert cfg.compute_dtype == jnp.bfloat16
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 8, "fp16": {"enabled": True}}))
+    cfg = Config.from_any(str(p))
+    assert cfg.fp16.enabled and cfg.train_batch_size == 8
+
+
+def test_mesh_resolution():
+    m = MeshConfig(data=-1, model=2)
+    sizes = m.resolve(8)
+    assert sizes == {"data": 4, "seq": 1, "pipe": 1, "expert": 1, "model": 2}
+
+
+def test_mesh_resolution_invalid():
+    with pytest.raises(ConfigError):
+        MeshConfig(data=3, model=2).resolve(8)

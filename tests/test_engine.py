@@ -1,0 +1,188 @@
+"""Engine tests: end-to-end training across ZeRO stages and precisions.
+
+Parity targets: reference tests/unit/runtime/test_ds_initialize.py,
+tests/unit/runtime/zero/test_zero.py (training convergence per stage),
+tests/unit/runtime/half_precision/ (fp16/bf16 paths).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+import deepspeed_tpu as dst
+from simple_model import init_mlp_params, make_batch, mlp_loss
+
+
+def _make_engine(zero_stage=0, precision=None, gas=1, clip=0.0, mesh=None, opt="adamw"):
+    cfg = {
+        "train_batch_size": 16 * gas,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": opt, "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": zero_stage,
+                              "stage3_param_persistence_threshold": 0},
+        "gradient_clipping": clip,
+        "steps_per_print": 1000,
+    }
+    if precision == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    elif precision == "fp16":
+        cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    if mesh:
+        cfg["mesh"] = mesh
+    params = init_mlp_params(jax.random.PRNGKey(0))
+    engine, _, _, _ = dst.initialize(loss_fn=mlp_loss, params=params, config=cfg)
+    return engine
+
+
+def _loss_decreases(engine, steps=10):
+    batch = make_batch(engine.train_batch_size)
+    first = None
+    last = None
+    for i in range(steps):
+        metrics = engine.train_batch(batch)
+        loss = float(metrics["loss"])
+        if first is None:
+            first = loss
+        last = loss
+    assert last < first, f"loss did not decrease: {first} -> {last}"
+    return first, last
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_train_loss_decreases_per_stage(stage):
+    engine = _make_engine(zero_stage=stage)
+    _loss_decreases(engine)
+
+
+@pytest.mark.parametrize("stage", [0, 3])
+def test_bf16_training(stage):
+    engine = _make_engine(zero_stage=stage, precision="bf16")
+    _loss_decreases(engine)
+
+
+def test_fp16_training_with_loss_scaling():
+    engine = _make_engine(precision="fp16")
+    _loss_decreases(engine)
+    assert engine.get_loss_scale() > 0
+
+
+def test_gradient_accumulation_equivalence():
+    """gas=2 with the same total batch gives (near) identical params to gas=1."""
+    batch = make_batch(16)
+    e1 = _make_engine(gas=1)
+    e2 = _make_engine(gas=2)
+    # same data: gas=2 splits [16] -> 2 x [8]
+    e1.train_batch(batch)
+    e2.train_batch(batch)
+    p1 = jax.tree_util.tree_leaves(e1.params)
+    p2 = jax.tree_util.tree_leaves(e2.params)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_zero_stages_numerically_equivalent():
+    """Stages 0-3 are placement-only: same math, same result."""
+    batch = make_batch(16)
+    results = []
+    for stage in [0, 1, 2, 3]:
+        e = _make_engine(zero_stage=stage)
+        e.train_batch(batch)
+        results.append([np.asarray(x) for x in jax.tree_util.tree_leaves(e.params)])
+    for other in results[1:]:
+        for a, b in zip(results[0], other):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_zero3_params_sharded():
+    engine = _make_engine(zero_stage=3)
+    # at least one param leaf must actually be sharded over 'data'
+    specs = [leaf.sharding.spec for leaf in jax.tree_util.tree_leaves(engine.params)]
+    assert any(spec != PartitionSpec() and "data" in str(spec) for spec in specs), specs
+
+
+def test_zero1_opt_state_sharded_params_replicated():
+    engine = _make_engine(zero_stage=1)
+    for leaf in jax.tree_util.tree_leaves(engine.params):
+        assert leaf.sharding.is_fully_replicated
+    opt_specs = [leaf.sharding.spec for leaf in jax.tree_util.tree_leaves(engine.opt_state)
+                 if hasattr(leaf, "sharding") and leaf.ndim > 0]
+    assert any("data" in str(s) for s in opt_specs), opt_specs
+
+
+def test_micro_step_api_matches_fused():
+    """forward/backward/step compat path == fused train_batch."""
+    batch = make_batch(32)
+    fused = _make_engine(gas=2)
+    compat = _make_engine(gas=2)
+    fused.train_batch(batch)
+    # compat: two microbatches of 16
+    mb1 = {k: v[:16] for k, v in batch.items()}
+    mb2 = {k: v[16:] for k, v in batch.items()}
+    # use identical rngs: mlp_loss ignores rng so no alignment needed
+    compat.backward(mb1)
+    compat.step()
+    assert compat.global_steps == 0  # not at boundary yet
+    compat.backward(mb2)
+    compat.step()
+    assert compat.global_steps == 1
+    for a, b in zip(jax.tree_util.tree_leaves(fused.params), jax.tree_util.tree_leaves(compat.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_clipping_applied():
+    engine = _make_engine(clip=1e-4)
+    batch = make_batch(16)
+    m = engine.train_batch(batch)
+    assert float(m["grad_norm"]) >= 0
+
+
+def test_eval_batch():
+    engine = _make_engine()
+    loss = engine.eval_batch(make_batch(16))
+    assert np.isfinite(float(loss))
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    engine = _make_engine(zero_stage=2)
+    batch = make_batch(16)
+    for _ in range(3):
+        engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path), tag="ckpt1")
+    ref = [np.asarray(x) for x in jax.tree_util.tree_leaves(engine.params)]
+
+    fresh = _make_engine(zero_stage=2)
+    client = fresh.load_checkpoint(str(tmp_path))
+    assert fresh.global_steps == 3
+    for a, b in zip(ref, jax.tree_util.tree_leaves(fresh.params)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=0, atol=0)
+    # training continues from the restored state
+    fresh.train_batch(batch)
+    assert fresh.global_steps == 4
+
+
+def test_checkpoint_cross_stage_reload(tmp_path):
+    """Universal-checkpoint property: save under stage 3, reload under stage 0."""
+    e3 = _make_engine(zero_stage=3)
+    batch = make_batch(16)
+    e3.train_batch(batch)
+    e3.save_checkpoint(str(tmp_path), tag="x")
+    e0 = _make_engine(zero_stage=0)
+    e0.load_checkpoint(str(tmp_path))
+    for a, b in zip(jax.tree_util.tree_leaves(e3.params), jax.tree_util.tree_leaves(e0.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0)
+
+
+def test_save_16bit_model(tmp_path):
+    engine = _make_engine()
+    path = engine.save_16bit_model(str(tmp_path))
+    data = np.load(path)
+    assert len(data.files) > 0
+
+
+def test_tp_mesh_training():
+    """data=4 x model=2 mesh trains (TP specs default to replicated here)."""
+    engine = _make_engine(mesh={"data": 4, "model": 2})
+    assert engine.topo.model_parallel_size == 2
+    _loss_decreases(engine)

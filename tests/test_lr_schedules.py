@@ -1,0 +1,63 @@
+"""LR schedule tests (parity with reference tests/unit/runtime/test_lr_schedulers.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime import lr_schedules as lrs
+
+
+def test_warmup_lr_endpoints():
+    s = lrs.warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=100, warmup_type="linear")
+    assert float(s(0)) == pytest.approx(0.0)
+    assert float(s(100)) == pytest.approx(0.1)
+    assert float(s(1000)) == pytest.approx(0.1)  # holds after warmup
+
+
+def test_warmup_decay():
+    s = lrs.warmup_decay_lr(total_num_steps=1000, warmup_max_lr=0.1, warmup_num_steps=100,
+                            warmup_type="linear")
+    assert float(s(50)) < 0.1
+    assert float(s(100)) == pytest.approx(0.1, rel=1e-3)
+    assert float(s(1000)) == pytest.approx(0.0, abs=1e-6)
+    assert float(s(550)) == pytest.approx(0.05, rel=1e-2)
+
+
+def test_warmup_cosine():
+    s = lrs.warmup_cosine_lr(total_num_steps=1000, warmup_num_steps=100, warmup_max_lr=0.1)
+    mid, end = float(s(550)), float(s(1000))
+    assert 0 < end < mid < 0.1 + 1e-9
+
+
+def test_one_cycle():
+    s = lrs.one_cycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=100)
+    assert float(s(0)) == pytest.approx(0.01)
+    assert float(s(100)) == pytest.approx(0.1)
+    assert float(s(200)) == pytest.approx(0.01, rel=1e-3)
+
+
+def test_lr_range_test():
+    s = lrs.lr_range_test(lr_range_test_min_lr=0.001, lr_range_test_step_size=10,
+                          lr_range_test_step_rate=1.0)
+    assert float(s(0)) == pytest.approx(0.001)
+    assert float(s(100)) > float(s(10))
+
+
+def test_build_registry_reference_names():
+    for name in ["WarmupLR", "WarmupDecayLR", "WarmupCosineLR", "OneCycle", "LRRangeTest"]:
+        params = {"total_num_steps": 100} if "Decay" in name or "Cosine" in name else \
+            {"cycle_min_lr": 0.01, "cycle_max_lr": 0.1} if name == "OneCycle" else {}
+        s = lrs.build_schedule(name, params)
+        assert np.isfinite(float(s(5)))
+
+
+def test_build_unknown_raises():
+    with pytest.raises(ValueError):
+        lrs.build_schedule("NoSuchSched")
+
+
+def test_jit_compatible():
+    import jax
+
+    s = lrs.warmup_decay_lr(total_num_steps=100, warmup_num_steps=10)
+    f = jax.jit(lambda step: s(step))
+    assert np.isfinite(float(f(5)))

@@ -1,0 +1,42 @@
+"""Topology/mesh tests (parity with reference groups.py behaviors)."""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.parallel.mesh import MESH_AXES, Topology
+
+
+def test_default_all_data(topo8):
+    assert topo8.data_parallel_size == 8
+    assert topo8.world_size == 8
+    assert topo8.model_parallel_size == 1
+
+
+def test_2d_mesh(topo_2d):
+    assert topo_2d.data_parallel_size == 4
+    assert topo_2d.model_parallel_size == 2
+    assert topo_2d.world_size == 8
+
+
+def test_zero_axes_data_only(topo8):
+    assert topo8.zero_partition_axes() == ("data",)
+
+
+def test_zero_axes_with_seq():
+    topo = Topology.build_virtual({"data": 2, "seq": 4})
+    assert set(topo.zero_partition_axes()) == {"data", "seq"}
+    assert topo.sequence_data_parallel_size == 8
+
+
+def test_batch_sharding_places_data(topo8):
+    x = np.ones((16, 4), np.float32)
+    arr = jax.device_put(x, topo8.batch_sharding(2))
+    assert arr.sharding.spec == PartitionSpec("data", None)
+    # each device holds 1/8 of the batch
+    assert arr.addressable_shards[0].data.shape == (2, 4)
+
+
+def test_axis_order_model_innermost():
+    assert MESH_AXES[-1] == "model"
+    assert MESH_AXES[0] == "data"

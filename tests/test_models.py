@@ -1,0 +1,135 @@
+"""Model tests: forward shape/dtype, loss, causality, TP sharding, engine e2e."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.models import GPT2, Llama, Transformer, TransformerConfig
+
+
+def tiny_llama():
+    return Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=2,
+                 vocab_size=128, max_seq_len=64, use_flash=False, remat=False)
+
+
+def tiny_gpt2():
+    return GPT2("tiny", n_layers=2, d_model=64, n_heads=4, vocab_size=128,
+                max_seq_len=64, use_flash=False, remat=False)
+
+
+def _batch(model, b=2, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    v = model.config.vocab_size
+    return {"input_ids": rng.integers(0, v, size=(b, s)).astype(np.int32)}
+
+
+@pytest.mark.parametrize("factory", [tiny_llama, tiny_gpt2])
+def test_forward_shapes(factory):
+    model = factory()
+    params = model.init(jax.random.PRNGKey(0))
+    batch = _batch(model)
+    logits = model.apply(params, batch["input_ids"])
+    assert logits.shape == (2, 16, model.config.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_loss_finite_and_near_uniform_at_init():
+    model = tiny_llama()
+    params = model.init(jax.random.PRNGKey(0))
+    loss = float(model.loss(params, _batch(model)))
+    # random init ≈ uniform over vocab
+    assert abs(loss - np.log(model.config.vocab_size)) < 1.5
+
+
+def test_causality():
+    """Changing a future token must not affect earlier logits."""
+    model = tiny_llama()
+    params = model.init(jax.random.PRNGKey(0))
+    batch = _batch(model)["input_ids"]
+    logits1 = model.apply(params, batch)
+    batch2 = np.array(batch)
+    batch2[:, -1] = (batch2[:, -1] + 1) % model.config.vocab_size
+    logits2 = model.apply(params, jnp.asarray(batch2))
+    np.testing.assert_allclose(np.asarray(logits1[:, :-1]), np.asarray(logits2[:, :-1]),
+                               rtol=1e-4, atol=1e-4)
+    assert not np.allclose(np.asarray(logits1[:, -1]), np.asarray(logits2[:, -1]))
+
+
+def test_gqa_heads():
+    model = tiny_llama()  # n_kv_heads=2 < n_heads=4
+    params = model.init(jax.random.PRNGKey(0))
+    assert params["layers"]["wk"].shape[-1] == 2 * model.config.head_dim
+    logits = model.apply(params, _batch(model)["input_ids"])
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_loss_mask():
+    model = tiny_llama()
+    params = model.init(jax.random.PRNGKey(0))
+    b = _batch(model)
+    full = float(model.loss(params, b))
+    masked = dict(b, loss_mask=np.zeros((2, 16), np.float32))
+    assert float(model.loss(params, masked)) == 0.0
+    assert full != 0.0
+
+
+def test_remat_same_result():
+    cfg = dict(n_layers=2, d_model=64, n_heads=4, n_kv_heads=2, vocab_size=128,
+               max_seq_len=64, use_flash=False)
+    m1 = Llama("tiny", remat=False, **cfg)
+    m2 = Llama("tiny", remat=True, **cfg)
+    params = m1.init(jax.random.PRNGKey(0))
+    b = _batch(m1)
+    np.testing.assert_allclose(float(m1.loss(params, b)), float(m2.loss(params, b)), rtol=1e-5)
+
+
+def test_param_count_matches():
+    model = tiny_llama()
+    params = model.init(jax.random.PRNGKey(0))
+    actual = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+    assert actual == model.config.param_count()
+
+
+def test_partition_specs_cover_params():
+    model = tiny_llama()
+    params = model.init(jax.random.PRNGKey(0))
+    specs = model.partition_specs(params)
+    jax.tree_util.tree_map(lambda p, s: None, params, specs)  # structure match
+
+
+def test_tp_training_e2e():
+    """Llama trains on a data=2 x model=4 mesh with real TP sharding."""
+    model = tiny_llama()
+    cfg = {
+        "train_batch_size": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+        "mesh": {"data": 2, "model": 4},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = dst.initialize(model=model, config=cfg, rng=jax.random.PRNGKey(0))
+    # verify a TP leaf is actually sharded over 'model'
+    wq = engine.params["layers"]["wq"]
+    assert "model" in str(wq.sharding.spec)
+    batch = _batch(model, b=4)
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_tp_matches_single_device_math():
+    """TP-sharded forward == replicated forward."""
+    model = tiny_llama()
+    params = model.init(jax.random.PRNGKey(0))
+    batch = _batch(model)["input_ids"]
+    ref = model.apply(params, batch)
+
+    topo = dst.Topology.build_virtual({"data": 1, "model": 8})
+    from jax.sharding import NamedSharding
+
+    specs = model.partition_specs(params)
+    sharded = jax.device_put(params, jax.tree_util.tree_map(
+        lambda s: NamedSharding(topo.mesh, s), specs,
+        is_leaf=lambda x: not isinstance(x, dict)))
+    out = jax.jit(model.apply)(sharded, batch)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4)
