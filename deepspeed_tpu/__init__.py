@@ -59,6 +59,8 @@ def initialize(*,
         topology = Topology.build(cfg.mesh)
     set_topology(topology)
     init_distributed()
+    if model is not None and hasattr(model, "bind_topology"):
+        model.bind_topology(topology)
 
     if loss_fn is None:
         if model is None or not hasattr(model, "loss"):

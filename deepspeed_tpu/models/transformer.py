@@ -58,6 +58,9 @@ class TransformerConfig:
     use_flash: bool = True
     logits_softcap: float = 0.0
     z_loss: float = 0.0
+    # sequence-parallel attention when the mesh's seq axis > 1:
+    # "auto" = ulysses when n_heads divides the seq axis, else ring
+    sp_attention: str = "auto"        # auto | ulysses | ring
 
     def __post_init__(self):
         if self.n_kv_heads is None:
@@ -73,14 +76,27 @@ class TransformerConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
-    def param_count(self) -> int:
-        d, f, v, n = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+    def _shared_param_count(self) -> int:
+        """Attention + norms + embeddings (everything but the FFN)."""
+        d, v, n = self.d_model, self.vocab_size, self.n_layers
         hd = self.head_dim
         attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd + self.n_heads * hd * d
-        mlp = (3 if self.activation == "silu_glu" else 2) * d * f
+        if self.use_bias:
+            attn += self.n_heads * hd + 2 * self.n_kv_heads * hd + d
         norms = (2 * d) * n + d
+        if self.norm == "layer":
+            norms *= 2  # weights + biases
         emb = v * d * (1 if self.tie_embeddings else 2)
-        return n * (attn + mlp) + norms + emb
+        if self.position == "learned":
+            emb += self.max_seq_len * d
+        return n * attn + norms + emb
+
+    def param_count(self) -> int:
+        d, f, n = self.d_model, self.d_ff, self.n_layers
+        mlp = (3 if self.activation == "silu_glu" else 2) * d * f
+        if self.use_bias:
+            mlp += f + d
+        return self._shared_param_count() + n * mlp
 
     def flops_per_token(self, seq_len: int) -> float:
         """Forward+backward FLOPs/token (standard 6N + attention term)."""
@@ -93,6 +109,21 @@ class Transformer:
 
     def __init__(self, config: TransformerConfig):
         self.config = config
+        self._mesh = None
+        self._seq_size = 1
+
+    def bind_topology(self, topo) -> "Transformer":
+        """Attach the device mesh; activates Ulysses/ring sequence-parallel
+        attention when the topology's seq axis > 1 (called by
+        ``deepspeed_tpu.initialize``)."""
+        self._mesh = topo.mesh
+        self._seq_size = topo.sequence_parallel_size
+        if self._seq_size > 1:
+            impl = self.config.sp_attention
+            if impl == "auto":
+                impl = "ulysses" if self.config.n_heads % self._seq_size == 0 else "ring"
+            self._sp_impl = impl
+        return self
 
     # ------------------------------------------------------------------
     def init(self, rng, dtype=jnp.float32) -> Dict[str, Any]:
@@ -129,7 +160,7 @@ class Transformer:
             layers["b_down"] = jnp.zeros((n, c.d_model), dtype)
 
         params: Dict[str, Any] = {
-            "tok_embed": dense(next(k), (c.vocab_size, c.d_model), scale=1.0),
+            "tok_embed": dense(next(k), (c.vocab_size, c.d_model), scale=0.02),
             "layers": layers,
             "final_norm_w": jnp.ones((c.d_model,), dtype),
         }
@@ -147,8 +178,18 @@ class Transformer:
             return rms_norm(x, w, self.config.norm_eps)
         return layer_norm(x, w, b, self.config.norm_eps)
 
-    def _block(self, x, lp, angles, positions, kv_cache=None):
-        """One transformer block. x: [b, s, d]. Returns (x, new_kv)."""
+    def _sp_attention(self, q, k, v):
+        """Sequence-parallel attention over the bound mesh's seq axis."""
+        if self._sp_impl == "ring":
+            from ..parallel.ring import ring_attention_sharded
+
+            return ring_attention_sharded(q, k, v, self._mesh, causal=True)
+        from ..parallel.ulysses import DistributedAttention
+
+        return DistributedAttention(dot_product_attention, self._mesh)(q, k, v, causal=True)
+
+    def _block(self, x, lp, angles, positions, kv_cache=None, rng=None, training=False):
+        """One transformer block. x: [b, s, d]. Returns (x, new_kv, aux)."""
         c = self.config
         hd = c.head_dim
         b, s, _ = x.shape
@@ -173,8 +214,10 @@ class Transformer:
             cv = jax.lax.dynamic_update_slice_in_dim(cv, vv, cache_pos, axis=1)
             new_kv = (ck, cv)
             valid = jnp.arange(ck.shape[1])[None, :] < (cache_pos + s)
-            mask = valid[None, None, :, :] if False else valid[None, None, None, :]
+            mask = valid[None, None, None, :]
             attn = dot_product_attention(q, ck, cv, causal=(s > 1), mask=mask)
+        elif self._seq_size > 1:
+            attn = self._sp_attention(q, kk, vv)
         elif c.use_flash:
             attn = flash_attention(q, kk, vv, causal=True)
         else:
@@ -186,6 +229,12 @@ class Transformer:
         x = x + attn
 
         h = self._norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
+        down, aux = self._mlp(h, lp, rng, training)
+        return x + down, new_kv, aux
+
+    def _mlp(self, h, lp, rng=None, training=False):
+        """Dense FFN. Subclasses (MoE) override; returns (out, aux_loss)."""
+        c = self.config
         if c.activation == "silu_glu":
             up = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
         else:
@@ -196,13 +245,16 @@ class Transformer:
         down = up @ lp["w_down"]
         if c.use_bias:
             down = down + lp["b_down"]
-        return x + down, new_kv
+        return down, jnp.zeros((), jnp.float32)
 
-    def apply(self, params, tokens, positions=None, kv_caches=None, cache_pos=None):
+    def apply(self, params, tokens, positions=None, kv_caches=None, cache_pos=None,
+              rng=None, training=False, return_aux=False):
         """Forward. tokens: [b, s] int32 -> logits [b, s, vocab] (fp32).
 
         ``kv_caches``: optional stacked (k,v) cache [n_layers, b, max_s, hkv, hd]
         pair for decode; returns (logits, new_caches) then.
+        ``return_aux``: also return the summed auxiliary loss (MoE load
+        balancing) accumulated across layers.
         """
         c = self.config
         x = params["tok_embed"][tokens]  # [b, s, d]
@@ -218,23 +270,31 @@ class Transformer:
         angles = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta) \
             if c.position == "rope" else None
 
-        block = self._block
-        if c.remat and kv_caches is None:
-            block = jax.checkpoint(block, static_argnums=())
-
+        aux_total = jnp.zeros((), jnp.float32)
         if kv_caches is None:
-            def scan_fn(carry, lp):
-                y, _ = block(carry, lp, angles, positions, None)
-                return y, None
+            layer_rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-            x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+            def block(x, lp, r):
+                return self._block(x, lp, angles, positions, None, r, training)
+
+            if c.remat:
+                block = jax.checkpoint(block)
+
+            def scan_fn(carry, lp):
+                y, r = carry
+                r, sub = jax.random.split(r)
+                y, _, aux = block(y, lp, sub)
+                return (y, r), aux
+
+            (x, _), auxes = jax.lax.scan(scan_fn, (x, layer_rng), params["layers"])
+            aux_total = jnp.sum(auxes)
             new_caches = None
         else:
             ks, vs = kv_caches
 
             def scan_fn(carry, layer_in):
                 lp, ck, cv = layer_in
-                y, (nk, nv) = self._block(carry, lp, angles, positions, (ck, cv, cache_pos))
+                y, (nk, nv), _aux = self._block(carry, lp, angles, positions, (ck, cv, cache_pos))
                 return y, (nk, nv)
 
             x, (nks, nvs) = jax.lax.scan(scan_fn, x, (params["layers"], ks, vs))
@@ -247,6 +307,8 @@ class Transformer:
             logits = jnp.tanh(logits / c.logits_softcap) * c.logits_softcap
         if new_caches is not None:
             return logits, new_caches
+        if return_aux:
+            return logits, aux_total
         return logits
 
     # ------------------------------------------------------------------
@@ -256,12 +318,19 @@ class Transformer:
         tokens = batch["input_ids"]
         if "labels" in batch:
             inputs, targets = tokens, batch["labels"]
+            mask = batch.get("loss_mask")
         else:
-            inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = self.apply(params, inputs)
+            # keep the full sequence length (it must stay divisible by the
+            # seq mesh axis); predict shift-left targets and mask the final
+            # position instead of slicing
+            inputs = tokens
+            targets = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+            last_off = jnp.ones_like(tokens, jnp.float32).at[:, -1].set(0.0)
+            mask = batch.get("loss_mask")
+            mask = last_off if mask is None else mask.astype(jnp.float32) * last_off
+        logits, aux = self.apply(params, inputs, rng=rng, training=True, return_aux=True)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        mask = batch.get("loss_mask")
         if mask is not None:
             mask = mask[:, : nll.shape[1]].astype(jnp.float32)
             loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
@@ -270,7 +339,7 @@ class Transformer:
         if self.config.z_loss > 0:
             z = jax.scipy.special.logsumexp(logits, axis=-1)
             loss = loss + self.config.z_loss * jnp.mean(jnp.square(z))
-        return loss
+        return loss + aux
 
     # ------------------------------------------------------------------
     def partition_specs(self, params, topo=None) -> Dict[str, Any]:

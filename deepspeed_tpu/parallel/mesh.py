@@ -72,9 +72,13 @@ class Topology:
 
     @classmethod
     def build_virtual(cls, sizes: Dict[str, int]) -> "Topology":
-        """Build a mesh with explicit axis sizes (tests / dry runs)."""
+        """Build a mesh with explicit axis sizes (tests / dry runs), using
+        only as many devices as the axes require."""
         cfg = MeshConfig(**{a: sizes.get(a, 1) for a in MESH_AXES})
-        return cls.build(cfg)
+        n = 1
+        for a in MESH_AXES:
+            n *= sizes.get(a, 1)
+        return cls.build(cfg, devices=jax.devices()[:n])
 
     # -- size / rank queries (parity with groups.py get_* helpers) ------
     def axis_size(self, axis: str) -> int:

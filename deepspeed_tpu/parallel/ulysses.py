@@ -1,0 +1,86 @@
+"""Ulysses-style sequence parallelism.
+
+Capability parity with the reference's DeepSpeed-Ulysses
+(``deepspeed/sequence/layer.py`` — ``DistributedAttention`` wrapping any
+local attention with ``_SeqAllToAll``: inputs sharded ``[s/P, b, h]`` are
+all-to-all'd to ``[s, b, h/P]`` so attention runs with full sequence but
+sharded heads, then transformed back; SURVEY.md §5.7). TPU-native form:
+the all-to-all rides the ``seq`` mesh axis via ``jax.lax.all_to_all``
+inside ``shard_map``, composing with the batch sharding the engine already
+applies ([b/data, s/seq, ...]).
+
+The reference's ``seq_parallel_communication_data_type`` knob
+(runtime/config.py:795) maps to ``comm_dtype`` below.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..ops.attention import dot_product_attention
+
+
+def _a2a(x, axis_name: str, split_axis: int, concat_axis: int):
+    """tiled all-to-all: scatter ``split_axis``, gather ``concat_axis``."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
+                      attn_fn: Optional[Callable] = None, comm_dtype=None):
+    """Head-scattering attention for seq-sharded inputs.
+
+    Call INSIDE shard_map where q/k/v are local shards [b, s/P, h, d].
+    All-to-all swaps seq-sharding for head-sharding ([b, s, h/P, d]),
+    runs full-sequence attention on the local heads, and swaps back.
+    Requires n_heads % P == 0 (same constraint as the reference,
+    sequence/layer.py head-count divisibility).
+    """
+    attn_fn = attn_fn or partial(dot_product_attention, causal=causal)
+    orig_dtype = q.dtype
+    if comm_dtype is not None:
+        q, k, v = (t.astype(comm_dtype) for t in (q, k, v))
+    # [b, s/P, h, d] -> [b, s, h/P, d]
+    q, k, v = (_a2a(t, axis_name, split_axis=2, concat_axis=1) for t in (q, k, v))
+    if comm_dtype is not None:
+        q, k, v = (t.astype(orig_dtype) for t in (q, k, v))
+    out = attn_fn(q, k, v)
+    if comm_dtype is not None:
+        out = out.astype(comm_dtype)
+    # [b, s, h/P, d] -> [b, s/P, h, d]
+    out = _a2a(out, axis_name, split_axis=1, concat_axis=2)
+    return out.astype(orig_dtype)
+
+
+class DistributedAttention:
+    """Module-level parity with the reference's
+    ``deepspeed.sequence.layer.DistributedAttention`` (layer.py:61): wraps a
+    local attention callable; __call__ takes seq-sharded global arrays and
+    runs the a2a dance under shard_map on the given mesh."""
+
+    def __init__(self, local_attention: Callable, mesh: Mesh,
+                 scatter_idx: int = 2, gather_idx: int = 1,
+                 axis_name: str = "seq", comm_dtype=None):
+        self.local_attn = local_attention
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.comm_dtype = comm_dtype
+        # scatter/gather idx kept for API parity; fixed [b, s, h, d] layout
+
+    def __call__(self, q, k, v, causal: bool = True):
+        spec = P(None, self.axis_name, None, None)  # [b, s/P, h, d]
+
+        def inner(q, k, v):
+            return ulysses_attention(
+                q, k, v, axis_name=self.axis_name, causal=causal,
+                attn_fn=partial(self.local_attn, causal=causal),
+                comm_dtype=self.comm_dtype)
+
+        return shard_map(inner, mesh=self.mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_rep=False)(q, k, v)

@@ -1,0 +1,120 @@
+"""Ulysses + ring attention tests.
+
+Parity target: the reference has no unit test for sequence/layer.py beyond
+model integration; here we verify numerics against single-device attention
+(the reference pattern for kernels: compare vs a trusted impl).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.ops.attention import dot_product_attention
+from deepspeed_tpu.parallel.mesh import Topology
+from deepspeed_tpu.parallel.ring import ring_attention_sharded
+from deepspeed_tpu.parallel.ulysses import DistributedAttention
+
+
+def _qkv(b=2, s=32, h=8, d=16, kv_h=None, seed=0):
+    rng = np.random.default_rng(seed)
+    kv_h = kv_h or h
+    q = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, s, kv_h, d)).astype(np.float32)
+    v = rng.normal(size=(b, s, kv_h, d)).astype(np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_local(causal):
+    topo = Topology.build_virtual({"seq": 8})
+    q, k, v = _qkv()
+    ref = dot_product_attention(q, k, v, causal=causal)
+    dist = DistributedAttention(dot_product_attention, topo.mesh)
+    spec = NamedSharding(topo.mesh, P(None, "seq", None, None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    out = dist(qs, ks, vs, causal=causal)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_local(causal):
+    topo = Topology.build_virtual({"seq": 8})
+    q, k, v = _qkv(s=64)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    spec = NamedSharding(topo.mesh, P(None, "seq", None, None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    out = ring_attention_sharded(qs, ks, vs, topo.mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-4, atol=1e-4)
+
+
+def test_ring_gqa():
+    topo = Topology.build_virtual({"seq": 4})
+    q, k, v = _qkv(s=32, h=8, kv_h=2)
+    ref = dot_product_attention(q, k, v, causal=True)
+    spec = NamedSharding(topo.mesh, P(None, "seq", None, None))
+    out = ring_attention_sharded(*(jax.device_put(t, spec) for t in (q, k, v)),
+                                 topo.mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["ulysses", "ring"])
+def test_model_trains_with_seq_parallel(impl):
+    """End-to-end: Transformer + engine on a data=2 x seq=4 mesh routes
+    attention through the SP implementation (reference parity: Ulysses wraps
+    model attention via DistributedAttention; ring is beyond-parity)."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.models import Llama
+    from deepspeed_tpu.runtime.dataloader import shard_batch
+
+    model = Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=4,
+                  vocab_size=128, max_seq_len=64, use_flash=False, remat=False,
+                  sp_attention=impl)
+    engine, _, _, _ = dst.initialize(model=model, config={
+        "train_batch_size": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+        "mesh": {"data": 2, "seq": 4},
+        "steps_per_print": 1000,
+    }, rng=jax.random.PRNGKey(0))
+    assert model._seq_size == 4 and model._sp_impl == impl
+    toks = np.random.default_rng(0).integers(0, 128, (4, 32)).astype(np.int32)
+    batch = shard_batch({"input_ids": toks}, engine.topo)
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+
+def test_sp_matches_dense_numerics():
+    """Seq-parallel model forward == plain forward (same params)."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.models import Llama
+
+    kw = dict(n_layers=2, d_model=64, n_heads=4, n_kv_heads=4, vocab_size=128,
+              max_seq_len=64, use_flash=False, remat=False)
+    dense = Llama("tiny", **kw)
+    params = dense.init(jax.random.PRNGKey(0))
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 32)), jnp.int32)
+    ref = dense.apply(params, toks)
+
+    sp = Llama("tiny", sp_attention="ulysses", **kw)
+    topo = Topology.build_virtual({"seq": 4})
+    sp.bind_topology(topo)
+    out = jax.jit(sp.apply)(params, toks)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4)
+
+
+def test_ring_grads_flow():
+    topo = Topology.build_virtual({"seq": 4})
+    q, k, v = _qkv(s=16, h=4, d=8)
+    spec = NamedSharding(topo.mesh, P(None, "seq", None, None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+
+    def f(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, topo.mesh) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    g = jax.grad(f)(qs, ks, vs)
+    g_ref = jax.grad(f_ref)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-3, atol=1e-3)
