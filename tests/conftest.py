@@ -17,6 +17,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
+# sitecustomize may have imported jax already (with JAX_PLATFORMS=axon baked
+# in), so the env var alone is not enough — force the config directly.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
