@@ -86,7 +86,10 @@ class MoETransformer(Transformer):
         layer_specs = dict(specs["layers"])
         for key in ("w_up", "w_down", "w_gate", "b_up", "b_down"):
             layer_specs.pop(key, None)
-        layer_specs.update(self.moe.partition_specs(n_layers=self.config.n_layers))
+        pipe_size = topo.pipe_parallel_size if topo is not None else self._pipe_size
+        layer_specs.update(self.moe.partition_specs(
+            n_layers=self.config.n_layers,
+            pipe="pipe" if pipe_size > 1 else None))
         specs["layers"] = layer_specs
         return specs
 

@@ -111,6 +111,7 @@ class Transformer:
         self.config = config
         self._mesh = None
         self._seq_size = 1
+        self._pipe_size = 1
 
     def bind_topology(self, topo) -> "Transformer":
         """Attach the device mesh; activates Ulysses/ring sequence-parallel
@@ -118,6 +119,11 @@ class Transformer:
         ``deepspeed_tpu.initialize``)."""
         self._mesh = topo.mesh
         self._seq_size = topo.sequence_parallel_size
+        self._pipe_size = topo.pipe_parallel_size
+        if self._pipe_size > 1:
+            assert self.config.n_layers % self._pipe_size == 0, (
+                f"n_layers={self.config.n_layers} not divisible by "
+                f"pipeline stages={self._pipe_size}")
         if self._seq_size > 1:
             impl = self.config.sp_attention
             if impl == "auto":
@@ -257,16 +263,7 @@ class Transformer:
         balancing) accumulated across layers.
         """
         c = self.config
-        x = params["tok_embed"][tokens]  # [b, s, d]
-        compute_dtype = params["layers"]["wq"].dtype
-        x = x.astype(compute_dtype)
-        if c.position == "learned":
-            s = tokens.shape[1]
-            if positions is None:
-                pos_emb = params["pos_embed"][:s]
-            else:
-                pos_emb = params["pos_embed"][positions]
-            x = x + pos_emb.astype(compute_dtype)
+        x = self._embed(params, tokens, positions)  # [b, s, d]
         angles = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta) \
             if c.position == "rope" else None
 
@@ -300,11 +297,7 @@ class Transformer:
             x, (nks, nvs) = jax.lax.scan(scan_fn, x, (params["layers"], ks, vs))
             new_caches = (nks, nvs)
 
-        x = self._norm(x, params["final_norm_w"], params.get("final_norm_b"))
-        w_out = params["tok_embed"].T if c.tie_embeddings else params["lm_head"]
-        logits = (x @ w_out.astype(x.dtype)).astype(jnp.float32)
-        if c.logits_softcap > 0:
-            logits = jnp.tanh(logits / c.logits_softcap) * c.logits_softcap
+        logits = self._head(params, x)
         if new_caches is not None:
             return logits, new_caches
         if return_aux:
@@ -312,33 +305,152 @@ class Transformer:
         return logits
 
     # ------------------------------------------------------------------
-    def loss(self, params, batch, rng=None):
-        """Next-token cross entropy. batch: {"input_ids": [b, s]} with
-        optional "labels" (shifted internally when absent) and "loss_mask"."""
+    def _targets_from_batch(self, batch):
+        """(inputs, targets, mask) for next-token CE. batch:
+        {"input_ids": [b, s]} with optional "labels" (shifted internally when
+        absent) and "loss_mask"."""
         tokens = batch["input_ids"]
         if "labels" in batch:
-            inputs, targets = tokens, batch["labels"]
             mask = batch.get("loss_mask")
-        else:
-            # keep the full sequence length (it must stay divisible by the
-            # seq mesh axis); predict shift-left targets and mask the final
-            # position instead of slicing
-            inputs = tokens
-            targets = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
-            last_off = jnp.ones_like(tokens, jnp.float32).at[:, -1].set(0.0)
-            mask = batch.get("loss_mask")
-            mask = last_off if mask is None else mask.astype(jnp.float32) * last_off
-        logits, aux = self.apply(params, inputs, rng=rng, training=True, return_aux=True)
+            if mask is not None:
+                mask = mask.astype(jnp.float32)
+            return tokens, batch["labels"], mask
+        # keep the full sequence length (it must stay divisible by the
+        # seq mesh axis); predict shift-left targets and mask the final
+        # position instead of slicing
+        targets = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+        last_off = jnp.ones_like(tokens, jnp.float32).at[:, -1].set(0.0)
+        mask = batch.get("loss_mask")
+        mask = last_off if mask is None else mask.astype(jnp.float32) * last_off
+        return tokens, targets, mask
+
+    def _ce_terms(self, logits, targets, mask):
+        """(weighted nll sum, weight sum, z-loss sum) for one [b, s, v]
+        logits block — fp32 accumulation."""
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         if mask is not None:
             mask = mask[:, : nll.shape[1]].astype(jnp.float32)
-            loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            nll_sum = jnp.sum(nll * mask)
+            denom = jnp.sum(mask)
         else:
-            loss = jnp.mean(nll)
+            nll_sum = jnp.sum(nll)
+            denom = jnp.asarray(float(np.prod(nll.shape)), jnp.float32)
+        z_sum = jnp.zeros([], jnp.float32)
         if self.config.z_loss > 0:
-            z = jax.scipy.special.logsumexp(logits, axis=-1)
-            loss = loss + self.config.z_loss * jnp.mean(jnp.square(z))
+            z = jnp.square(jax.scipy.special.logsumexp(logits, axis=-1))
+            if mask is not None:
+                z = z * mask
+            z_sum = jnp.sum(z)
+        return nll_sum, denom, z_sum
+
+    def loss(self, params, batch, rng=None):
+        """Next-token cross entropy (+ z-loss + MoE aux)."""
+        inputs, targets, mask = self._targets_from_batch(batch)
+        logits, aux = self.apply(params, inputs, rng=rng, training=True, return_aux=True)
+        nll_sum, denom, z_sum = self._ce_terms(logits, targets, mask)
+        loss = nll_sum / jnp.maximum(denom, 1.0)
+        if self.config.z_loss > 0:
+            loss = loss + self.config.z_loss * z_sum / jnp.maximum(denom, 1.0)
+        return loss + aux
+
+    # ------------------------------------------------------------------
+    # pipeline-parallel path (reference: runtime/pipe/engine.py train_batch)
+    def _embed(self, params, tokens, positions=None):
+        """Token (+ learned position) embedding: [b, s] -> [b, s, d] in the
+        compute dtype."""
+        c = self.config
+        x = params["tok_embed"][tokens]
+        compute_dtype = params["layers"]["wq"].dtype
+        x = x.astype(compute_dtype)
+        if c.position == "learned":
+            s = tokens.shape[-1]
+            pos_emb = params["pos_embed"][:s] if positions is None else params["pos_embed"][positions]
+            x = x + pos_emb.astype(compute_dtype)
+        return x
+
+    def _head(self, params, x):
+        """Final norm + LM head: [..., s, d] -> fp32 logits [..., s, vocab]."""
+        c = self.config
+        x = self._norm(x, params["final_norm_w"], params.get("final_norm_b"))
+        w_out = params["tok_embed"].T if c.tie_embeddings else params["lm_head"]
+        logits = (x @ w_out.astype(x.dtype)).astype(jnp.float32)
+        if c.logits_softcap > 0:
+            logits = jnp.tanh(logits / c.logits_softcap) * c.logits_softcap
+        return logits
+
+    def pipeline_loss(self, params, batch, rng, num_microbatches: int):
+        """Pipelined training loss over the whole global batch.
+
+        Splits the batch into ``num_microbatches`` (= gradient-accumulation
+        steps, as in the reference PipelineEngine where GAS is the number of
+        in-flight micro-batches), embeds, pipelines the block stack over the
+        ``pipe`` mesh axis via the rotating-microbatch executor, then runs
+        the head + CE per micro-batch under a scan (so full-batch logits are
+        never materialized at once).
+        """
+        from ..parallel.pipeline import microbatch, pipeline_apply, stack_stage_params
+
+        c = self.config
+        assert self._pipe_size > 1 and self._mesh is not None, \
+            "pipeline_loss requires a bound topology with pipe axis > 1"
+        if self._seq_size > 1:
+            raise NotImplementedError(
+                "pipe x seq parallel composition not supported yet; "
+                "use Ulysses/ring SP without the pipe axis")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        inputs, targets, mask = self._targets_from_batch(batch)
+        mb = microbatch(
+            {"inputs": inputs, "targets": targets,
+             **({"mask": mask} if mask is not None else {})},
+            num_microbatches)
+        xs = jax.vmap(lambda t: self._embed(params, t))(mb["inputs"])  # [M, b/M, s, d]
+        angles = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta) \
+            if c.position == "rope" else jnp.zeros((1, 1), jnp.float32)
+        stage_params = stack_stage_params(params["layers"], self._pipe_size)
+
+        # fp32 at the pipe boundary: inter-stage transfers and the
+        # replicated-input cotangent reductions shard_map's autodiff inserts
+        # accumulate in fp32 (sub-fp32 psum also miscompiles on XLA:CPU);
+        # block compute stays in the params' compute dtype.
+        compute_dtype = params["layers"]["wq"].dtype
+        xs = xs.astype(jnp.float32)
+
+        def stage_fn(lp_stage, x, consts, sub_rng, valid):
+            x = x.astype(compute_dtype)
+
+            def body(carry, lp):
+                y, r = carry
+                r, sub = jax.random.split(r)
+                y, _, aux = self._block(y, lp, consts["angles"], None, None, sub, True)
+                return (y, r), aux
+
+            (y, _), auxes = jax.lax.scan(body, (x, sub_rng), lp_stage)
+            return y.astype(jnp.float32), jnp.sum(auxes)
+
+        ys, aux = pipeline_apply(
+            stage_fn, stage_params, xs, rng, self._mesh,
+            consts={"angles": angles}, remat=c.remat)
+
+        # head + CE per micro-batch, scanned to bound logits memory
+        def head_ce(carry, mb_t):
+            logits = self._head(params, mb_t["x"].astype(compute_dtype))
+            nll_sum, denom, z_sum = self._ce_terms(
+                logits, mb_t["targets"], mb_t.get("mask"))
+            nll_acc, den_acc, z_acc = carry
+            return (nll_acc + nll_sum, den_acc + denom, z_acc + z_sum), None
+
+        head_ce = jax.checkpoint(head_ce)
+        zeros = (jnp.zeros([], jnp.float32),) * 3
+        scan_in = {"x": ys, "targets": mb["targets"]}
+        if mask is not None:
+            scan_in["mask"] = mb["mask"]
+        (nll_sum, denom, z_sum), _ = jax.lax.scan(head_ce, zeros, scan_in)
+        loss = nll_sum / jnp.maximum(denom, 1.0)
+        if c.z_loss > 0:
+            loss = loss + c.z_loss * z_sum / jnp.maximum(denom, 1.0)
         return loss + aux
 
     # ------------------------------------------------------------------
@@ -352,25 +464,30 @@ class Transformer:
         inference as AutoTP (module_inject/auto_tp.py) — here it is native.
         """
         c = self.config
+        # pipeline parallelism: the stacked-layer leading dim is sharded over
+        # 'pipe' so each stage group holds only its layers (reference:
+        # PipelineModule assigns layer ranges to stage ranks, module.py:86)
+        pipe_size = topo.pipe_parallel_size if topo is not None else self._pipe_size
+        pipe = "pipe" if pipe_size > 1 else None
         layer_specs = {
-            "attn_norm_w": P(None, None),
-            "wq": P(None, None, "model"),
-            "wk": P(None, None, "model"),
-            "wv": P(None, None, "model"),
-            "wo": P(None, "model", None),
-            "mlp_norm_w": P(None, None),
-            "w_up": P(None, None, "model"),
-            "w_down": P(None, "model", None),
+            "attn_norm_w": P(pipe, None),
+            "wq": P(pipe, None, "model"),
+            "wk": P(pipe, None, "model"),
+            "wv": P(pipe, None, "model"),
+            "wo": P(pipe, "model", None),
+            "mlp_norm_w": P(pipe, None),
+            "w_up": P(pipe, None, "model"),
+            "w_down": P(pipe, "model", None),
         }
         if c.activation == "silu_glu":
-            layer_specs["w_gate"] = P(None, None, "model")
+            layer_specs["w_gate"] = P(pipe, None, "model")
         if c.norm == "layer":
-            layer_specs["attn_norm_b"] = P(None, None)
-            layer_specs["mlp_norm_b"] = P(None, None)
+            layer_specs["attn_norm_b"] = P(pipe, None)
+            layer_specs["mlp_norm_b"] = P(pipe, None)
         if c.use_bias:
             layer_specs.update({
-                "bq": P(None, "model"), "bk": P(None, "model"), "bv": P(None, "model"),
-                "bo": P(None, None), "b_up": P(None, "model"), "b_down": P(None, None),
+                "bq": P(pipe, "model"), "bk": P(pipe, "model"), "bv": P(pipe, "model"),
+                "bo": P(pipe, None), "b_up": P(pipe, "model"), "b_down": P(pipe, None),
             })
         specs: Dict[str, Any] = {
             "tok_embed": P("model", None),

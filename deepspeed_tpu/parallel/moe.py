@@ -178,10 +178,14 @@ class MoELayer:
         out = jnp.einsum("bsec,ebcd->bsd", combine.astype(x.dtype), expert_out)
         return out, aux
 
-    def partition_specs(self, n_layers: Optional[int] = None):
+    def partition_specs(self, n_layers: Optional[int] = None,
+                        pipe: Optional[str] = None):
+        """``pipe``: mesh axis name to shard the stacked-layer leading dim
+        over (pipeline stages own their layers' expert banks, matching the
+        dense-param placement in Transformer.partition_specs)."""
         from jax.sharding import PartitionSpec as P
 
-        lead = (None,) if n_layers else ()
+        lead = (pipe,) if n_layers else ()
         specs = {
             "wg": P(*lead, None, None),
             "w_up": P(*lead, "expert", None, "model"),

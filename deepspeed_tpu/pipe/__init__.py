@@ -1,0 +1,34 @@
+"""Pipeline parallelism: layer specs, partitioning, instruction schedules,
+and the compiled SPMD executor (parallel/pipeline.py).
+
+Parity surface with the reference's ``deepspeed/pipe`` + ``runtime/pipe``
+(PipelineModule, LayerSpec, TiedLayerSpec re-exported at deepspeed/pipe/
+__init__.py; schedules in runtime/pipe/schedule.py).
+"""
+
+from .module import LayerSpec, PipelineModule, TiedLayerSpec, partition_balanced
+from .schedule import (
+    BackwardPass,
+    ForwardPass,
+    InferenceSchedule,
+    LoadMicroBatch,
+    OptimizerStep,
+    PipeInstruction,
+    PipeSchedule,
+    RecvActivation,
+    RecvGrad,
+    ReduceGrads,
+    ReduceTiedGrads,
+    SendActivation,
+    SendGrad,
+    TrainSchedule,
+    bubble_fraction,
+)
+
+__all__ = [
+    "LayerSpec", "TiedLayerSpec", "PipelineModule", "partition_balanced",
+    "PipeSchedule", "TrainSchedule", "InferenceSchedule", "PipeInstruction",
+    "ForwardPass", "BackwardPass", "SendActivation", "RecvActivation",
+    "SendGrad", "RecvGrad", "LoadMicroBatch", "ReduceGrads",
+    "ReduceTiedGrads", "OptimizerStep", "bubble_fraction",
+]

@@ -1,0 +1,213 @@
+"""Pipeline module specification: layer lists, partitioning, tied weights.
+
+Parity with the reference's ``runtime/pipe/module.py`` (``LayerSpec`` :86's
+deferred construction, ``TiedLayerSpec`` :77, ``PipelineModule`` partition
+methods uniform/parameters/type:regex) — re-designed for functional JAX:
+
+* a layer is anything with ``init(rng) -> params`` and
+  ``apply(params, x) -> x`` (or a parameterless callable ``x -> x``);
+* tied layers *share one params entry* — in JAX tying is aliasing in the
+  pytree, and the gradient summation the reference implements as
+  ``ReduceTiedGrads`` (pipe/engine.py:253) falls out of autodiff when both
+  uses reference the same leaf;
+* partitioning returns stage boundaries; execution is either the compiled
+  rotating-microbatch pipeline (``parallel/pipeline.py``) when every stage
+  is structurally identical (the transformer fast path), or a sequential
+  composition under GSPMD with per-stage sharding hints otherwise.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class LayerSpec:
+    """Deferred layer construction (reference module.py:86). Holds the
+    class/factory and args; ``build()`` instantiates."""
+
+    def __init__(self, typename: Callable, *args, **kwargs):
+        self.typename = typename
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.args, **self.kwargs)
+
+    @property
+    def name(self) -> str:
+        return getattr(self.typename, "__name__", str(self.typename))
+
+    def __repr__(self):
+        return f"LayerSpec({self.name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """LayerSpec whose parameters are shared across every layer built with
+    the same ``key`` (reference module.py:77 — embedding/LM-head tying)."""
+
+    def __init__(self, key: str, typename: Callable, *args,
+                 forward_fn: Optional[Callable] = None, **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+    def __repr__(self):
+        return f"TiedLayerSpec({self.key}, {self.name})"
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Contiguous partition of ``weights`` into ``num_parts`` minimizing the
+    max part weight (reference deepspeed/runtime/utils.py partition_balanced,
+    used by PipelineModule._partition_layers). Returns ``num_parts + 1``
+    boundary indices."""
+    n = len(weights)
+    assert num_parts <= n, f"cannot split {n} layers into {num_parts} stages"
+    prefix = np.concatenate([[0.0], np.cumsum(np.asarray(weights, np.float64))])
+
+    def parts_needed(cap: float) -> Optional[List[int]]:
+        bounds = [0]
+        start = 0
+        for _ in range(num_parts):
+            # furthest end with sum(weights[start:end]) <= cap
+            end = int(np.searchsorted(prefix, prefix[start] + cap, side="right") - 1)
+            end = max(end, start + 1)  # always advance
+            end = min(end, n)
+            bounds.append(end)
+            start = end
+            if end == n:
+                break
+        if bounds[-1] < n:
+            return None
+        while len(bounds) < num_parts + 1:
+            bounds.append(n)
+        return bounds
+
+    lo, hi = float(np.max(weights)) if n else 0.0, float(prefix[-1])
+    best = parts_needed(hi)
+    for _ in range(50):
+        mid = (lo + hi) / 2
+        got = parts_needed(mid)
+        if got is not None:
+            best, hi = got, mid
+        else:
+            lo = mid
+    assert best is not None
+    return best
+
+
+def _is_layer_obj(layer: Any) -> bool:
+    return hasattr(layer, "init") and hasattr(layer, "apply")
+
+
+class PipelineModule:
+    """Partition a layer list across pipeline stages
+    (reference module.py:86 PipelineModule).
+
+    ``partition_method``: ``"uniform"`` (equal layer counts),
+    ``"parameters"`` (balance by parameter count), or ``"type:<regex>"``
+    (stage boundaries at layers whose name matches).
+    """
+
+    def __init__(self, layers: Sequence[Any], num_stages: int,
+                 partition_method: str = "parameters",
+                 loss_fn: Optional[Callable] = None):
+        self.specs = list(layers)
+        self.num_stages = num_stages
+        self.partition_method = partition_method
+        self.loss_fn = loss_fn
+        self._built = [s.build() if isinstance(s, LayerSpec) else s for s in self.specs]
+        self.parts = self._partition_layers()
+
+    # -- partitioning ---------------------------------------------------
+    def _layer_param_counts(self) -> List[float]:
+        counts = []
+        for layer in self._built:
+            if _is_layer_obj(layer):
+                shapes = jax.eval_shape(lambda l=layer: l.init(jax.random.PRNGKey(0)))
+                counts.append(float(sum(int(np.prod(s.shape))
+                                        for s in jax.tree_util.tree_leaves(shapes))))
+            else:
+                counts.append(0.0)
+        return counts
+
+    def _partition_layers(self) -> List[int]:
+        method = self.partition_method.lower()
+        n = len(self.specs)
+        if method == "uniform":
+            return partition_balanced([1.0] * n, self.num_stages)
+        if method == "parameters":
+            counts = self._layer_param_counts()
+            if sum(counts) == 0:
+                counts = [1.0] * n
+            return partition_balanced(counts, self.num_stages)
+        if method.startswith("type:"):
+            pattern = method.split(":", 1)[1]
+            weights = []
+            for spec in self.specs:
+                name = spec.name if isinstance(spec, LayerSpec) else type(spec).__name__
+                weights.append(1.0 if re.search(pattern, name, re.IGNORECASE) else 0.0)
+            if sum(weights) == 0:
+                raise ValueError(f"no layer matches partition regex {pattern!r}")
+            return partition_balanced(weights, self.num_stages)
+        raise ValueError(f"unknown partition_method {self.partition_method!r}")
+
+    def stage_layers(self, stage_id: int) -> List[Any]:
+        return self._built[self.parts[stage_id]:self.parts[stage_id + 1]]
+
+    def stage_of_layer(self, layer_idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    # -- params ---------------------------------------------------------
+    def init(self, rng) -> Dict[str, Any]:
+        """Build the parameter pytree: one entry per layer, tied layers
+        collapsing onto a shared ``tied/<key>`` entry."""
+        params: Dict[str, Any] = {"layers": {}, "tied": {}}
+        keys = jax.random.split(rng, max(len(self._built), 1))
+        for i, (spec, layer) in enumerate(zip(self.specs, self._built)):
+            if not _is_layer_obj(layer):
+                continue
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in params["tied"]:
+                    params["tied"][spec.key] = layer.init(keys[i])
+            else:
+                params["layers"][str(i)] = layer.init(keys[i])
+        return params
+
+    # -- execution ------------------------------------------------------
+    def apply(self, params: Dict[str, Any], x: Any, **kwargs) -> Any:
+        """Sequential forward through all layers. Under GSPMD with the
+        ``pipe``-axis placement from :meth:`partition_specs` this is the
+        correctness path; the homogeneous-stage fast path goes through
+        ``parallel/pipeline.py`` (see models/transformer.py)."""
+        for i, (spec, layer) in enumerate(zip(self.specs, self._built)):
+            if _is_layer_obj(layer):
+                if isinstance(spec, TiedLayerSpec):
+                    p = params["tied"][spec.key]
+                    fwd = spec.forward_fn or (lambda l, pp, xx: l.apply(pp, xx))
+                    x = fwd(layer, p, x)
+                else:
+                    x = layer.apply(params["layers"][str(i)], x)
+            else:
+                x = layer(x)
+        return x
+
+    def loss(self, params: Dict[str, Any], batch: Any, rng=None) -> jnp.ndarray:
+        assert self.loss_fn is not None, "PipelineModule needs loss_fn for training"
+        out = self.apply(params, batch["input"] if isinstance(batch, dict) else batch)
+        target = batch["target"] if isinstance(batch, dict) else None
+        return self.loss_fn(out, target)
+
+    def __len__(self):
+        return len(self.specs)
+
+    def __repr__(self):
+        return (f"PipelineModule({len(self.specs)} layers, "
+                f"{self.num_stages} stages, parts={self.parts})")

@@ -1,0 +1,189 @@
+"""Pipeline instruction schedules.
+
+Parity with the reference's ``runtime/pipe/schedule.py`` (TrainSchedule 1F1B
+:189, InferenceSchedule :135, instruction set :327-:475). On TPU the
+schedule is not interpreted at runtime — the compiled rotating-microbatch
+program in ``parallel/pipeline.py`` realizes the same dependency structure —
+but the explicit instruction list remains the specification of that
+structure: tests assert the compiled executor's tick/stage mapping agrees
+with these schedules, and tooling (trace viewers, the autotuner's bubble
+model) consumes them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List
+
+
+@dataclass(frozen=True)
+class PipeInstruction:
+    """Base instruction (reference schedule.py:327)."""
+    micro_batch: int = -1
+
+    def __repr__(self):
+        mb = f"(mb={self.micro_batch})" if self.micro_batch >= 0 else ""
+        return f"{type(self).__name__}{mb}"
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass
+
+
+class ForwardPass(PipeInstruction):
+    pass
+
+
+class BackwardPass(PipeInstruction):
+    pass
+
+
+class SendActivation(PipeInstruction):
+    pass
+
+
+class RecvActivation(PipeInstruction):
+    pass
+
+
+class SendGrad(PipeInstruction):
+    pass
+
+
+class RecvGrad(PipeInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Yields lists of instructions per clock step for one stage
+    (reference schedule.py:11 PipeSchedule ABC)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def num_pipe_buffers(self) -> int:
+        raise NotImplementedError
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return self.steps()
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill/drain (reference schedule.py:135)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for t in range(total):
+            cmds: List[PipeInstruction] = []
+            mb = t - self.stage_id
+            if 0 <= mb < self.micro_batches:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(mb))
+                else:
+                    cmds.append(RecvActivation(mb))
+                cmds.append(ForwardPass(mb))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(mb))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B interleave (reference schedule.py:189).
+
+    Each stage runs forwards ahead of backwards by at most
+    ``stages - stage_id`` micro-batches, bounding live activations to
+    ``num_pipe_buffers`` instead of M (the whole point of 1F1B). Total
+    wall-clock steps: ``2 * (micro_batches + stages - 1)``.
+    """
+
+    def num_pipe_buffers(self) -> int:
+        # reference schedule.py:248: min(stages - stage_id + 1, micro_batches)
+        buffers = min(self.stages - self.stage_id + 1, self.micro_batches)
+        return max(2, buffers)
+
+    def _step_to_micro_batch(self, step_id: int):
+        """Map a clock step to (micro_batch, is_forward) for this stage.
+        Even steps forward, odd steps backward, offset so stage s starts its
+        first forward at step s and its first backward after the pipeline
+        fills (mirrors reference schedule.py:257-:280)."""
+        if _is_even(step_id) and _is_even(self.stage_id):
+            mb = step_id // 2 - self.stage_id // 2
+            return mb, True
+        if _is_odd(step_id) and _is_odd(self.stage_id):
+            mb = step_id // 2 - self.stage_id // 2
+            return mb, True
+        if _is_odd(step_id) and _is_even(self.stage_id):
+            mb = (step_id - 1) // 2 - (self.stages - 1) + self.stage_id // 2
+            return mb, False
+        mb = (step_id - 1) // 2 - (self.stages - 1) + (self.stage_id + 1) // 2
+        return mb, False
+
+    def steps(self):
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        prev_mb = -1
+        for step_id in range(total_steps):
+            mb, is_forward = self._step_to_micro_batch(step_id)
+            cmds: List[PipeInstruction] = []
+            if 0 <= mb < self.micro_batches:
+                if is_forward:
+                    if self.is_first_stage:
+                        cmds.append(LoadMicroBatch(mb))
+                    else:
+                        cmds.append(RecvActivation(mb))
+                    cmds.append(ForwardPass(mb))
+                    if not self.is_last_stage:
+                        cmds.append(SendActivation(mb))
+                else:
+                    if not self.is_last_stage:
+                        cmds.append(RecvGrad(mb))
+                    cmds.append(BackwardPass(mb))
+                    if not self.is_first_stage:
+                        cmds.append(SendGrad(mb))
+                prev_mb = mb
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            yield cmds
+
+
+def _is_even(x: int) -> bool:
+    return x % 2 == 0
+
+
+def _is_odd(x: int) -> bool:
+    return x % 2 != 0
+
+
+def bubble_fraction(micro_batches: int, stages: int) -> float:
+    """Idle fraction of the 1F1B schedule: (P-1)/(M+P-1)."""
+    return (stages - 1) / (micro_batches + stages - 1)

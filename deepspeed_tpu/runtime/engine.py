@@ -101,6 +101,26 @@ class TrainEngine:
         self.tp_specs = tp_specs
         self._donate = donate
 
+        # -- pipeline parallelism: GAS micro-batches flow through the
+        # rotating-microbatch executor inside ONE loss call instead of the
+        # outer accumulation scan (reference: PipelineEngine.train_batch,
+        # runtime/pipe/engine.py:312, where GAS == in-flight micro-batches)
+        self._pipelined = self.topo.pipe_parallel_size > 1
+        if self._pipelined:
+            if model is None or not hasattr(model, "pipeline_loss"):
+                raise ValueError(
+                    "mesh has pipe axis > 1 but the model does not expose "
+                    "pipeline_loss(params, batch, rng, num_microbatches)")
+
+            def pipe_loss(p, batch, rng):
+                # read GAS at call time: resolve_batch_config (below) may
+                # derive it from train_batch/micro_batch after this closure
+                # is created
+                return model.pipeline_loss(p, batch, rng,
+                                           config.gradient_accumulation_steps)
+
+            self.loss_fn = _normalize_loss_fn(pipe_loss)
+
         # -- batch arithmetic (reference config._configure_train_batch_size)
         config.resolve_batch_config(self.topo.data_parallel_size)
         log_dist(
@@ -228,7 +248,8 @@ class TrainEngine:
 
     def _build_train_step(self):
         cfg = self.config
-        gas = cfg.gradient_accumulation_steps
+        # pipelined: micro-batching happens inside pipeline_loss
+        gas = 1 if self._pipelined else cfg.gradient_accumulation_steps
         clip = cfg.gradient_clipping
         fp16 = cfg.fp16.enabled
         dynamic = fp16 and cfg.fp16.dynamic_loss_scale
@@ -338,6 +359,7 @@ class TrainEngine:
         """Compute loss for a microbatch (no grads). Provided for API parity;
         ``backward`` recomputes through ``jax.grad`` (forward+backward fuse
         on TPU, so the split exists only at the Python API level)."""
+        self._reject_if_pipelined()
         loss, _aux = self._jitted_eval()(self.params, batch, self._next_rng())
         self._last_loss = loss
         return loss
@@ -345,6 +367,7 @@ class TrainEngine:
     def backward(self, batch: Any) -> Any:
         """Accumulate gradient shards for one microbatch (parity with
         engine.backward engine.py:1902 + ZeRO IPG accumulation)."""
+        self._reject_if_pipelined()
         if self._micro_grad_fn is None:
             self._micro_grad_fn = jax.jit(
                 lambda p, b, r, s: self._loss_and_grads(p, b, r, s)[:2],
@@ -361,9 +384,18 @@ class TrainEngine:
         self._last_loss = loss
         return loss
 
+    def _reject_if_pipelined(self) -> None:
+        if self._pipelined:
+            # reference parity: PipelineEngine only supports train_batch()
+            # (pipe/engine.py — forward/backward are schedule instructions,
+            # not user API)
+            raise RuntimeError("pipelined engine: use train_batch(), not "
+                               "forward()/backward()/step()")
+
     def step(self) -> None:
         """Apply the update at a gradient-accumulation boundary (parity with
         engine.step engine.py:2100: no-op off-boundary)."""
+        self._reject_if_pipelined()
         if self.micro_steps % self.gradient_accumulation_steps != 0:
             return
         if self._acc_grads is None:
