@@ -63,20 +63,22 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = No
                     block_q: int = 128, block_k: int = 128):
     """Blocked flash attention. Dispatches to the Pallas TPU kernel when
     running on TPU with compatible shapes; jnp reference otherwise."""
-    if _use_pallas(q):
+    if _use_pallas(q, k, block_q, block_k):
         from .pallas.flash_attention import flash_attention as _pallas_flash
 
-        return _pallas_flash(q, k, v, causal=causal, scale=scale,
-                             block_q=block_q, block_k=block_k)
+        return _pallas_flash(q, k, v, causal, scale, block_q, block_k)
     return dot_product_attention(q, k, v, causal=causal, scale=scale)
 
 
-def _use_pallas(q) -> bool:
+def _use_pallas(q, k, block_q: int, block_k: int) -> bool:
     try:
         platform = jax.devices()[0].platform
     except Exception:
         return False
     if platform not in ("tpu",):
         return False
-    b, s, h, d = q.shape
-    return s >= 128 and d % 128 == 0 or d in (64, 128, 256)
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    bq, bk = min(block_q, sq), min(block_k, skv)
+    return (sq % bq == 0 and skv % bk == 0 and bq % 8 == 0 and bk % 8 == 0
+            and d in (64, 128, 256) and hq % hkv == 0 and skv >= sq)

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels — the replacement for the reference's ``csrc/`` CUDA
+kernel zoo (SURVEY.md §2.4). Each module documents which reference kernels
+it subsumes."""

@@ -1,0 +1,358 @@
+"""Blocked flash attention (Pallas TPU kernel), forward + backward.
+
+Subsumes the reference's attention kernel surface: the fused training
+softmax kernels (``csrc/transformer/softmax.cu``,
+``general_kernels.cu``), the Evoformer CUTLASS fMHA
+(``csrc/deepspeed4science/evoformer_attn/``), and the inference
+``softmax_context`` path's core attention math
+(``csrc/transformer/inference/csrc/softmax.cu``) — one online-softmax
+kernel family instead of a per-era zoo.
+
+Design (standard flash attention 2 on the MXU):
+* forward: grid ``(batch, q_heads, q_blocks, kv_blocks)`` with the kv axis
+  innermost; running row-max / row-sum / output accumulator live in VMEM
+  scratch across kv steps; logits and softmax in fp32, output in the input
+  dtype. Emits LSE (``m + log l``) residuals for the backward.
+* causal masking skips fully-masked kv blocks via ``pl.when`` (no MXU work
+  in the upper triangle) and applies the per-element mask on the diagonal
+  blocks only.
+* GQA/MQA: kv-head index derived in the BlockSpec index maps
+  (``q_head // group``) — K/V are never materialized per-q-head in the
+  forward.
+* backward: two kernels — dq over ``(b, h, nq, nk)`` and dk/dv over
+  ``(b, h, nk, nq)`` — both recompute probabilities from the LSE residual
+  (flash-2 style: no stored attention matrix, ``delta = rowsum(dout*out)``
+  precomputed outside).
+
+Off-TPU the caller (``ops/attention.py``) uses the jnp reference path;
+tests run these kernels in Pallas interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-negative instead of -inf: avoids NaN from (-inf)-(-inf)
+LANES = 128
+
+
+def _causal_mask(qi, ki, block_q: int, block_k: int, sq: int, skv: int):
+    """[block_q, block_k] bool mask for the (qi, ki) tile; query positions are
+    aligned to the END of the kv sequence (decode parity with
+    ops/attention.py dot_product_attention)."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return (q_pos + (skv - sq)) >= k_pos
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, block_q: int, block_k: int,
+                sq: int, skv: int):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # skip tiles strictly above the causal diagonal
+    diag_offset = skv - sq
+    run = (not causal) or (ki * block_k <= qi * block_q + (block_q - 1) + diag_offset)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)          # [bk, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            # apply the element mask only on blocks crossing the diagonal
+            partial = ki * block_k + (block_k - 1) > qi * block_q + diag_offset
+            s = jnp.where(
+                jnp.logical_and(partial,
+                                jnp.logical_not(_causal_mask(qi, ki, block_q,
+                                                             block_k, sq, skv))),
+                NEG_INF, s)
+        m_prev = m_scr[:, :1]                        # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                       # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)               # [bq, 1]
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)          # [bk, d]
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        # fully-masked rows (possible when causal and skv < sq): m stays at
+        # NEG_INF but p = exp(NEG_INF - NEG_INF) = 1 polluted l/acc, so
+        # detect via m, zero the output, and push lse to +inf so the
+        # backward's exp(s - lse) is 0 for these rows.
+        masked = m_scr[:, :1] <= NEG_INF / 2
+        l = l_scr[:, :1]
+        l_safe = jnp.where(jnp.logical_or(masked, l == 0.0), 1.0, l)
+        o_ref[0, 0] = jnp.where(masked, 0.0, acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(masked, -NEG_INF, m_scr[:, :1] + jnp.log(l_safe))
+        lse_ref[0, 0] = lse[:, 0]
+
+
+def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    group = hq // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    nq, nk = sq // block_q, skv // block_k
+    # [b, h, s, d] layout: heads as a grid axis, seq contiguous for tiling
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, sq=sq, skv=skv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), lse
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_scr, *, scale: float, causal: bool,
+               block_q: int, block_k: int, sq: int, skv: int):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    diag_offset = skv - sq
+    run = (not causal) or (ki * block_k <= qi * block_q + (block_q - 1) + diag_offset)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]                 # [bq, 1]
+        delta = delta_ref[0, 0][:, None]             # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, sq, skv),
+                          s, NEG_INF)
+        p = jnp.exp(s - lse)                         # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        acc_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        dq_ref[0, 0] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale: float, causal: bool,
+                block_q: int, block_k: int, sq: int, skv: int, nq: int):
+    # last grid dim fuses (q-head group, q block): dk/dv accumulate across
+    # the whole group in scratch without materializing per-q-head K/V
+    ki, gq = pl.program_id(2), pl.program_id(3)
+    n_gq = pl.num_programs(3)
+    qi = jax.lax.rem(gq, nq)
+
+    @pl.when(gq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    diag_offset = skv - sq
+    run = (not causal) or (ki * block_k <= qi * block_q + (block_q - 1) + diag_offset)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, sq, skv),
+                          s, NEG_INF)
+        p = jnp.exp(s - lse)                         # [bq, bk]
+        # dv += P^T @ dO
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                # [bq, bk]
+        # dk += dS^T @ Q
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(gq == n_gq - 1)
+    def _final():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _seq_spec(block: int, d: int, index_map):
+    return pl.BlockSpec((1, 1, block, d), index_map, memory_space=pltpu.VMEM)
+
+
+def _row_spec(block: int, index_map):
+    return pl.BlockSpec((1, 1, block), index_map, memory_space=pltpu.VMEM)
+
+
+def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
+                    interpret):
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    group = hq // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    nq, nk = sq // block_q, skv // block_k
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    dot = do.transpose(0, 2, 1, 3)
+    ot = out.transpose(0, 2, 1, 3)
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
+
+    # dq: grid (b, q_head, q_block, kv_block); K/V indexed per kv-head group
+    # (same trick as the forward — never expanded to q-heads)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, sq=sq, skv=skv),
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            _seq_spec(block_q, d, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            _seq_spec(block_k, d,
+                      lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
+            _seq_spec(block_k, d,
+                      lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
+            _seq_spec(block_q, d, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            _row_spec(block_q, lambda bi, hi, qi, ki: (bi, hi, qi)),
+            _row_spec(block_q, lambda bi, hi, qi, ki: (bi, hi, qi)),
+        ],
+        out_specs=_seq_spec(block_q, d, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # dk/dv: grid (b, kv_head, kv_block, group*q_block) — the fused last dim
+    # walks every q-head of the group then every q block, accumulating into
+    # one [block_k, d] scratch per kv head (no hq-sized dk/dv intermediates)
+    def qhead(hk, gq, g=group):
+        return hk * g + gq // nq
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, sq=sq, skv=skv,
+                          nq=nq),
+        grid=(b, hkv, nk, group * nq),
+        in_specs=[
+            _seq_spec(block_q, d,
+                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq), 0)),
+            _seq_spec(block_k, d, lambda bi, hk, ki, gq: (bi, hk, ki, 0)),
+            _seq_spec(block_k, d, lambda bi, hk, ki, gq: (bi, hk, ki, 0)),
+            _seq_spec(block_q, d,
+                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq), 0)),
+            _row_spec(block_q,
+                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq))),
+            _row_spec(block_q,
+                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq))),
+        ],
+        out_specs=[
+            _seq_spec(block_k, d, lambda bi, hk, ki, gq: (bi, hk, ki, 0)),
+            _seq_spec(block_k, d, lambda bi, hk, ki, gq: (bi, hk, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hkv, skv, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    return (dq.transpose(0, 2, 1, 3),
+            dk.transpose(0, 2, 1, 3),
+            dv.transpose(0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """q: [b, sq, hq, d]; k/v: [b, skv, hkv, d] -> [b, sq, hq, d].
+
+    ``sq``/``skv`` must divide by the (clamped) block sizes; the dispatcher
+    in ``ops/attention.py`` falls back to the jnp path otherwise.
+    """
+    scale_v = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    out, _ = _flash_forward(q, k, v, scale_v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    scale_v = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    out, lse = _flash_forward(q, k, v, scale_v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    scale_v = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    dq, dk, dv = _flash_backward(q, k, v, out, lse, g, scale_v, causal,
+                                 block_q, block_k, interpret)
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
