@@ -1,0 +1,10 @@
+"""Inference stack: v1 TP-sharded generation engine (engine.py) and the
+FastGen-v2-parity ragged/continuous-batching engine (ragged.py).
+
+Reference surface: deepspeed/inference/ (engine.py, config.py) + v2
+(engine_v2.py, ragged/).
+"""
+
+from .engine import InferenceConfig, InferenceEngine
+
+__all__ = ["InferenceConfig", "InferenceEngine"]

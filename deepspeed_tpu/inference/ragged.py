@@ -1,0 +1,378 @@
+"""Ragged / continuous-batching inference with a paged KV cache
+(FastGen v2 parity).
+
+Reference surface (deepspeed/inference/v2/):
+* ``InferenceEngineV2.put(uids, tokens)`` ragged decode step (engine_v2.py:107)
+  and the ``query`` / ``can_schedule`` / ``flush`` scheduling API (:153-:228),
+* ``DSStateManager`` + ``DSSequenceDescriptor`` (ragged/ragged_manager.py:19,
+  ragged/sequence_descriptor.py),
+* ``BlockedAllocator`` paged-KV block pool (ragged/blocked_allocator.py),
+* the ragged-batch atom building the reference does in C++
+  (ragged/csrc/fast_host_buffer.cpp) — here plain numpy on the host feeding
+  ONE jitted step with static shapes,
+* Dynamic-SplitFuse token scheduling (the FastGen blog's core idea):
+  every step packs all pending decodes (1 token each) plus as many prompt
+  tokens as fit into a fixed token budget, so the compiled program sees one
+  shape regardless of the prefill/decode mix.
+
+TPU-first redesign: CUDA FastGen builds variable "ragged atoms" per step and
+launches paged-attention kernels over them. Under XLA every shape must be
+static, so the step program is fixed at ``[token_budget]`` tokens and
+``[max_seqs]`` sequence slots; inactive lanes are masked. The paged
+attention itself gathers each token's block list from the pool — the jnp
+formulation below vectorizes over tokens (fine at decode batch sizes); a
+Pallas kernel with scalar-prefetched block tables is the drop-in upgrade
+path (ops/pallas/paged_attention.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.norms import layer_norm, rms_norm
+from ..ops.rotary import apply_rotary, rope_frequencies
+from ..utils.logging import log_dist
+
+
+# ----------------------------------------------------------------------
+# host-side state (reference: ragged/blocked_allocator.py, ragged_manager.py)
+class BlockedAllocator:
+    """Free-list allocator over ``n_blocks`` KV pages
+    (reference blocked_allocator.py — same capability, python list instead
+    of a torch tensor free-list)."""
+
+    def __init__(self, n_blocks: int):
+        self.n_blocks = n_blocks
+        self._free: List[int] = list(range(n_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(f"KV pool exhausted: need {n}, have {len(self._free)}")
+        out, self._free = self._free[:n], self._free[n:]
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        self._free.extend(int(b) for b in blocks)
+
+
+@dataclass
+class SequenceDescriptor:
+    """Reference DSSequenceDescriptor: uid, slot, tokens seen/scheduled,
+    owned KV blocks."""
+
+    uid: int
+    slot: int
+    tokens: List[int] = field(default_factory=list)  # full known token stream
+    seen: int = 0                                    # tokens already in KV
+    blocks: List[int] = field(default_factory=list)
+
+    @property
+    def pending(self) -> int:
+        return len(self.tokens) - self.seen
+
+
+@dataclass
+class RaggedConfig:
+    """Knobs mirroring reference DSStateManagerConfig + RaggedBatchConfig
+    (inference/v2/ragged/manager_configs.py): max_ragged_batch_size =
+    token_budget, max_tracked_sequences = max_seqs, memory_config block
+    count/size."""
+
+    token_budget: int = 256
+    max_seqs: int = 8
+    kv_block_size: int = 16
+    n_kv_blocks: int = 256
+    max_context: int = 2048
+    dtype: Any = jnp.bfloat16
+
+
+class RaggedInferenceEngine:
+    """Continuous-batching engine over a deepspeed_tpu Transformer.
+
+    ``put(uids, tokens)`` runs ONE compiled ragged step mixing prefill
+    chunks and decodes (Dynamic SplitFuse); returns next-token logits per
+    uid (NaN rows for uids whose prompt is still being prefilled across
+    steps). ``generate`` drives put/flush to completion.
+    """
+
+    def __init__(self, model, config: Optional[RaggedConfig] = None,
+                 params: Any = None, rng: Any = None):
+        self.config = config or RaggedConfig()
+        self.model = model
+        c = model.config
+        # the ragged step inlines the dense block math; models overriding
+        # _mlp (MoE) need the expert-aware path which is not wired here yet
+        if hasattr(model, "moe"):
+            raise NotImplementedError(
+                "RaggedInferenceEngine does not support MoE models yet; "
+                "use InferenceEngine (dense KV cache) for MoE")
+        if self.config.max_context > c.max_seq_len:
+            raise ValueError(
+                f"max_context {self.config.max_context} exceeds model "
+                f"max_seq_len {c.max_seq_len} (RoPE/position table bound)")
+        self.params = params if params is not None else model.init(
+            rng if rng is not None else jax.random.PRNGKey(0))
+        self.params = jax.tree_util.tree_map(
+            lambda x: x.astype(self.config.dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) else x,
+            self.params)
+        cfg = self.config
+        self.allocator = BlockedAllocator(cfg.n_kv_blocks)
+        self.seqs: Dict[int, SequenceDescriptor] = {}
+        self._free_slots = list(range(cfg.max_seqs))
+        self.max_pages = cfg.max_context // cfg.kv_block_size
+        # paged KV pool [n_layers, n_blocks + 1, block, hkv, hd]; the last
+        # page is a scratch sink for masked-out batch lanes (duplicate
+        # scatters with mixed old/new values are undefined — inactive lanes
+        # must never alias a live page)
+        pool_shape = (c.n_layers, cfg.n_kv_blocks + 1, cfg.kv_block_size,
+                      c.n_kv_heads, c.head_dim)
+        self.kv_pool = (jnp.zeros(pool_shape, cfg.dtype),
+                        jnp.zeros(pool_shape, cfg.dtype))
+        self._step_fn = None
+        log_dist(f"RaggedInferenceEngine: budget={cfg.token_budget} "
+                 f"blocks={cfg.n_kv_blocks}x{cfg.kv_block_size}")
+
+    # -- scheduling API (parity engine_v2.query/can_schedule) -----------
+    def query(self, uid: int) -> Tuple[int, int]:
+        """(max new tokens schedulable for uid now, free kv blocks) —
+        reference engine_v2.query :153."""
+        return self.config.token_budget, self.allocator.free_blocks
+
+    def can_schedule(self, uids: Sequence[int], lengths: Sequence[int]) -> bool:
+        """Whether prompts of the given lengths fit (slots + kv blocks) —
+        reference engine_v2.can_schedule :179."""
+        new = [u for u in uids if u not in self.seqs]
+        need_blocks = sum(-(-l // self.config.kv_block_size) + 1 for l in lengths)
+        return (len(new) <= len(self._free_slots)
+                and need_blocks <= self.allocator.free_blocks)
+
+    def flush(self, uids: Sequence[int]) -> None:
+        """Release sequence state + KV blocks (reference engine_v2.flush :228)."""
+        for uid in uids:
+            seq = self.seqs.pop(uid, None)
+            if seq is not None:
+                self.allocator.free(seq.blocks)
+                self._free_slots.append(seq.slot)
+
+    # -- step ------------------------------------------------------------
+    def put(self, uids: Sequence[int], tokens: Sequence[Sequence[int]]) -> np.ndarray:
+        """Admit new tokens for ``uids`` and run one ragged step.
+
+        Returns [len(uids), vocab] fp32 logits of each sequence's latest
+        processed token; rows are NaN while a long prompt is still
+        mid-prefill (call put(uid, []) again to continue it).
+        """
+        cfg = self.config
+        for uid, toks in zip(uids, tokens):
+            if uid not in self.seqs:
+                if not self._free_slots:
+                    raise RuntimeError("no free sequence slots; flush() first")
+                self.seqs[uid] = SequenceDescriptor(uid=uid,
+                                                    slot=self._free_slots.pop())
+            self.seqs[uid].tokens.extend(int(t) for t in toks)
+
+        # ---- Dynamic SplitFuse packing: decodes (and short prompt tails)
+        # first, then the longest-pending prefill fills the leftover budget
+        sched: List[Tuple[SequenceDescriptor, int]] = []
+        budget = cfg.token_budget
+        pending = sorted((s for s in self.seqs.values() if s.pending > 0),
+                         key=lambda s: s.pending)
+        for seq in pending:
+            take = min(seq.pending, budget)
+            if take == 0:
+                break
+            sched.append((seq, take))
+            budget -= take
+        if not sched:
+            raise ValueError("put() called with no pending tokens")
+
+        # ---- validate + allocate for the WHOLE schedule before mutating any
+        # sequence state, so an exhausted pool leaves every descriptor
+        # consistent (seen never advances without its KV being written)
+        needs = []
+        for seq, take in sched:
+            new_total = seq.seen + take
+            if new_total > cfg.max_context:
+                raise ValueError(
+                    f"uid {seq.uid}: context {new_total} exceeds "
+                    f"max_context {cfg.max_context}")
+            needs.append(-(-new_total // cfg.kv_block_size) - len(seq.blocks))
+        if sum(n for n in needs if n > 0) > self.allocator.free_blocks:
+            raise RuntimeError(
+                f"KV pool exhausted: need {sum(n for n in needs if n > 0)} "
+                f"blocks, have {self.allocator.free_blocks}; flush() finished "
+                "sequences first")
+
+        # ---- build the flat step batch (reference: C++ fast_host_buffer)
+        T = cfg.token_budget
+        flat_tokens = np.zeros((T,), np.int32)
+        flat_slot = np.full((T,), -1, np.int32)
+        flat_pos = np.zeros((T,), np.int32)
+        last_index = {}  # uid -> index in flat batch of its last token
+        cursor = 0
+        for (seq, take), need in zip(sched, needs):
+            new_total = seq.seen + take
+            if need > 0:
+                seq.blocks.extend(self.allocator.allocate(need))
+            chunk = seq.tokens[seq.seen:seq.seen + take]
+            flat_tokens[cursor:cursor + take] = chunk
+            flat_slot[cursor:cursor + take] = seq.slot
+            flat_pos[cursor:cursor + take] = np.arange(seq.seen, new_total)
+            seq.seen = new_total
+            last_index[seq.uid] = cursor + take - 1
+            cursor += take
+
+        block_tables = np.zeros((cfg.max_seqs, self.max_pages), np.int32)
+        context_lens = np.zeros((cfg.max_seqs,), np.int32)
+        for seq in self.seqs.values():
+            block_tables[seq.slot, :len(seq.blocks)] = seq.blocks
+            context_lens[seq.slot] = seq.seen
+
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        logits, self.kv_pool = self._step_fn(
+            self.params, self.kv_pool, jnp.asarray(flat_tokens),
+            jnp.asarray(flat_slot), jnp.asarray(flat_pos),
+            jnp.asarray(block_tables), jnp.asarray(context_lens))
+        logits = np.asarray(logits)
+
+        out = np.full((len(uids), logits.shape[-1]), np.nan, np.float32)
+        for i, uid in enumerate(uids):
+            seq = self.seqs[uid]
+            if seq.pending == 0 and uid in last_index:
+                out[i] = logits[last_index[uid]]
+        return out
+
+    # -- generation convenience -----------------------------------------
+    def generate(self, prompts: Dict[int, Sequence[int]], max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None) -> Dict[int, List[int]]:
+        """Drive put() with SplitFuse scheduling until every uid has
+        ``max_new_tokens`` (or EOS). Returns uid -> generated tokens."""
+        done: Dict[int, List[int]] = {u: [] for u in prompts}
+        uids = list(prompts)
+        logits = self.put(uids, [list(p) for p in prompts.values()])
+        while uids:
+            step_uids, step_toks = [], []
+            for uid, row in zip(uids, logits):
+                if np.isnan(row).any():          # prompt still prefilling
+                    step_uids.append(uid)
+                    step_toks.append([])
+                    continue
+                tok = int(np.argmax(row))
+                done[uid].append(tok)
+                if (len(done[uid]) < max_new_tokens
+                        and not (eos_token_id is not None and tok == eos_token_id)):
+                    step_uids.append(uid)
+                    step_toks.append([tok])
+            if not step_uids:
+                break
+            logits = self.put(step_uids, step_toks)
+            uids = step_uids
+        self.flush(list(prompts))
+        return done
+
+    # -- the compiled ragged step ----------------------------------------
+    def _build_step(self):
+        model = self.model
+        c = model.config
+        cfg = self.config
+        bs = cfg.kv_block_size
+
+        def norm(x, w, b=None):
+            return rms_norm(x, w, c.norm_eps) if c.norm == "rms" \
+                else layer_norm(x, w, b, c.norm_eps)
+
+        def step(params, pools, tokens, slots, positions, block_tables,
+                 context_lens):
+            # tokens/slots/positions: [T]; embeddings via the model's path
+            x = model._embed(params, tokens[None, :],
+                             positions=positions[None, :])[0]  # [T, d]
+            angles = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta) \
+                if c.position == "rope" else None
+            active = slots >= 0                                   # [T]
+            safe_slot = jnp.maximum(slots, 0)
+            # per-token flat page list and context mask
+            tables = block_tables[safe_slot]                      # [T, max_pages]
+            ctx = context_lens[safe_slot]                         # [T]
+
+            k_pool, v_pool = pools
+
+            def block(carry, layer_in):
+                x, kp, vp = carry
+                li, lp = layer_in
+                h = norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"))
+                q = (h @ lp["wq"]).reshape(-1, c.n_heads, c.head_dim)
+                kk = (h @ lp["wk"]).reshape(-1, c.n_kv_heads, c.head_dim)
+                vv = (h @ lp["wv"]).reshape(-1, c.n_kv_heads, c.head_dim)
+                if c.use_bias:
+                    q = q + lp["bq"].reshape(c.n_heads, c.head_dim)
+                    kk = kk + lp["bk"].reshape(c.n_kv_heads, c.head_dim)
+                    vv = vv + lp["bv"].reshape(c.n_kv_heads, c.head_dim)
+                if c.position == "rope":
+                    q = apply_rotary(q[:, None], angles, positions[:, None])[:, 0]
+                    kk = apply_rotary(kk[:, None], angles, positions[:, None])[:, 0]
+                # scatter new K/V into this layer's pages:
+                # page = table[pos // bs], row = pos % bs
+                page = jnp.take_along_axis(tables, (positions // bs)[:, None],
+                                           axis=1)[:, 0]          # [T]
+                row = positions % bs
+                # inactive lanes scatter into the scratch sink page
+                page = jnp.where(active, page, cfg.n_kv_blocks)
+                kp_l = kp[li].at[page, row].set(kk.astype(kp.dtype))
+                vp_l = vp[li].at[page, row].set(vv.astype(vp.dtype))
+                kp = kp.at[li].set(kp_l)
+                vp = vp.at[li].set(vp_l)
+                # gather each token's context pages -> [T, max_ctx, hkv, hd]
+                keys = kp_l[tables].reshape(tables.shape[0], -1, c.n_kv_heads,
+                                            c.head_dim)
+                vals = vp_l[tables].reshape(tables.shape[0], -1, c.n_kv_heads,
+                                            c.head_dim)
+                kv_pos = (jnp.arange(self.max_pages * bs)[None, :])
+                visible = kv_pos <= positions[:, None]             # causal
+                visible &= kv_pos < ctx[:, None]
+                # paged attention (jnp path; Pallas upgrade point)
+                group = c.n_heads // c.n_kv_heads
+                keys = jnp.repeat(keys, group, axis=2)
+                vals = jnp.repeat(vals, group, axis=2)
+                logits = jnp.einsum("thd,tkhd->thk", q.astype(jnp.float32),
+                                    keys.astype(jnp.float32))
+                logits = logits / np.sqrt(c.head_dim)
+                logits = jnp.where(visible[:, None, :], logits, -1e30)
+                probs = jax.nn.softmax(logits, axis=-1)
+                attn = jnp.einsum("thk,tkhd->thd", probs,
+                                  vals.astype(jnp.float32)).astype(x.dtype)
+                attn = attn.reshape(-1, c.n_heads * c.head_dim) @ lp["wo"]
+                if c.use_bias:
+                    attn = attn + lp["bo"]
+                x = x + attn
+                h = norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
+                if c.activation == "silu_glu":
+                    up = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+                else:
+                    up = h @ lp["w_up"]
+                    if c.use_bias:
+                        up = up + lp["b_up"]
+                    up = jax.nn.gelu(up)
+                down = up @ lp["w_down"]
+                if c.use_bias:
+                    down = down + lp["b_down"]
+                return (x + down, kp, vp), None
+
+            n_layers = c.n_layers
+            (x, k_pool, v_pool), _ = jax.lax.scan(
+                block, (x, k_pool, v_pool),
+                (jnp.arange(n_layers), params["layers"]))
+            logits = model._head(params, x[None, :])[0]            # [T, vocab]
+            return logits, (k_pool, v_pool)
+
+        return jax.jit(step, donate_argnums=(1,))

@@ -219,9 +219,13 @@ class Transformer:
             ck = jax.lax.dynamic_update_slice_in_dim(ck, kk, cache_pos, axis=1)
             cv = jax.lax.dynamic_update_slice_in_dim(cv, vv, cache_pos, axis=1)
             new_kv = (ck, cv)
-            valid = jnp.arange(ck.shape[1])[None, :] < (cache_pos + s)
-            mask = valid[None, None, None, :]
-            attn = dot_product_attention(q, ck, cv, causal=(s > 1), mask=mask)
+            # query i sits at absolute position cache_pos + i: it may attend
+            # every cache slot up to and including itself (this also masks
+            # the unwritten zero tail of the cache)
+            q_abs = cache_pos + jnp.arange(s)                   # [s]
+            k_pos = jnp.arange(ck.shape[1])                     # [max_len]
+            mask = (k_pos[None, :] <= q_abs[:, None])[None, None]  # [1,1,s,max_len]
+            attn = dot_product_attention(q, ck, cv, causal=False, mask=mask)
         elif self._seq_size > 1:
             attn = self._sp_attention(q, kk, vv)
         elif c.use_flash:

@@ -1,0 +1,115 @@
+"""Inference engine v1 tests (reference: tests/unit/inference/test_inference.py
+style — generation consistency, TP parity, config plumbing)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.inference import InferenceConfig, InferenceEngine
+from deepspeed_tpu.models import GPT2, Llama
+from deepspeed_tpu.parallel import mesh as mesh_mod
+
+
+def _llama(**kw):
+    kw.setdefault("n_layers", 2)
+    return Llama("tiny", d_model=64, n_heads=4, n_kv_heads=2, vocab_size=128,
+                 max_seq_len=128, use_flash=False, remat=False, **kw)
+
+
+def _prompt(b=2, s=8, seed=0):
+    return np.random.default_rng(seed).integers(0, 128, (b, s)).astype(np.int32)
+
+
+def test_config_from_any():
+    cfg = InferenceConfig.from_any({"dtype": "float32", "mp_size": 2,
+                                    "replace_with_kernel_inject": True,
+                                    "unknown_knob": 7})
+    assert cfg.tensor_parallel == 2
+    assert cfg.dtype == "float32"
+    assert cfg.extras["unknown_knob"] == 7
+    cfg2 = InferenceConfig.from_any({"tensor_parallel": {"tp_size": 4}})
+    assert cfg2.tensor_parallel == 4
+
+
+def test_greedy_generation_consistent_with_forward():
+    """KV-cache decode must agree with teacher-forced argmax (the cache is
+    an optimization, not a different model)."""
+    model = _llama()
+    eng = InferenceEngine(model, InferenceConfig(dtype="float32", temperature=0.0))
+    prompt = _prompt(b=2, s=8)
+    out = eng.generate(prompt, max_new_tokens=6)
+    assert out.shape == (2, 14)
+    # teacher-forced check: feeding out[:, :t] must argmax to out[:, t]
+    logits = np.asarray(eng.forward(out[:, :-1]))
+    for t in range(8, out.shape[1]):
+        np.testing.assert_array_equal(np.argmax(logits[:, t - 1], -1), out[:, t])
+
+
+def test_generation_with_learned_positions():
+    model = GPT2("tiny", n_layers=2, d_model=64, n_heads=4, vocab_size=128,
+                 max_seq_len=128, use_flash=False, remat=False)
+    eng = InferenceEngine(model, InferenceConfig(dtype="float32", temperature=0.0))
+    prompt = _prompt(b=1, s=4, seed=1)
+    out = eng.generate(prompt, max_new_tokens=4)
+    logits = np.asarray(eng.forward(out[:, :-1]))
+    for t in range(4, out.shape[1]):
+        np.testing.assert_array_equal(np.argmax(logits[:, t - 1], -1), out[:, t])
+
+
+def test_tp_generation_matches_single_device():
+    prompt = _prompt(b=2, s=8, seed=2)
+    rng = jax.random.PRNGKey(3)
+
+    model1 = _llama()
+    eng1 = InferenceEngine(model1, InferenceConfig(dtype="float32", temperature=0.0),
+                           rng=rng)
+    out1 = eng1.generate(prompt, max_new_tokens=5)
+
+    mesh_mod.reset_topology()
+    model2 = _llama()
+    eng2 = InferenceEngine(model2, InferenceConfig(dtype="float32", temperature=0.0,
+                                                   tensor_parallel=2), rng=rng)
+    assert eng2.topo.model_parallel_size == 2
+    out2 = eng2.generate(prompt, max_new_tokens=5)
+    np.testing.assert_array_equal(out1, out2)
+
+
+def test_sampling_controls():
+    model = _llama()
+    eng = InferenceEngine(model, InferenceConfig(dtype="float32", temperature=0.8,
+                                                 top_k=5, seed=7))
+    out = eng.generate(_prompt(b=2, s=4, seed=4), max_new_tokens=4)
+    assert out.shape == (2, 8)
+    assert (out >= 0).all() and (out < 128).all()
+
+
+def test_init_inference_api():
+    """deepspeed.init_inference parity entrypoint."""
+    model = _llama()
+    eng = dst.init_inference(model, config={"dtype": "float32", "temperature": 0.0})
+    assert isinstance(eng, InferenceEngine)
+    out = eng.generate(_prompt(b=1, s=4), max_new_tokens=2)
+    assert out.shape == (1, 6)
+
+
+def test_per_row_eos_padding():
+    """A row that hits EOS keeps emitting EOS while others continue."""
+    model = _llama()
+    eng = InferenceEngine(model, InferenceConfig(dtype="float32", temperature=0.0))
+    prompt = _prompt(b=2, s=4, seed=9)
+    base = eng.generate(prompt, max_new_tokens=6)
+    # pick row 0's first generated token as the "eos": row 0 must then be
+    # padded with it for the rest of the sequence
+    eos = int(base[0, 4])
+    out = eng.generate(prompt, max_new_tokens=6, eos_token_id=eos)
+    row0_gen = out[0, 4:]
+    assert row0_gen[0] == eos and (row0_gen == eos).all()
+
+
+def test_generation_overflow_rejected():
+    model = _llama()
+    eng = InferenceEngine(model, InferenceConfig(dtype="float32"))
+    with pytest.raises(AssertionError):
+        eng.generate(_prompt(b=1, s=100), max_new_tokens=100)
