@@ -1,0 +1,25 @@
+"""Elastic training configuration.
+
+Reference surface: deepspeed/elasticity/ — ``compute_elastic_config``
+(elasticity.py:233, algorithms v0.1 :83 / v0.2 :126),
+``ensure_immutable_elastic_config`` (:208), the ``ds_elastic`` CLI, and
+``DSElasticAgent`` (elastic_agent.py:28).
+
+TPU-native stance (SURVEY.md §7 "Elasticity"): TPU slices don't do live
+membership change — recovery is checkpoint-based resume at a new world size
+(the universal/orbax checkpoint reshards automatically). So this module
+keeps the *planning* capability (choosing batch configs valid across an
+accelerator-count range, enforcing immutability) and maps the agent's
+restart loop onto run-loop resume (runtime/engine.load_checkpoint).
+"""
+
+from .elasticity import (
+    ElasticityConfig,
+    ElasticityError,
+    compute_elastic_config,
+    ensure_immutable_elastic_config,
+    get_compatible_gpus,
+)
+
+__all__ = ["compute_elastic_config", "ensure_immutable_elastic_config",
+           "get_compatible_gpus", "ElasticityConfig", "ElasticityError"]

@@ -1,0 +1,131 @@
+"""Elastic batch-size planning (reference elasticity/elasticity.py).
+
+Given a target global-batch range, candidate micro-batch sizes, and a min/max
+accelerator count, find the global batch size (and per-count micro-batch +
+GAS) that stays valid across every admissible accelerator count — so a job
+can resume from checkpoint at a different slice size without changing the
+effective batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import hashlib
+import json
+
+from ..utils.logging import log_dist
+
+
+class ElasticityError(Exception):
+    pass
+
+
+@dataclass
+class ElasticityConfig:
+    """Reference elasticity config schema (elasticity/config.py):
+    max_train_batch_size, micro_batch_sizes, min/max_gpus,
+    prefer_larger_batch, version, ignore_non_elastic_batch_info."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2048
+    micro_batch_sizes: Sequence[int] = (2, 4, 6)
+    min_gpus: int = 1
+    max_gpus: int = 1024
+    min_time: int = 0
+    prefer_larger_batch: bool = True
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict]) -> "ElasticityConfig":
+        if not d:
+            return cls()
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+
+def _candidate_batches(max_batch: int, micro_batches: Sequence[int]) -> List[int]:
+    """All batch sizes of the form micro * k <= max_batch (reference
+    _get_candidate_batch_sizes)."""
+    out = set()
+    for mb in micro_batches:
+        b = mb
+        while b <= max_batch:
+            out.add(b)
+            b += mb
+    return sorted(out)
+
+
+def get_compatible_gpus(batch: int, micro_batches: Sequence[int],
+                        min_gpus: int, max_gpus: int) -> List[int]:
+    """Accelerator counts that evenly fit ``batch`` with some micro-batch
+    (reference _get_compatible_gpus_v01)."""
+    ok = []
+    for n in range(min_gpus, max_gpus + 1):
+        if any(batch % (mb * n) == 0 for mb in micro_batches):
+            ok.append(n)
+    return ok
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Pick the final batch config (reference compute_elastic_config :233).
+
+    Returns (final_batch_size, valid_gpus[, micro_batch]) — with
+    ``world_size`` > 0 also resolves the micro-batch for that size.
+    """
+    econf = ElasticityConfig.from_dict(ds_config.get("elasticity"))
+    if not ds_config.get("elasticity"):
+        raise ElasticityError("'elasticity' section missing from config")
+    if not econf.enabled:
+        raise ElasticityError("elasticity.enabled is false")
+
+    best_batch, best_gpus = 0, []
+    for batch in _candidate_batches(econf.max_train_batch_size,
+                                    econf.micro_batch_sizes):
+        gpus = get_compatible_gpus(batch, econf.micro_batch_sizes,
+                                   econf.min_gpus, econf.max_gpus)
+        better = (len(gpus), batch) > (len(best_gpus), best_batch) \
+            if econf.prefer_larger_batch else (len(gpus), -batch) > (len(best_gpus), -best_batch)
+        if gpus and better:
+            best_batch, best_gpus = batch, gpus
+
+    if not best_gpus:
+        raise ElasticityError(
+            f"no batch size <= {econf.max_train_batch_size} is compatible with "
+            f"gpu range [{econf.min_gpus}, {econf.max_gpus}] and micro-batches "
+            f"{list(econf.micro_batch_sizes)}")
+    log_dist(f"elastic config: batch={best_batch} valid_gpus={best_gpus[:8]}"
+             + ("..." if len(best_gpus) > 8 else ""))
+
+    if world_size > 0:
+        if world_size not in best_gpus:
+            raise ElasticityError(
+                f"world size {world_size} not in valid elastic gpu counts")
+        micro = max(mb for mb in econf.micro_batch_sizes
+                    if best_batch % (mb * world_size) == 0)
+        return best_batch, best_gpus, micro
+    if return_microbatch:
+        return best_batch, best_gpus, None
+    return best_batch, best_gpus
+
+
+def elasticity_fingerprint(ds_config: Dict) -> str:
+    e = ds_config.get("elasticity", {})
+    return hashlib.sha256(json.dumps(e, sort_keys=True).encode()).hexdigest()
+
+
+_frozen: Dict[str, str] = {}
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict) -> None:
+    """Reference :208 — the elastic config may not change once scheduled
+    (resources were provisioned against it)."""
+    fp = elasticity_fingerprint({"elasticity": runtime_elastic_config_dict})
+    prev = _frozen.get("fp")
+    if prev is not None and prev != fp:
+        raise ElasticityError("elastic config changed after scheduling — "
+                              "the batch contract is immutable")
+    _frozen["fp"] = fp

@@ -1,0 +1,81 @@
+"""Environment/compatibility report (the ``ds_report`` CLI —
+reference deepspeed/env_report.py: op compatibility matrix + version/env
+table). The reference reports which CUDA extensions can build; here the
+"ops" are Pallas kernels and XLA features, reported per detected platform.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Tuple
+
+GREEN_OK = "[OKAY]"
+RED_NO = "[NO]"
+
+
+def op_compatibility() -> List[Tuple[str, bool, str]]:
+    """(op, available, note) rows — the DS_BUILD_* matrix analog."""
+    import jax
+
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "none"
+    on_tpu = platform == "tpu"
+    rows = [
+        ("flash_attention (pallas)", True, "compiled on TPU; interpret elsewhere"),
+        ("paged/ragged attention", True, "jnp path everywhere; pallas on TPU"),
+        ("fused optimizers (jit)", True, "optax-style fused update under jit"),
+        ("sequence parallel (ulysses a2a)", True, ""),
+        ("ring attention (ppermute)", True, ""),
+        ("pipeline (shard_map+ppermute)", True, ""),
+        ("moe a2a dispatch", True, ""),
+        ("bf16 matmul on MXU", on_tpu, "requires TPU" if not on_tpu else ""),
+        ("int8 quantization kernels", True, "jnp path; pallas on TPU"),
+        ("async checkpoint (orbax)", _has("orbax.checkpoint"), ""),
+    ]
+    return rows
+
+
+def _has(mod: str) -> bool:
+    try:
+        __import__(mod)
+        return True
+    except Exception:
+        return False
+
+
+def main(argv=None) -> int:
+    import jax
+
+    import deepspeed_tpu
+
+    lines = ["-" * 72, "DeepSpeed-TPU C compatibility report", "-" * 72]
+    lines.append(f"deepspeed_tpu version ... {deepspeed_tpu.__version__}")
+    lines.append(f"python version .......... {sys.version.split()[0]}")
+    lines.append(f"jax version ............. {jax.__version__}")
+    try:
+        import jaxlib
+
+        lines.append(f"jaxlib version .......... {jaxlib.__version__}")
+    except Exception:
+        pass
+    try:
+        devs = jax.devices()
+        lines.append(f"platform ................ {devs[0].platform}")
+        lines.append(f"devices ................. {len(devs)} x {devs[0].device_kind}")
+    except Exception as e:
+        lines.append(f"platform ................ unavailable ({type(e).__name__})")
+    lines.append("-" * 72)
+    lines.append("op compatibility (the DS_BUILD_* matrix analog):")
+    for op, ok, note in op_compatibility():
+        status = GREEN_OK if ok else RED_NO
+        lines.append(f"  {op:38s} {status:7s} {note}")
+    lines.append("-" * 72)
+    text = "\n".join(lines)
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -141,10 +141,12 @@ class InferenceEngine:
         model = self.model
 
         def prefill(params, tokens, caches):
-            # tokens: [b, s_prompt]; fills cache at [0, s) and returns last logits
+            # tokens: [b, s_prompt]; fills cache at [0, s); the head runs on
+            # the LAST position only (a full-prompt [b, s, vocab] fp32 logits
+            # tensor would be GBs at serving sizes)
             logits, caches = model.apply(params, tokens, kv_caches=caches,
-                                         cache_pos=0)
-            return logits[:, -1, :], caches
+                                         cache_pos=0, last_token_only=True)
+            return logits[:, 0, :], caches
 
         return jax.jit(prefill, donate_argnums=(2,))
 
@@ -172,6 +174,8 @@ class InferenceEngine:
         lengths in inference/ragged.py)."""
         input_ids = jnp.asarray(input_ids, jnp.int32)
         b, s = input_ids.shape
+        if max_new_tokens <= 0:
+            return np.asarray(input_ids)
         max_len = s + max_new_tokens
         assert max_len <= self.model.config.max_seq_len, (
             f"prompt+new tokens {max_len} exceeds model max_seq_len "
@@ -180,9 +184,9 @@ class InferenceEngine:
             self._prefill_fn = self._build_prefill()
             self._decode_fn = self._build_decode()
         caches = self._alloc_cache(b, max_len)
-        rng = jax.random.PRNGKey(self.config.seed)
+        rng, sub = jax.random.split(jax.random.PRNGKey(self.config.seed))
         logits, caches = self._prefill_fn(self.params, input_ids, caches)
-        next_tok = _sample(logits, rng, self.config.temperature,
+        next_tok = _sample(logits, sub, self.config.temperature,
                            self.config.top_k, self.config.top_p)
         # per-row EOS: finished rows emit eos (padding) from then on
         finished = np.zeros((b,), bool)

@@ -119,6 +119,10 @@ class RaggedInferenceEngine:
             raise ValueError(
                 f"max_context {self.config.max_context} exceeds model "
                 f"max_seq_len {c.max_seq_len} (RoPE/position table bound)")
+        if self.config.max_context % self.config.kv_block_size != 0:
+            raise ValueError(
+                f"max_context {self.config.max_context} must be a multiple of "
+                f"kv_block_size {self.config.kv_block_size}")
         self.params = params if params is not None else model.init(
             rng if rng is not None else jax.random.PRNGKey(0))
         self.params = jax.tree_util.tree_map(
@@ -145,14 +149,29 @@ class RaggedInferenceEngine:
     # -- scheduling API (parity engine_v2.query/can_schedule) -----------
     def query(self, uid: int) -> Tuple[int, int]:
         """(max new tokens schedulable for uid now, free kv blocks) —
-        reference engine_v2.query :153."""
-        return self.config.token_budget, self.allocator.free_blocks
+        reference engine_v2.query :153. Accounts for the uid's remaining
+        context window and the blocks it could still claim."""
+        seen = self.seqs[uid].seen if uid in self.seqs else 0
+        owned = len(self.seqs[uid].blocks) if uid in self.seqs else 0
+        ctx_room = self.config.max_context - seen
+        slack_in_blocks = owned * self.config.kv_block_size - seen
+        kv_room = slack_in_blocks + self.allocator.free_blocks * self.config.kv_block_size
+        return (max(0, min(self.config.token_budget, ctx_room, kv_room)),
+                self.allocator.free_blocks)
 
     def can_schedule(self, uids: Sequence[int], lengths: Sequence[int]) -> bool:
         """Whether prompts of the given lengths fit (slots + kv blocks) —
         reference engine_v2.can_schedule :179."""
+        bs = self.config.kv_block_size
         new = [u for u in uids if u not in self.seqs]
-        need_blocks = sum(-(-l // self.config.kv_block_size) + 1 for l in lengths)
+        need_blocks = 0
+        for uid, length in zip(uids, lengths):
+            if uid in self.seqs:
+                seq = self.seqs[uid]
+                total = seq.seen + length
+                need_blocks += max(0, -(-total // bs) - len(seq.blocks))
+            else:
+                need_blocks += -(-length // bs) + 1
         return (len(new) <= len(self._free_slots)
                 and need_blocks <= self.allocator.free_blocks)
 

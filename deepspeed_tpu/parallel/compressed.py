@@ -1,0 +1,169 @@
+"""Compressed collectives: error-compensated 1-bit / int8 gradient
+reduction (the 1-bit optimizer comm layer + ZeRO++ quantized gradients).
+
+Reference surface:
+* ``runtime/comm/nccl.py:51`` NcclBackend.compressed_allreduce — the
+  error-feedback 1-bit allreduce behind OnebitAdam/OnebitLamb/ZeroOneAdam
+  (``runtime/fp16/onebit/``): worker compression -> chunk exchange ->
+  server (per-chunk) reduce + second compression -> result broadcast, with
+  TWO error buffers (worker_error, server_error) carrying both stages'
+  residuals,
+* ``runtime/comm/mpi.py`` (same algorithm over mpi4py),
+* ZeRO++ quantized gradients over intra-node groups
+  (groups.py:356, engine.py:1117).
+
+TPU-first: the reference builds the exchange from igather/isend loops on
+side streams; here both phases are XLA collectives inside shard_map —
+``all_to_all`` moves int8 sign payloads (1 byte/element instead of 4) so
+the wire volume drops ~4x (plus one fp32 scale per chunk), then the
+reduced chunk is re-compressed and ``all_gather``-ed. Same convergence
+contract, compiler-scheduled transfers riding ICI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _sign_compress(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row 1-bit compression: x [rows, m] -> (sign int8, scale [rows]).
+    scale = mean |x| per row keeps the decompressed magnitude unbiased."""
+    scale = jnp.mean(jnp.abs(x), axis=-1)
+    sign = jnp.where(x >= 0, 1, -1).astype(jnp.int8)
+    return sign, scale
+
+
+def onebit_allreduce(x: jnp.ndarray, worker_error: jnp.ndarray,
+                     server_error: jnp.ndarray, axis_name: str):
+    """Error-compensated 1-bit mean-allreduce of one flat tensor.
+
+    Must run inside shard_map with ``axis_name`` manual. x: [n] with n
+    divisible by the axis size. Returns (reduced [n], new_worker_error,
+    new_server_error)."""
+    world = jax.lax.psum(1, axis_name)
+    n = x.shape[0]
+
+    # -- phase 1: worker compression + chunk exchange
+    corrected = x + worker_error
+    chunks = corrected.reshape(world, -1)                  # [world, m]
+    sign, scale = _sign_compress(chunks)                   # int8, [world]
+    new_worker_error = (corrected -
+                        (sign * scale[:, None]).reshape(-1))
+    # each rank receives chunk r of every rank (the igather analog)
+    signs_recv = jax.lax.all_to_all(sign, axis_name, split_axis=0,
+                                    concat_axis=0, tiled=False)
+    scales_recv = jax.lax.all_to_all(scale[:, None], axis_name, split_axis=0,
+                                     concat_axis=0, tiled=False)
+    # [world, m] / [world, 1]: rank k's view of chunk <self> from all ranks
+    signs_recv = signs_recv.reshape(world, -1)
+    scales_recv = scales_recv.reshape(world, 1)
+
+    # -- phase 2: server reduce + second compression
+    chunk_avg = jnp.mean(signs_recv.astype(jnp.float32) * scales_recv, axis=0)
+    corrected2 = chunk_avg + server_error
+    sign2, scale2 = _sign_compress(corrected2[None, :])
+    new_server_error = corrected2 - (sign2[0] * scale2[0])
+
+    # -- broadcast: all_gather the compressed reduced chunks
+    signs_all = jax.lax.all_gather(sign2[0], axis_name)     # [world, m] int8
+    scales_all = jax.lax.all_gather(scale2[0], axis_name)   # [world]
+    reduced = (signs_all.astype(jnp.float32) * scales_all[:, None]).reshape(n)
+    return reduced, new_worker_error, new_server_error
+
+
+def int8_allreduce(x: jnp.ndarray, worker_error: jnp.ndarray,
+                   axis_name: str, block: int = 512):
+    """Blockwise-int8 error-compensated allreduce (ZeRO++ gradient
+    quantization analog): quantize local contribution to int8 + per-block
+    scale, exchange chunks, dense-average, return fp32."""
+    from ..ops.quantizer import dequantize_blockwise, quantize_blockwise
+
+    world = jax.lax.psum(1, axis_name)
+    corrected = x + worker_error
+    q, s, _ = quantize_blockwise(corrected, bits=8, block=block)
+    deq = dequantize_blockwise(q, s, block=block)
+    new_error = corrected - deq
+    # chunk exchange of the int8 payload, dequantized server-side
+    chunks = q.reshape(world, -1)
+    scales = s.reshape(world, -1)
+    q_recv = jax.lax.all_to_all(chunks, axis_name, 0, 0, tiled=False)
+    s_recv = jax.lax.all_to_all(scales, axis_name, 0, 0, tiled=False)
+    q_recv = q_recv.reshape(world, -1, block)
+    s_recv = s_recv.reshape(world, -1)
+    chunk_avg = jnp.mean(q_recv.astype(jnp.float32) * s_recv[..., None], axis=0)
+    reduced = jax.lax.all_gather(chunk_avg.reshape(-1), axis_name).reshape(x.shape)
+    return reduced, new_error
+
+
+def tree_onebit_allreduce(grads: Any, worker_errors: Any, server_errors: Any,
+                          axis_name: str, world: int):
+    """Leaf-wise onebit_allreduce over a gradient pytree. Error buffers are
+    PER-RANK state: inside shard_map their leaves arrive as [1, ...] local
+    shards of a [world, ...] global array. Leaves whose size doesn't divide
+    the axis size fall back to dense psum-mean (the reference similarly
+    exempts small tensors)."""
+
+    def leaf(g, we, se):
+        n = g.size
+        flat = g.reshape(-1).astype(jnp.float32)
+        if n % world != 0 or n < 4 * world:
+            return jax.lax.pmean(flat, axis_name).reshape(g.shape), we, se
+        red, nwe, nse = onebit_allreduce(flat, we[0], se[0], axis_name)
+        return red.reshape(g.shape), nwe[None], nse[None]
+
+    flat_g, tree = jax.tree_util.tree_flatten(grads)
+    flat_we = jax.tree_util.tree_leaves(worker_errors)
+    flat_se = jax.tree_util.tree_leaves(server_errors)
+    out = [leaf(g, we, se) for g, we, se in zip(flat_g, flat_we, flat_se)]
+    return (jax.tree_util.tree_unflatten(tree, [a for a, _, _ in out]),
+            jax.tree_util.tree_unflatten(tree, [b for _, b, _ in out]),
+            jax.tree_util.tree_unflatten(tree, [c for _, _, c in out]))
+
+
+def make_onebit_grad_fn(loss_fn, mesh: Mesh, axis_name: str = "data"):
+    """grad_fn(params, batch, worker_err, server_err)
+    -> (grads, loss, new_worker_err, new_server_err), with the cross-replica
+    gradient reduction going through the error-compensated 1-bit collective
+    instead of a dense psum (params replicated over ``axis_name``; batch
+    dim 0 sharded over it — the 1-bit optimizers' ZeRO-0/1 layout).
+
+    Error buffers come from :func:`init_error_feedback` and must be placed
+    with dim 0 sharded over ``axis_name``.
+    """
+    world = mesh.shape[axis_name]
+
+    def spmd(params, batch, we, se):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, None))(params)
+        red, nwe, nse = tree_onebit_allreduce(grads, we, se, axis_name, world)
+        return red, jax.lax.pmean(loss, axis_name), nwe, nse
+
+    return jax.shard_map(
+        spmd, mesh=mesh, axis_names={axis_name},
+        in_specs=(P(), P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P(), P(), P(axis_name), P(axis_name)),
+        check_vma=False)
+
+
+def init_error_feedback(params: Any, axis_size: int) -> Tuple[Any, Any]:
+    """(worker_errors, server_errors) zero buffers, one row per rank
+    (leading dim = axis_size; shard it over the reduction axis). Server
+    errors cover one chunk (1/axis_size of each leaf) — the rank-local
+    reduction share. The reference keeps the same two buffers as
+    worker_error/server_error tensors per rank."""
+
+    def worker(p):
+        return jnp.zeros((axis_size, p.size), jnp.float32)
+
+    def server(p):
+        n = p.size
+        m = n // axis_size if (n % axis_size == 0 and n >= 4 * axis_size) else n
+        return jnp.zeros((axis_size, m), jnp.float32)
+
+    return (jax.tree_util.tree_map(worker, params),
+            jax.tree_util.tree_map(server, params))

@@ -1,0 +1,10 @@
+"""Data efficiency suite (reference runtime/data_pipeline/): curriculum
+learning scheduler, curriculum-aware data sampler, and random-LTD
+(layer-token drop)."""
+
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import DeepSpeedDataSampler
+from .random_ltd import RandomLTDScheduler, random_ltd_gather, random_ltd_scatter
+
+__all__ = ["CurriculumScheduler", "DeepSpeedDataSampler", "RandomLTDScheduler",
+           "random_ltd_gather", "random_ltd_scatter"]

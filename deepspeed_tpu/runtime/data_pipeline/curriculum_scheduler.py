@@ -1,0 +1,84 @@
+"""Curriculum learning scheduler.
+
+Parity with reference ``runtime/data_pipeline/curriculum_scheduler.py:11``
+(CurriculumScheduler): difficulty ramps by schedule type
+``fixed_linear`` / ``fixed_root`` / ``fixed_discrete`` / ``custom``, with
+``update_difficulty(global_step)`` / ``get_current_difficulty()`` and
+state_dict round-trip. Difficulty typically modulates sequence length
+(truncation) — see DataLoader.curriculum hook.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        self.state: Dict[str, Any] = {}
+        assert "curriculum_type" in config, "curriculum_type required"
+        assert "min_difficulty" in config and "max_difficulty" in config
+        ctype = config["curriculum_type"]
+        self.state["schedule_type"] = ctype
+        self.state["min_difficulty"] = config["min_difficulty"]
+        self.state["max_difficulty"] = config["max_difficulty"]
+        self.state["current_difficulty"] = config["min_difficulty"]
+        sched = config.get("schedule_config", {})
+        if ctype in (FIXED_LINEAR, FIXED_ROOT):
+            assert "total_curriculum_step" in sched
+            sched.setdefault("difficulty_step", 1)
+            if ctype == FIXED_ROOT:
+                sched.setdefault("root_degree", 2)
+        elif ctype == FIXED_DISCRETE:
+            assert "difficulty" in sched and "max_step" in sched
+            assert len(sched["difficulty"]) == len(sched["max_step"]) + 1
+        elif ctype == CUSTOM:
+            self._custom_fn: Optional[Callable[[int], int]] = sched.get("difficulty_fn")
+            assert callable(self._custom_fn), "custom curriculum needs difficulty_fn"
+        else:
+            raise ValueError(f"unknown curriculum_type {ctype!r}")
+        self.state["schedule"] = sched
+
+    # -- reference API --------------------------------------------------
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def set_current_difficulty(self, d: int) -> None:
+        self.state["current_difficulty"] = d
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.state["current_difficulty"] = self.__difficulty(global_steps)
+        return self.state["current_difficulty"]
+
+    def get_state(self) -> Dict[str, Any]:
+        return dict(self.state)
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.state.update(state)
+
+    # -- schedules ------------------------------------------------------
+    def __difficulty(self, step: int) -> int:
+        lo, hi = self.state["min_difficulty"], self.state["max_difficulty"]
+        sched = self.state["schedule"]
+        ctype = self.state["schedule_type"]
+        if ctype == FIXED_LINEAR:
+            frac = min(step / sched["total_curriculum_step"], 1.0)
+        elif ctype == FIXED_ROOT:
+            frac = min((step / sched["total_curriculum_step"]) **
+                       (1.0 / sched["root_degree"]), 1.0)
+        elif ctype == FIXED_DISCRETE:
+            idx = sum(1 for m in sched["max_step"] if step > m)
+            return int(sched["difficulty"][idx])
+        else:
+            return int(self._custom_fn(step))
+        d = lo + (hi - lo) * frac
+        # round down to difficulty_step granularity (reference behavior)
+        q = sched.get("difficulty_step", 1)
+        d = int(d // q * q)
+        return max(lo, min(hi, d))

@@ -1,0 +1,74 @@
+"""Curriculum-aware data sampler.
+
+Parity with reference ``runtime/data_pipeline/data_sampling/data_sampler.py:36``
+(DeepSpeedDataSampler): samples are bucketed by a difficulty metric; each
+epoch the sampler draws only from buckets at or below the curriculum's
+current difficulty, sharded across data-parallel ranks deterministically.
+The reference's offline map-reduce ``DataAnalyzer`` reduces here to a
+difficulty callable (or precomputed array) — the mmap index machinery is
+unnecessary when difficulties fit in one numpy array.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, dataset_size: int,
+                 difficulties: Sequence[float],
+                 curriculum: CurriculumScheduler,
+                 batch_size: int,
+                 data_parallel_rank: int = 0,
+                 data_parallel_size: int = 1,
+                 seed: int = 0,
+                 drop_last: bool = True):
+        assert len(difficulties) == dataset_size
+        self.difficulties = np.asarray(difficulties)
+        self.dataset_size = dataset_size
+        self.curriculum = curriculum
+        self.batch_size = batch_size
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.global_step = 0
+        assert batch_size % data_parallel_size == 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def state_dict(self):
+        return {"epoch": self.epoch, "global_step": self.global_step,
+                "curriculum": self.curriculum.get_state()}
+
+    def load_state_dict(self, state) -> None:
+        self.epoch = state["epoch"]
+        self.global_step = state["global_step"]
+        self.curriculum.set_state(state["curriculum"])
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(self.seed + self.epoch)
+        order = rng.permutation(self.dataset_size)
+        per_rank = self.batch_size // self.dp_size
+        cursor = 0
+        while True:
+            difficulty = self.curriculum.update_difficulty(self.global_step)
+            eligible = order[self.difficulties[order] <= difficulty]
+            if cursor + self.batch_size > len(eligible):
+                if self.drop_last or cursor >= len(eligible):
+                    return
+                batch = eligible[cursor:]
+            else:
+                batch = eligible[cursor:cursor + self.batch_size]
+            cursor += self.batch_size
+            self.global_step += 1
+            yield batch[self.dp_rank * per_rank:(self.dp_rank + 1) * per_rank]
+
+    def __len__(self) -> int:
+        return self.dataset_size // self.batch_size

@@ -1,0 +1,85 @@
+"""Random-LTD: random layerwise token dropping.
+
+Parity with reference ``runtime/data_pipeline/data_routing/`` (basic_layer.py
+RandomLayerTokenDrop + scheduler.py RandomLTDScheduler) and its CUDA helpers
+``csrc/random_ltd/`` (token_sort.cu, gather_scatter.cu) — on TPU the
+gather/scatter is ``jnp.take_along_axis`` with a sorted random index set
+(SURVEY.md §2.4 row Random-LTD: "jax.lax.sort/gather — no custom kernel").
+
+Mechanics: middle layers process only a random subset of tokens; the kept
+tokens' outputs are scattered back into the full residual stream. The kept
+count ramps linearly from ``mini_seq`` to the full sequence over the
+schedule, after which the layer reverts to dense.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomLTDScheduler:
+    """Kept-token-count schedule (reference scheduler.py: update_seq per
+    global step, linear ramp seq_begin -> seq_end by step_size)."""
+
+    def __init__(self, total_layers: int, mini_seq: int, full_seq: int,
+                 total_steps: int, step_size: int = 16):
+        self.total_layers = total_layers
+        self.mini_seq = mini_seq
+        self.full_seq = full_seq
+        self.total_steps = max(total_steps, 1)
+        self.step_size = step_size
+        self.current_seq = mini_seq
+
+    def update_seq(self, global_step: int) -> int:
+        frac = min(global_step / self.total_steps, 1.0)
+        seq = int(self.mini_seq + (self.full_seq - self.mini_seq) * frac)
+        seq = min(self.full_seq, (seq // self.step_size) * self.step_size)
+        self.current_seq = max(self.mini_seq, seq)
+        return self.current_seq
+
+    def get_current_seq(self) -> int:
+        return self.current_seq
+
+    def state_dict(self):
+        return {"current_seq": self.current_seq}
+
+    def load_state_dict(self, sd):
+        self.current_seq = sd["current_seq"]
+
+
+def random_ltd_indices(rng, seq_len: int, keep: int, batch: int) -> jnp.ndarray:
+    """[batch, keep] sorted random token indices (reference token_sort.cu:
+    random selection that preserves order)."""
+    # gumbel top-k without replacement, then sort to preserve token order
+    g = jax.random.gumbel(rng, (batch, seq_len))
+    _, idx = jax.lax.top_k(g, keep)
+    return jnp.sort(idx, axis=-1)
+
+
+def random_ltd_gather(x: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """[b, s, d] -> [b, keep, d] (reference gather_scatter.cu gather)."""
+    return jnp.take_along_axis(x, indices[..., None], axis=1)
+
+
+def random_ltd_scatter(full: jnp.ndarray, part: jnp.ndarray,
+                       indices: jnp.ndarray) -> jnp.ndarray:
+    """Scatter processed kept tokens back over the residual stream
+    (reference gather_scatter.cu scatter): dropped tokens keep their
+    incoming activations (skip connection)."""
+    b = full.shape[0]
+    batch_idx = jnp.arange(b)[:, None]
+    return full.at[batch_idx, indices].set(part)
+
+
+def apply_random_ltd(layer_fn, x: jnp.ndarray, rng, keep: int):
+    """Run ``layer_fn`` on a random token subset; identity elsewhere."""
+    b, s, _ = x.shape
+    if keep >= s:
+        return layer_fn(x)
+    idx = random_ltd_indices(rng, s, keep, b)
+    sub = random_ltd_gather(x, idx)
+    out = layer_fn(sub)
+    return random_ltd_scatter(x, out, idx)

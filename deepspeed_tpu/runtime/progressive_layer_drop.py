@@ -1,0 +1,59 @@
+"""Progressive Layer Drop (reference runtime/progressive_layer_drop.py).
+
+Same theta schedule: keep probability theta(t) = (1 - gamma)*exp(-gamma*t)
+... the reference uses theta(t) ramping from 0.5 to theta_bar with
+exponential decay constant gamma: theta(t) = (1 - theta_bar) * exp(-gamma*t)
++ theta_bar. Each transformer block i gets keep probability
+p_i = 1 - (i / L) * (1 - theta) (deeper layers dropped more).
+
+TPU integration: the per-layer keep decisions are a [n_layers] bernoulli
+mask folded into the layer scan — residual branches are scaled by
+mask / p (inverted-dropout style) so expectation is preserved and shapes
+stay static under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ProgressiveLayerDrop:
+    """theta schedule + state (reference class: update_state(global_step),
+    get_state/get_theta)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta_bar = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        def _prob(x, gamma, p):
+            return (1.0 - p) * np.exp(-gamma * x) + p
+
+        self.current_theta = float(_prob(global_step, self.gamma, self.theta_bar))
+        return self.current_theta
+
+
+def layer_keep_probs(theta: float, n_layers: int) -> jnp.ndarray:
+    """Per-layer keep probability: deeper layers dropped more aggressively
+    (reference PLD paper schedule: p_i = 1 - i/L * (1 - theta))."""
+    i = jnp.arange(n_layers, dtype=jnp.float32)
+    return 1.0 - (i / max(n_layers, 1)) * (1.0 - theta)
+
+
+def sample_layer_mask(rng, theta: float, n_layers: int) -> jnp.ndarray:
+    """[n_layers] float mask, each entry mask_i/p_i or 0 (inverted dropout
+    over whole layers — multiply each block's residual branch by it)."""
+    p = layer_keep_probs(theta, n_layers)
+    keep = jax.random.bernoulli(rng, p)
+    return jnp.where(keep, 1.0 / p, 0.0)

@@ -1,0 +1,82 @@
+"""Tiled linear layers (reference runtime/zero/tiling.py — TiledLinear
+splits one huge matmul into in/out-feature tiles so ZeRO-3 can fetch one
+tile's params at a time).
+
+On TPU the memory motivation maps to sharding, not manual tiling — a big
+linear is sharded over the ``model`` axis and GSPMD streams it — but the
+capability is preserved for parity and for the genuinely-huge-single-layer
+case (embedding/vocab projections beyond one core's HBM): the tile loop is
+a ``lax.map`` over parameter slices, so only one tile's output is live at a
+time and remat keeps backward memory flat.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TiledLinear:
+    """init/apply functional layer computing x @ W + b with W stored as
+    [out_splits, in_splits, in/in_splits, out/out_splits].
+
+    Reference parity: in_splits/out_splits args, input_is_already_split /
+    combine_out_splits behaviors (TiledLinear forward, tiling.py).
+    """
+
+    def __init__(self, in_features: int, out_features: int,
+                 in_splits: int = 1, out_splits: int = 1, use_bias: bool = True):
+        assert in_features % in_splits == 0, (in_features, in_splits)
+        assert out_features % out_splits == 0, (out_features, out_splits)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.in_splits = in_splits
+        self.out_splits = out_splits
+        self.use_bias = use_bias
+
+    def init(self, rng) -> Dict[str, Any]:
+        w = jax.random.normal(
+            rng, (self.out_splits, self.in_splits,
+                  self.in_features // self.in_splits,
+                  self.out_features // self.out_splits),
+            jnp.float32) / np.sqrt(self.in_features)
+        params = {"w": w}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.out_features,), jnp.float32)
+        return params
+
+    def apply(self, params, x, input_is_already_split: bool = False,
+              combine_out_splits: bool = True):
+        """x: [..., in_features] (or a tuple of in_splits chunks)."""
+        if input_is_already_split:
+            xs = jnp.stack(x, axis=0)  # [in_splits, ..., in/in_splits]
+        else:
+            xs = jnp.stack(jnp.split(x, self.in_splits, axis=-1), axis=0)
+
+        def out_tile(w_out):  # w_out: [in_splits, in_t, out_t]
+            # sum over input tiles; lax.map keeps one tile live at a time
+            def in_tile(acc_w):
+                acc, (w, xt) = acc_w
+                return acc + xt @ w
+
+            parts = jax.vmap(lambda w, xt: xt @ w)(w_out, xs)  # [in_splits, ..., out_t]
+            return jnp.sum(parts, axis=0)
+
+        outs = jax.lax.map(out_tile, params["w"])  # [out_splits, ..., out_t]
+        if combine_out_splits:
+            out = jnp.concatenate(list(outs), axis=-1)
+            if self.use_bias:
+                out = out + params["b"]
+            return out
+        return [outs[i] for i in range(self.out_splits)]
+
+    def full_weight(self, params) -> jnp.ndarray:
+        """Reassemble [in_features, out_features] (reference
+        copy_params_from inverse)."""
+        w = params["w"]  # [os, is, in_t, out_t]
+        return jnp.concatenate(
+            [jnp.concatenate(list(w[o]), axis=0) for o in range(self.out_splits)],
+            axis=1)

@@ -118,7 +118,7 @@ def test_max_context_rejected():
     with pytest.raises(ValueError):
         eng.put([1], [list(range(17))])
     with pytest.raises(ValueError):
-        RaggedConfig, RaggedInferenceEngine(model, _cfg(max_context=512))
+        RaggedInferenceEngine(model, _cfg(max_context=512))
 
 
 def test_pool_exhaustion_is_atomic():
@@ -131,6 +131,19 @@ def test_pool_exhaustion_is_atomic():
         eng.put([2], [list(range(16))])
     assert eng.seqs[2].seen == 0                    # untouched
     assert eng.seqs[1].seen == 8
+
+
+def test_query_reflects_capacity():
+    model = _llama()
+    eng = RaggedInferenceEngine(model, _cfg(max_context=32, token_budget=16))
+    tokens, free = eng.query(1)
+    assert tokens == 16 and free == eng.config.n_kv_blocks
+    eng.put([1], [list(range(30))])  # 16 + 14 across two steps
+    eng.put([1], [[]])
+    tokens, _ = eng.query(1)
+    assert tokens == 2                # only 2 context slots left
+    # known uid mid-stream: can_schedule charges only incremental blocks
+    assert eng.can_schedule([1], [2])
 
 
 def test_can_schedule_and_slot_exhaustion():
