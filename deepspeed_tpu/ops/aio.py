@@ -1,0 +1,87 @@
+"""Python surface of the native async-IO engine.
+
+Parity with the reference ``aio_handle`` API
+(csrc/aio/py_lib/deepspeed_py_aio_handle.cpp pybind exports: async_pread /
+async_pwrite / sync_pread / sync_pwrite / wait, plus the pinned-tensor
+manager). Buffers are numpy arrays (host memory IS the staging tier on
+TPU — device HBM transfers go through jax.device_put separately).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from .op_builder import AsyncIOBuilder
+
+
+class AsyncIOHandle:
+    """Thread-pool async file IO (reference aio_handle)."""
+
+    def __init__(self, n_threads: int = 4, queue_depth: int = 128):
+        self._builder = AsyncIOBuilder()
+        self._lib = self._builder.load()
+        self._h = self._lib.ds_aio_create(n_threads, queue_depth)
+        if not self._h:
+            raise RuntimeError("ds_aio_create failed")
+        self._buffers: Dict[int, np.ndarray] = {}  # keep alive while inflight
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ds_aio_destroy(self._h)
+        except Exception:
+            pass
+
+    # -- async ----------------------------------------------------------
+    def async_pread(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        assert buffer.flags["C_CONTIGUOUS"]
+        req = self._lib.ds_aio_pread(
+            self._h, path.encode(), buffer.ctypes.data_as(ctypes.c_void_p),
+            buffer.nbytes, offset)
+        if req < 0:
+            raise RuntimeError("aio queue full")
+        self._buffers[req] = buffer
+        return req
+
+    def async_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        assert buffer.flags["C_CONTIGUOUS"]
+        req = self._lib.ds_aio_pwrite(
+            self._h, path.encode(), buffer.ctypes.data_as(ctypes.c_void_p),
+            buffer.nbytes, offset)
+        if req < 0:
+            raise RuntimeError("aio queue full")
+        self._buffers[req] = buffer
+        return req
+
+    def wait(self, count: int = 1):
+        """Block for ``count`` completions; returns [(req_id, nbytes)]."""
+        ids = (ctypes.c_int64 * count)()
+        res = (ctypes.c_int64 * count)()
+        got = self._lib.ds_aio_wait(self._h, count, ids, res)
+        out = []
+        for i in range(got):
+            rid, r = int(ids[i]), int(res[i])
+            self._buffers.pop(rid, None)
+            if r < 0:
+                raise OSError(-r, os.strerror(-r))
+            out.append((rid, r))
+        return out
+
+    def poll(self) -> int:
+        return int(self._lib.ds_aio_poll(self._h))
+
+    def inflight(self) -> int:
+        return int(self._lib.ds_aio_inflight(self._h))
+
+    # -- sync convenience (reference sync_pread/sync_pwrite) -------------
+    def sync_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        self.async_pwrite(buffer, path, offset)
+        return self.wait(1)[0][1]
+
+    def sync_pread(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        self.async_pread(buffer, path, offset)
+        return self.wait(1)[0][1]

@@ -1,0 +1,126 @@
+"""Native op builder registry.
+
+Parity with the reference's ``op_builder/`` infrastructure (OpBuilder
+builder.py:108 with jit_load :460 via torch cpp_extension; per-op
+``is_compatible``/DS_BUILD_* gating; ``all_ops`` enumeration). Here native
+ops are plain shared libraries compiled with g++ on first use and bound via
+ctypes — no torch, no pybind. Pallas kernels don't go through this path
+(XLA compiles them); this registry exists for the genuinely host-native
+components (async IO today).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..utils.logging import log_dist, logger
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_BUILD_DIR = Path(os.environ.get(
+    "DS_BUILD_DIR", os.path.join(os.path.expanduser("~"), ".cache",
+                                 "deepspeed_tpu", "ops")))
+
+
+class OpBuilder:
+    """Compile-and-load for one native extension."""
+
+    NAME = "base"
+    SOURCES: List[str] = []            # relative to repo csrc/
+    EXTRA_FLAGS: List[str] = []
+
+    def __init__(self):
+        self._lib: Optional[ctypes.CDLL] = None
+
+    def absolute_sources(self) -> List[Path]:
+        return [_REPO_ROOT / "csrc" / s for s in self.SOURCES]
+
+    def lib_path(self) -> Path:
+        return _BUILD_DIR / f"lib{self.NAME}.so"
+
+    def is_compatible(self) -> bool:
+        """Whether this op can build here (reference is_compatible)."""
+        return all(p.is_file() for p in self.absolute_sources())
+
+    def _needs_build(self) -> bool:
+        out = self.lib_path()
+        if not out.is_file():
+            return True
+        mtime = out.stat().st_mtime
+        return any(p.stat().st_mtime > mtime for p in self.absolute_sources())
+
+    def build(self) -> Path:
+        out = self.lib_path()
+        out.parent.mkdir(parents=True, exist_ok=True)
+        srcs = [str(p) for p in self.absolute_sources()]
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-std=c++17",
+               *self.EXTRA_FLAGS, *srcs, "-o", str(out)]
+        log_dist(f"building native op {self.NAME}: {' '.join(cmd)}")
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build of {self.NAME} failed:\n{proc.stderr}")
+        return out
+
+    def load(self) -> ctypes.CDLL:
+        """Prebuilt-or-jit load (reference OpBuilder.load :442)."""
+        if self._lib is not None:
+            return self._lib
+        if not self.is_compatible():
+            raise RuntimeError(f"op {self.NAME}: sources missing "
+                               f"({self.SOURCES})")
+        if self._needs_build():
+            self.build()
+        self._lib = ctypes.CDLL(str(self.lib_path()))
+        self._configure(self._lib)
+        return self._lib
+
+    def _configure(self, lib: ctypes.CDLL) -> None:
+        """Subclasses declare argtypes/restypes."""
+
+
+class AsyncIOBuilder(OpBuilder):
+    """The reference AsyncIOBuilder (op_builder/async_io.py) analog."""
+
+    NAME = "ds_aio"
+    SOURCES = ["aio/ds_aio.cpp"]
+
+    def _configure(self, lib: ctypes.CDLL) -> None:
+        i64, p = ctypes.c_int64, ctypes.c_void_p
+        lib.ds_aio_create.restype = p
+        lib.ds_aio_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.ds_aio_destroy.argtypes = [p]
+        for fn in (lib.ds_aio_pread, lib.ds_aio_pwrite):
+            fn.restype = i64
+            fn.argtypes = [p, ctypes.c_char_p, ctypes.c_void_p, i64, i64]
+        lib.ds_aio_wait.restype = i64
+        lib.ds_aio_wait.argtypes = [p, i64, ctypes.POINTER(i64),
+                                    ctypes.POINTER(i64)]
+        lib.ds_aio_poll.restype = i64
+        lib.ds_aio_poll.argtypes = [p]
+        lib.ds_aio_inflight.restype = i64
+        lib.ds_aio_inflight.argtypes = [p]
+
+
+ALL_OPS: Dict[str, type] = {
+    AsyncIOBuilder.NAME: AsyncIOBuilder,
+}
+
+
+def get_op_builder(name: str) -> OpBuilder:
+    if name not in ALL_OPS:
+        raise KeyError(f"unknown op {name!r}; have {sorted(ALL_OPS)}")
+    return ALL_OPS[name]()
+
+
+def op_report() -> List:
+    """(name, compatible, built) rows for ds_report."""
+    rows = []
+    for name, cls in ALL_OPS.items():
+        b = cls()
+        rows.append((name, b.is_compatible(), b.lib_path().is_file()))
+    return rows
