@@ -1,26 +1,37 @@
 """Headline benchmark: Llama training throughput (tokens/sec + MFU) on the
-available TPU chip, via the full TrainEngine (ZeRO + bf16 + remat).
+available TPU chip, via the full TrainEngine (ZeRO + bf16 + remat + Pallas
+flash attention).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline is MFU / 0.45 — the north-star target from BASELINE.json is
 ZeRO-3 Llama-2-7B at >=45% MFU (v5p-64); single-chip we track the same MFU
 discipline on a model sized to chip HBM.
+
+Robustness (round-2 hardening): the TPU claim through the axon tunnel can
+fail or hang outright (round 1: BENCH_r01.json rc=1 with a backend
+UNAVAILABLE error). The parent process therefore never imports jax; it
+probes the TPU in a bounded subprocess (with one retry), runs the real
+benchmark in a child, and falls back to a CPU child — always emitting a
+valid JSON line on stdout, exit 0.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+PROBE_TIMEOUT_S = 240       # axon claim can take minutes through the tunnel
+PROBE_RETRIES = 2
+TPU_BENCH_TIMEOUT_S = 1500  # first compile is slow; warmup + 10 steps
+CPU_BENCH_TIMEOUT_S = 900
 
 
-def peak_flops_per_chip() -> float:
+def peak_flops_per_chip(device_kind: str) -> float:
     """bf16 peak for the local chip generation."""
-    import jax
-
-    kind = jax.devices()[0].device_kind.lower()
+    kind = device_kind.lower()
     if "v5 lite" in kind or "v5e" in kind:
         return 197e12
     if "v5p" in kind or "v5" in kind:
@@ -32,19 +43,23 @@ def peak_flops_per_chip() -> float:
     return 197e12  # conservative default
 
 
-def main():
+# ----------------------------------------------------------------------
+# child: the actual benchmark, run in whatever platform env the parent set
+def _child_main():
     import jax
-    import jax.numpy as jnp
+    import numpy as np
 
     import deepspeed_tpu as dst
     from deepspeed_tpu.models import Llama
+    from deepspeed_tpu.runtime.dataloader import shard_batch
 
     on_tpu = jax.devices()[0].platform == "tpu"
+    use_flash = os.environ.get("DST_BENCH_FLASH", "1" if on_tpu else "0") == "1"
     # ~350M-param Llama sized for a single v5e chip with Adam fp32 state
     if on_tpu:
         model = Llama("tiny", d_model=1024, n_layers=24, n_heads=16, n_kv_heads=16,
                       d_ff=2816, vocab_size=32000, max_seq_len=2048, remat=True,
-                      use_flash=False)
+                      use_flash=use_flash)
         batch_size, seq_len, steps, warmup = 8, 2048, 10, 2
     else:  # CPU smoke fallback
         model = Llama("tiny", n_layers=2, d_model=128, n_heads=4, n_kv_heads=4,
@@ -62,8 +77,6 @@ def main():
     engine, _, _, _ = dst.initialize(model=model, config=config, rng=jax.random.PRNGKey(0))
     tokens = np.random.default_rng(0).integers(0, model.config.vocab_size,
                                                (batch_size, seq_len)).astype(np.int32)
-    from deepspeed_tpu.runtime.dataloader import shard_batch
-
     batch = shard_batch({"input_ids": tokens}, engine.topo)
 
     for _ in range(warmup):
@@ -78,7 +91,7 @@ def main():
     tokens_per_step = batch_size * (seq_len - 1)
     tok_per_sec = tokens_per_step * steps / dt
     flops_per_token = model.config.flops_per_token(seq_len)
-    mfu = tok_per_sec * flops_per_token / peak_flops_per_chip()
+    mfu = tok_per_sec * flops_per_token / peak_flops_per_chip(jax.devices()[0].device_kind)
     print(json.dumps({
         "metric": "llama_350m_train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec, 1),
@@ -88,9 +101,92 @@ def main():
             "mfu": round(mfu, 4),
             "params": model.config.param_count(),
             "platform": jax.devices()[0].device_kind,
+            "flash_attention": use_flash,
             "step_ms": round(dt / steps * 1e3, 1),
         },
-    }))
+    }), flush=True)
+
+
+# ----------------------------------------------------------------------
+# parent: orchestration (no jax import here — the axon claim may hang)
+def _tpu_env() -> dict:
+    return dict(os.environ, DST_BENCH_CHILD="1")
+
+
+def _cpu_env() -> dict:
+    from __graft_entry__ import cpu_child_env  # single shared disarm recipe
+
+    return dict(cpu_child_env(), DST_BENCH_CHILD="1")
+
+
+def _run(cmd, env, timeout):
+    """Run a child, tee its output, return (rc, json_line|None)."""
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=timeout,
+                              capture_output=True, text=True,
+                              cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        print(f"[bench] child timed out after {timeout}s", file=sys.stderr)
+        return 124, None
+    sys.stderr.write(proc.stderr[-4000:] if proc.stderr else "")
+    line = None
+    for ln in (proc.stdout or "").splitlines():
+        ln = ln.strip()
+        if ln.startswith("{") and '"metric"' in ln:
+            line = ln
+    return proc.returncode, line
+
+
+def _probe_tpu() -> bool:
+    code = ("import jax; d = jax.devices()[0]; "
+            "print('PROBE_OK', d.platform, d.device_kind, flush=True)")
+    for attempt in range(PROBE_RETRIES):
+        try:
+            proc = subprocess.run([sys.executable, "-c", code], env=dict(os.environ),
+                                  timeout=PROBE_TIMEOUT_S, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            print(f"[bench] TPU probe attempt {attempt + 1} timed out", file=sys.stderr)
+            continue
+        if proc.returncode == 0 and "PROBE_OK tpu" in proc.stdout:
+            print(f"[bench] TPU probe ok: {proc.stdout.strip()}", file=sys.stderr)
+            return True
+        print(f"[bench] TPU probe attempt {attempt + 1} failed rc={proc.returncode}: "
+              f"{(proc.stderr or '').strip()[-500:]}", file=sys.stderr)
+    return False
+
+
+def main():
+    if os.environ.get("DST_BENCH_CHILD") == "1":
+        _child_main()
+        return 0
+
+    child = [sys.executable, os.path.abspath(__file__)]
+    if _probe_tpu():
+        rc, line = _run(child, dict(_tpu_env(), DST_BENCH_FLASH="1"), TPU_BENCH_TIMEOUT_S)
+        if line:
+            print(line, flush=True)
+            return 0
+        print("[bench] TPU bench with flash failed; retrying without flash",
+              file=sys.stderr)
+        rc, line = _run(child, dict(_tpu_env(), DST_BENCH_FLASH="0"), TPU_BENCH_TIMEOUT_S)
+        if line:
+            print(line, flush=True)
+            return 0
+        print("[bench] TPU bench failed outright; falling back to CPU", file=sys.stderr)
+
+    rc, line = _run(child, _cpu_env(), CPU_BENCH_TIMEOUT_S)
+    if line:
+        print(line, flush=True)
+        return 0
+    # last resort: still emit parseable JSON rather than crashing the driver
+    print(json.dumps({
+        "metric": "llama_350m_train_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "extra": {"error": f"all bench children failed (last rc={rc})"},
+    }), flush=True)
+    return 0
 
 
 if __name__ == "__main__":

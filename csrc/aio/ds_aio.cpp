@@ -12,6 +12,7 @@
 // Build: g++ -O2 -shared -fPIC -pthread ds_aio.cpp -o libds_aio.so
 
 #include <atomic>
+#include <cerrno>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -33,6 +34,7 @@ struct Request {
   void* buffer;
   int64_t nbytes;
   int64_t offset;
+  bool trunc;  // writes only: truncate file to offset+nbytes afterwards
 };
 
 struct Completion {
@@ -59,11 +61,11 @@ class AioEngine {
   }
 
   int64_t submit(bool is_read, const char* path, void* buffer, int64_t nbytes,
-                 int64_t offset) {
+                 int64_t offset, bool trunc = false) {
     std::unique_lock<std::mutex> lk(mu_);
     if ((int)pending_.size() >= queue_depth_) return -1;
     int64_t id = next_id_++;
-    pending_.push_back(Request{id, is_read, path, buffer, nbytes, offset});
+    pending_.push_back(Request{id, is_read, path, buffer, nbytes, offset, trunc});
     ++inflight_;
     cv_.notify_one();
     return id;
@@ -132,6 +134,16 @@ class AioEngine {
       if (n == 0) break;  // EOF
       total += n;
     }
+    // caller-requested truncation: drop any stale tail beyond this write
+    // (an explicit flag, not inferred from offset — inferring from offset==0
+    // would race with concurrent chunk writes to other offsets of the file)
+    if (!req.is_read && req.trunc) {
+      if (::ftruncate(fd, req.offset + total) != 0) {
+        int64_t err = -errno;
+        ::close(fd);
+        return err;
+      }
+    }
     ::close(fd);
     return total;
   }
@@ -165,6 +177,14 @@ int64_t ds_aio_pread(void* h, const char* path, void* buf, int64_t nbytes,
 int64_t ds_aio_pwrite(void* h, const char* path, void* buf, int64_t nbytes,
                       int64_t offset) {
   return static_cast<AioEngine*>(h)->submit(false, path, buf, nbytes, offset);
+}
+
+// write + truncate-to-end: for whole-file rewrites that must not leave a
+// stale tail when the new contents are shorter than the old file
+int64_t ds_aio_pwrite_trunc(void* h, const char* path, void* buf,
+                            int64_t nbytes, int64_t offset) {
+  return static_cast<AioEngine*>(h)->submit(false, path, buf, nbytes, offset,
+                                            /*trunc=*/true);
 }
 
 int64_t ds_aio_wait(void* h, int64_t count, int64_t* ids, int64_t* results) {

@@ -119,6 +119,7 @@ class InferenceEngine:
         self._prefill_fn = None
         self._decode_fn = None
         self._fwd_fn = None
+        self._rng = jax.random.PRNGKey(self.config.seed)
         self._alloc_fns: Dict[Tuple, Callable] = {}  # avoid re-jit per call
         log_dist(f"InferenceEngine up: tp={tp} dtype={self.config.dtype}")
 
@@ -184,7 +185,10 @@ class InferenceEngine:
             self._prefill_fn = self._build_prefill()
             self._decode_fn = self._build_decode()
         caches = self._alloc_cache(b, max_len)
-        rng, sub = jax.random.split(jax.random.PRNGKey(self.config.seed))
+        # per-engine RNG stream: successive generate() calls draw fresh keys
+        # (the reference engine likewise does not reseed per request)
+        self._rng, rng = jax.random.split(self._rng)
+        rng, sub = jax.random.split(rng)
         logits, caches = self._prefill_fn(self.params, input_ids, caches)
         next_tok = _sample(logits, sub, self.config.temperature,
                            self.config.top_k, self.config.top_p)

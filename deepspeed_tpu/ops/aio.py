@@ -47,28 +47,45 @@ class AsyncIOHandle:
         self._buffers[req] = buffer
         return req
 
-    def async_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+    def async_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0,
+                     truncate: bool = False) -> int:
+        """``truncate=True`` drops any stale file tail beyond this write —
+        use for whole-file rewrites (explicit, so chunked writers at other
+        offsets of the same file are never clobbered)."""
         assert buffer.flags["C_CONTIGUOUS"]
-        req = self._lib.ds_aio_pwrite(
-            self._h, path.encode(), buffer.ctypes.data_as(ctypes.c_void_p),
-            buffer.nbytes, offset)
+        fn = self._lib.ds_aio_pwrite_trunc if truncate else self._lib.ds_aio_pwrite
+        req = fn(self._h, path.encode(), buffer.ctypes.data_as(ctypes.c_void_p),
+                 buffer.nbytes, offset)
         if req < 0:
             raise RuntimeError("aio queue full")
         self._buffers[req] = buffer
         return req
 
     def wait(self, count: int = 1):
-        """Block for ``count`` completions; returns [(req_id, nbytes)]."""
+        """Block for ``count`` completions; returns [(req_id, nbytes)].
+
+        All ``count`` completions are drained (and their buffers released)
+        before any error is raised, so a failed request can't strand later
+        completions or leave buffers pinned.
+        """
         ids = (ctypes.c_int64 * count)()
         res = (ctypes.c_int64 * count)()
         got = self._lib.ds_aio_wait(self._h, count, ids, res)
-        out = []
+        out, errors = [], []
         for i in range(got):
             rid, r = int(ids[i]), int(res[i])
             self._buffers.pop(rid, None)
             if r < 0:
-                raise OSError(-r, os.strerror(-r))
-            out.append((rid, r))
+                errors.append((rid, -r))
+            else:
+                out.append((rid, r))
+        if errors:
+            rid, err = errors[0]
+            exc = OSError(err, f"aio request {rid} (+{len(errors) - 1} more): "
+                          + os.strerror(err))
+            exc.completed = out    # successful (req_id, nbytes) pairs
+            exc.failed = errors    # (req_id, errno) pairs
+            raise exc
         return out
 
     def poll(self) -> int:

@@ -94,7 +94,7 @@ class AsyncIOBuilder(OpBuilder):
         lib.ds_aio_create.restype = p
         lib.ds_aio_create.argtypes = [ctypes.c_int, ctypes.c_int]
         lib.ds_aio_destroy.argtypes = [p]
-        for fn in (lib.ds_aio_pread, lib.ds_aio_pwrite):
+        for fn in (lib.ds_aio_pread, lib.ds_aio_pwrite, lib.ds_aio_pwrite_trunc):
             fn.restype = i64
             fn.argtypes = [p, ctypes.c_char_p, ctypes.c_void_p, i64, i64]
         lib.ds_aio_wait.restype = i64

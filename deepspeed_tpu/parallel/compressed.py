@@ -84,6 +84,13 @@ def int8_allreduce(x: jnp.ndarray, worker_error: jnp.ndarray,
     from ..ops.quantizer import dequantize_blockwise, quantize_blockwise
 
     world = jax.lax.psum(1, axis_name)
+    n = x.size
+    # trace-time divisibility guards (otherwise the reshapes below fail with
+    # an opaque error, or scales misalign with payload chunks)
+    assert n % (world * block) == 0, (
+        f"int8_allreduce: size {n} must be divisible by world*block "
+        f"({world}*{block}) — pad the input or use tree_onebit_allreduce's "
+        f"dense fallback for small tensors")
     corrected = x + worker_error
     q, s, _ = quantize_blockwise(corrected, bits=8, block=block)
     deq = dequantize_blockwise(q, s, block=block)

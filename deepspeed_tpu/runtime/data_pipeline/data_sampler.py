@@ -56,17 +56,25 @@ class DeepSpeedDataSampler:
         rng = np.random.default_rng(self.seed + self.epoch)
         order = rng.permutation(self.dataset_size)
         per_rank = self.batch_size // self.dp_size
-        cursor = 0
+        # Samples move exactly once from locked -> queue as the curriculum
+        # difficulty grows (the reference appends newly unlocked data the same
+        # way); consuming from the queue head can then never re-yield or skip
+        # a sample, unlike indexing a recomputed eligible array with a cursor.
+        unlocked = np.zeros(self.dataset_size, dtype=bool)
+        queue: list = []
         while True:
             difficulty = self.curriculum.update_difficulty(self.global_step)
-            eligible = order[self.difficulties[order] <= difficulty]
-            if cursor + self.batch_size > len(eligible):
-                if self.drop_last or cursor >= len(eligible):
+            newly = order[(self.difficulties[order] <= difficulty) & ~unlocked[order]]
+            if newly.size:
+                unlocked[newly] = True
+                queue.extend(newly.tolist())
+            if len(queue) < self.batch_size:
+                if self.drop_last or not queue:
                     return
-                batch = eligible[cursor:]
+                batch, queue = np.asarray(queue), []
             else:
-                batch = eligible[cursor:cursor + self.batch_size]
-            cursor += self.batch_size
+                batch = np.asarray(queue[:self.batch_size])
+                queue = queue[self.batch_size:]
             self.global_step += 1
             yield batch[self.dp_rank * per_rank:(self.dp_rank + 1) * per_rank]
 

@@ -45,7 +45,9 @@ class AsyncTensorSwapper:
         """Async write; array must stay alive until wait_all (the handle
         pins it)."""
         arr = np.ascontiguousarray(array)
-        self.handle.async_pwrite(arr, self._path(key))
+        # whole-file rewrite: truncate so a smaller tensor re-swapped to the
+        # same key can't leave a stale tail on disk
+        self.handle.async_pwrite(arr, self._path(key), truncate=True)
         self._pending += 1
 
     def swap_in(self, key: str, shape, dtype) -> np.ndarray:
@@ -57,7 +59,15 @@ class AsyncTensorSwapper:
 
     def wait_all(self) -> None:
         while self._pending > 0:
-            got = self.handle.wait(1)
+            try:
+                got = self.handle.wait(1)
+            except OSError as e:
+                # the handle drains all completions before raising; account
+                # for both successes and failures so a failed IO can't leave
+                # _pending stuck forever
+                self._pending -= len(getattr(e, "completed", [])) + \
+                    len(getattr(e, "failed", [(None, None)]))
+                raise
             self._pending -= len(got)
 
     def bytes_on_disk(self) -> int:

@@ -209,6 +209,27 @@ def test_data_sampler_curriculum_and_dp_shard():
         assert not set(b0) & set(b1)
 
 
+def test_data_sampler_no_duplicates_or_skips_as_curriculum_grows():
+    """Regression: samples unlocking mid-epoch must neither re-yield already
+    consumed samples nor permanently skip new ones (advisor round-1 finding:
+    a flat cursor into a recomputed eligible array shifts under growth)."""
+    from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                     DeepSpeedDataSampler)
+
+    n = 96
+    difficulties = np.arange(n) % 8
+    cur_cfg = {"curriculum_type": "fixed_linear",
+               "min_difficulty": 1, "max_difficulty": 8,
+               "schedule_config": {"total_curriculum_step": 6,
+                                   "difficulty_step": 1}}
+    s = DeepSpeedDataSampler(n, difficulties, CurriculumScheduler(cur_cfg),
+                             batch_size=4, data_parallel_rank=0,
+                             data_parallel_size=1, seed=7, drop_last=False)
+    seen = np.concatenate(list(s))
+    assert len(seen) == len(set(seen.tolist())), "duplicate samples yielded"
+    assert set(seen.tolist()) == set(range(n)), "samples permanently skipped"
+
+
 def test_random_ltd():
     from deepspeed_tpu.runtime.data_pipeline import (
         RandomLTDScheduler, random_ltd_gather, random_ltd_scatter)
