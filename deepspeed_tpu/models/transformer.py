@@ -111,6 +111,7 @@ class Transformer:
         self.config = config
         self._mesh = None
         self._seq_size = 1
+        self._tp_size = 1
         self._pipe_size = 1
 
     def bind_topology(self, topo) -> "Transformer":
@@ -119,6 +120,7 @@ class Transformer:
         ``deepspeed_tpu.initialize``)."""
         self._mesh = topo.mesh
         self._seq_size = topo.sequence_parallel_size
+        self._tp_size = topo.model_parallel_size
         self._pipe_size = topo.pipe_parallel_size
         if self._pipe_size > 1:
             assert self.config.n_layers % self._pipe_size == 0, (
@@ -364,10 +366,26 @@ class Transformer:
     # pipeline-parallel path (reference: runtime/pipe/engine.py train_batch)
     def _embed(self, params, tokens, positions=None):
         """Token (+ learned position) embedding: [b, s] -> [b, s, d] in the
-        compute dtype."""
+        compute dtype.
+
+        With the table vocab-sharded over 'model' (partition_specs), a plain
+        gather forces SPMD "involuntary full rematerialization" (replicate
+        the table, then repartition). The one-hot contraction keeps the
+        lookup sharded: each shard contracts its vocab slice on the MXU and
+        GSPMD inserts one psum of [b, s, d] — never materializing the full
+        table on any chip (the Megatron VocabParallelEmbedding semantics,
+        expressed as a matmul instead of masked gather + allreduce).
+        """
         c = self.config
-        x = params["tok_embed"][tokens]
         compute_dtype = params["layers"]["wq"].dtype
+        if self._tp_size > 1:
+            # clip for parity with the gather branch (jnp indexing clamps
+            # out-of-range ids; unclipped one_hot would zero them instead)
+            safe = jnp.clip(tokens, 0, c.vocab_size - 1)
+            one_hot = jax.nn.one_hot(safe, c.vocab_size, dtype=compute_dtype)
+            x = one_hot @ params["tok_embed"].astype(compute_dtype)
+        else:
+            x = params["tok_embed"][tokens]
         x = x.astype(compute_dtype)
         if c.position == "learned":
             s = tokens.shape[-1]
@@ -412,7 +430,14 @@ class Transformer:
             {"inputs": inputs, "targets": targets,
              **({"mask": mask} if mask is not None else {})},
             num_microbatches)
-        xs = jax.vmap(lambda t: self._embed(params, t))(mb["inputs"])  # [M, b/M, s, d]
+        # lax.map (sequential) under TP bounds the one-hot embed transient to
+        # one micro-batch's [b/M, s, vocab]; vmap would materialize all M at
+        # once — a ~vocab/d_model blowup at the pipeline entrance
+        if self._tp_size > 1:
+            xs = jax.lax.map(lambda t: self._embed(params, t), mb["inputs"])
+        else:
+            xs = jax.vmap(lambda t: self._embed(params, t))(mb["inputs"])
+        # xs: [M, b/M, s, d]
         angles = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta) \
             if c.position == "rope" else jnp.zeros((1, 1), jnp.float32)
         stage_params = stack_stage_params(params["layers"], self._pipe_size)

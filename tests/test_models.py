@@ -133,3 +133,12 @@ def test_tp_matches_single_device_math():
         is_leaf=lambda x: not isinstance(x, dict)))
     out = jax.jit(model.apply)(sharded, batch)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4)
+
+    # bound topology activates the one-hot (vocab-parallel) embedding path;
+    # numerics must match the gather path exactly (incl. clamped ids)
+    model.bind_topology(topo)
+    assert model._tp_size == 8
+    out_oh = jax.jit(model.apply)(sharded, batch)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out_oh),
+                               rtol=2e-4, atol=2e-4)
+    model._tp_size = 1  # unbind for other tests sharing the fixture
