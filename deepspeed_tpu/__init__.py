@@ -87,8 +87,28 @@ def initialize(*,
 
 
 def init_inference(model: Any = None, config: Any = None, **kwargs):
-    """Parity with ``deepspeed.init_inference`` (reference __init__.py:269)."""
+    """Parity with ``deepspeed.init_inference`` (reference __init__.py:269).
+
+    ``model`` may be a model object (random init), a ``(model, params)``
+    pair (e.g. from :func:`deepspeed_tpu.checkpoint.from_pretrained`), or
+    None with a ``checkpoint`` entry in the config pointing at an HF
+    checkpoint directory (reference InferenceConfig.checkpoint +
+    load_model_with_checkpoint, inference/engine.py:324).
+    """
     from .inference.engine import InferenceEngine, InferenceConfig
 
     icfg = InferenceConfig.from_any(config, **kwargs)
-    return InferenceEngine(model=model, config=icfg)
+    params = None
+    if isinstance(model, tuple):
+        model, params = model
+    if icfg.extras.get("checkpoint") and params is None:
+        from .checkpoint import from_pretrained
+
+        loaded_model, params = from_pretrained(icfg.extras["checkpoint"],
+                                               dtype=icfg.jnp_dtype)
+        # a user-supplied model keeps serving (its config must match the
+        # checkpoint — shape mismatches fail loudly at first forward);
+        # otherwise the checkpoint's own config builds the model
+        if model is None:
+            model = loaded_model
+    return InferenceEngine(model=model, config=icfg, params=params)

@@ -1,0 +1,4 @@
+"""Checkpoint tooling (reference ``deepspeed/checkpoint/``): HF pretrained
+ingestion, universal-checkpoint conversion surface."""
+
+from .hf import from_pretrained, hf_config, map_hf_params, read_hf_state  # noqa: F401

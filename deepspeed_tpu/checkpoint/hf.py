@@ -1,0 +1,319 @@
+"""Pretrained-checkpoint ingestion: HuggingFace -> native stacked layout.
+
+Parity with the reference's checkpoint-loading surface:
+``module_inject/load_checkpoint.py`` (v1 sharded HF loading into injected
+containers), ``inference/v2/model_implementations/flat_model_helpers.py``
+(FastGen parses HF checkpoints into per-layer containers) and
+``inference/engine.py:324`` (``load_model_with_checkpoint``). TPU-first
+design: instead of per-module tensor surgery on a live torch model, HF
+tensors are mapped once into the native stacked-layer pytree
+([n_layers, ...] leading dim, see models/transformer.py init) and placed
+with ``jax.device_put`` under the model's PartitionSpecs — GSPMD handles
+TP/ZeRO sharding from there; no injection machinery.
+
+Supported families: Llama/Mistral (RMSNorm+RoPE+SwiGLU+GQA), GPT-2
+(Conv1D fused qkv), OPT (learned positions with the +2 offset, ReLU).
+
+Formats: ``*.safetensors`` (single or index-sharded) and
+``pytorch_model.bin`` (torch pickle, single or index-sharded).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["read_hf_state", "hf_config", "map_hf_params", "from_pretrained"]
+
+
+# ----------------------------------------------------------------------
+# raw tensor reading
+def _to_numpy(t) -> np.ndarray:
+    """torch tensor -> numpy (bf16 upcast through fp32, torch has no
+    numpy bf16 bridge)."""
+    import torch
+
+    if t.dtype == torch.bfloat16:
+        return t.to(torch.float32).numpy()
+    return t.numpy()
+
+
+def read_hf_state(model_dir: str) -> Dict[str, np.ndarray]:
+    """Read every tensor of an HF checkpoint directory into numpy."""
+    d = str(model_dir)
+    state: Dict[str, np.ndarray] = {}
+
+    st_index = os.path.join(d, "model.safetensors.index.json")
+    pt_index = os.path.join(d, "pytorch_model.bin.index.json")
+    if os.path.exists(st_index) or os.path.exists(pt_index):
+        index = st_index if os.path.exists(st_index) else pt_index
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        for shard in sorted(set(weight_map.values())):
+            state.update(_read_one(os.path.join(d, shard)))
+        return state
+
+    for name in ("model.safetensors", "pytorch_model.bin"):
+        path = os.path.join(d, name)
+        if os.path.exists(path):
+            return _read_one(path)
+    raise FileNotFoundError(
+        f"no model.safetensors / pytorch_model.bin (or index) under {d}")
+
+
+def _read_one(path: str) -> Dict[str, np.ndarray]:
+    if path.endswith(".safetensors"):
+        from safetensors import safe_open
+
+        out = {}
+        with safe_open(path, framework="np") as f:
+            for key in f.keys():
+                try:
+                    out[key] = f.get_tensor(key)
+                except (TypeError, ValueError):
+                    # bf16 et al. unsupported by the numpy framework bridge
+                    out[key] = None
+        if any(v is None for v in out.values()):
+            with safe_open(path, framework="pt") as f:
+                for key, v in list(out.items()):
+                    if v is None:
+                        out[key] = _to_numpy(f.get_tensor(key))
+        return out
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    return {k: _to_numpy(v) for k, v in sd.items()}
+
+
+# ----------------------------------------------------------------------
+# config translation
+def hf_config(model_dir: str):
+    """Parse HF config.json -> (family, TransformerConfig)."""
+    from ..models.transformer import TransformerConfig
+
+    with open(os.path.join(str(model_dir), "config.json")) as f:
+        hc = json.load(f)
+    family = hc.get("model_type", "")
+    if family in ("llama", "mistral"):
+        # loud failure beats silently-wrong logits for unsupported variants
+        if hc.get("rope_scaling"):
+            raise NotImplementedError(
+                f"rope_scaling={hc['rope_scaling']} not supported "
+                "(plain RoPE only)")
+        if hc.get("attention_bias"):
+            raise NotImplementedError("llama attention_bias=true not supported")
+        max_seq = hc.get("max_position_embeddings", 2048)
+        window = hc.get("sliding_window")
+        if window is not None and window < max_seq:
+            # full attention == sliding-window attention while seq <= window;
+            # cap the usable context instead of serving wrong long-range math
+            max_seq = window
+        cfg = TransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
+            n_layers=hc["num_hidden_layers"], n_heads=hc["num_attention_heads"],
+            n_kv_heads=hc.get("num_key_value_heads", hc["num_attention_heads"]),
+            d_ff=hc["intermediate_size"],
+            max_seq_len=max_seq,
+            norm="rms", activation="silu_glu", position="rope",
+            rope_theta=hc.get("rope_theta", 10000.0),
+            tie_embeddings=hc.get("tie_word_embeddings", False),
+            use_bias=False, norm_eps=hc.get("rms_norm_eps", 1e-6))
+    elif family == "gpt2":
+        cfg = TransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["n_embd"],
+            n_layers=hc["n_layer"], n_heads=hc["n_head"],
+            d_ff=hc.get("n_inner") or 4 * hc["n_embd"],
+            max_seq_len=hc.get("n_positions", 1024),
+            norm="layer", activation="gelu", position="learned",
+            tie_embeddings=True, use_bias=True,
+            norm_eps=hc.get("layer_norm_epsilon", 1e-5))
+    elif family == "opt":
+        if not hc.get("do_layer_norm_before", True):
+            raise NotImplementedError(
+                "post-norm OPT (do_layer_norm_before=false, the 350m variant) "
+                "not supported")
+        act = hc.get("activation_function", "relu")
+        cfg = TransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
+            n_layers=hc["num_hidden_layers"], n_heads=hc["num_attention_heads"],
+            d_ff=hc.get("ffn_dim", 4 * hc["hidden_size"]),
+            max_seq_len=hc.get("max_position_embeddings", 2048),
+            norm="layer", activation="relu" if act == "relu" else "gelu",
+            position="learned",
+            tie_embeddings=hc.get("tie_word_embeddings", True),
+            use_bias=hc.get("enable_bias", True), norm_eps=1e-5)
+        if hc["hidden_size"] != hc.get("word_embed_proj_dim", hc["hidden_size"]):
+            raise NotImplementedError("OPT word_embed_proj_dim != hidden_size")
+    else:
+        raise ValueError(f"unsupported HF model_type '{family}' "
+                         f"(supported: llama, mistral, gpt2, opt)")
+    return family, cfg
+
+
+# ----------------------------------------------------------------------
+# weight mapping (per family)
+def _stack(state, fmt: str, n: int, transpose=False) -> np.ndarray:
+    """Stack per-layer tensors into one [n, ...] array."""
+    arrs = [state[fmt.format(i)] for i in range(n)]
+    if transpose:
+        arrs = [a.T for a in arrs]
+    return np.stack(arrs)
+
+
+def _map_llama(state, c) -> Dict[str, Any]:
+    n = c.n_layers
+    pre = "model." if "model.embed_tokens.weight" in state else ""
+    L = pre + "layers.{}."
+    layers = {
+        "attn_norm_w": _stack(state, L + "input_layernorm.weight", n),
+        # torch Linear stores [out, in]; native layout is [in, out]
+        "wq": _stack(state, L + "self_attn.q_proj.weight", n, transpose=True),
+        "wk": _stack(state, L + "self_attn.k_proj.weight", n, transpose=True),
+        "wv": _stack(state, L + "self_attn.v_proj.weight", n, transpose=True),
+        "wo": _stack(state, L + "self_attn.o_proj.weight", n, transpose=True),
+        "mlp_norm_w": _stack(state, L + "post_attention_layernorm.weight", n),
+        "w_gate": _stack(state, L + "mlp.gate_proj.weight", n, transpose=True),
+        "w_up": _stack(state, L + "mlp.up_proj.weight", n, transpose=True),
+        "w_down": _stack(state, L + "mlp.down_proj.weight", n, transpose=True),
+    }
+    params = {
+        "tok_embed": state[pre + "embed_tokens.weight"],
+        "layers": layers,
+        "final_norm_w": state[pre + "norm.weight"],
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = (state["lm_head.weight"]
+                             if "lm_head.weight" in state
+                             else state[pre + "embed_tokens.weight"]).T
+    return params
+
+
+def _map_gpt2(state, c) -> Dict[str, Any]:
+    n, d = c.n_layers, c.d_model
+    pre = "transformer." if "transformer.wte.weight" in state else ""
+    L = pre + "h.{}."
+    # HF Conv1D stores [in, out] — native orientation already; fused c_attn
+    # splits [d, 3d] -> q, k, v along the output dim
+    qkv_w = [state[(L + "attn.c_attn.weight").format(i)] for i in range(n)]
+    qkv_b = [state[(L + "attn.c_attn.bias").format(i)] for i in range(n)]
+    layers = {
+        "attn_norm_w": _stack(state, L + "ln_1.weight", n),
+        "attn_norm_b": _stack(state, L + "ln_1.bias", n),
+        "wq": np.stack([w[:, :d] for w in qkv_w]),
+        "wk": np.stack([w[:, d:2 * d] for w in qkv_w]),
+        "wv": np.stack([w[:, 2 * d:] for w in qkv_w]),
+        "bq": np.stack([b[:d] for b in qkv_b]),
+        "bk": np.stack([b[d:2 * d] for b in qkv_b]),
+        "bv": np.stack([b[2 * d:] for b in qkv_b]),
+        "wo": _stack(state, L + "attn.c_proj.weight", n),
+        "bo": _stack(state, L + "attn.c_proj.bias", n),
+        "mlp_norm_w": _stack(state, L + "ln_2.weight", n),
+        "mlp_norm_b": _stack(state, L + "ln_2.bias", n),
+        "w_up": _stack(state, L + "mlp.c_fc.weight", n),
+        "b_up": _stack(state, L + "mlp.c_fc.bias", n),
+        "w_down": _stack(state, L + "mlp.c_proj.weight", n),
+        "b_down": _stack(state, L + "mlp.c_proj.bias", n),
+    }
+    return {
+        "tok_embed": state[pre + "wte.weight"],
+        "pos_embed": state[pre + "wpe.weight"],
+        "layers": layers,
+        "final_norm_w": state[pre + "ln_f.weight"],
+        "final_norm_b": state[pre + "ln_f.bias"],
+    }
+
+
+def _map_opt(state, c) -> Dict[str, Any]:
+    n = c.n_layers
+    pre = "model." if "model.decoder.embed_tokens.weight" in state else ""
+    D = pre + "decoder."
+    L = D + "layers.{}."
+    layers = {
+        "attn_norm_w": _stack(state, L + "self_attn_layer_norm.weight", n),
+        "attn_norm_b": _stack(state, L + "self_attn_layer_norm.bias", n),
+        "wq": _stack(state, L + "self_attn.q_proj.weight", n, transpose=True),
+        "wk": _stack(state, L + "self_attn.k_proj.weight", n, transpose=True),
+        "wv": _stack(state, L + "self_attn.v_proj.weight", n, transpose=True),
+        "bq": _stack(state, L + "self_attn.q_proj.bias", n),
+        "bk": _stack(state, L + "self_attn.k_proj.bias", n),
+        "bv": _stack(state, L + "self_attn.v_proj.bias", n),
+        "wo": _stack(state, L + "self_attn.out_proj.weight", n, transpose=True),
+        "bo": _stack(state, L + "self_attn.out_proj.bias", n),
+        "mlp_norm_w": _stack(state, L + "final_layer_norm.weight", n),
+        "mlp_norm_b": _stack(state, L + "final_layer_norm.bias", n),
+        "w_up": _stack(state, L + "fc1.weight", n, transpose=True),
+        "b_up": _stack(state, L + "fc1.bias", n),
+        "w_down": _stack(state, L + "fc2.weight", n, transpose=True),
+        "b_down": _stack(state, L + "fc2.bias", n),
+    }
+    params = {
+        "tok_embed": state[D + "embed_tokens.weight"],
+        # OPTLearnedPositionalEmbedding carries a +2 offset: rows 0-1 unused
+        "pos_embed": state[D + "embed_positions.weight"][2:],
+        "layers": layers,
+        "final_norm_w": state[D + "final_layer_norm.weight"],
+        "final_norm_b": state[D + "final_layer_norm.bias"],
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = (state["lm_head.weight"] if "lm_head.weight" in state
+                             else state[D + "embed_tokens.weight"]).T
+    return params
+
+
+_MAPPERS: Dict[str, Callable] = {
+    "llama": _map_llama, "mistral": _map_llama,
+    "gpt2": _map_gpt2, "opt": _map_opt,
+}
+
+
+def map_hf_params(state: Dict[str, np.ndarray], family: str, config) -> Dict[str, Any]:
+    """HF state dict -> native stacked params pytree (numpy, fp32)."""
+    if family not in _MAPPERS:
+        raise ValueError(f"unsupported family '{family}'")
+    return _MAPPERS[family](state, config)
+
+
+# ----------------------------------------------------------------------
+def from_pretrained(model_dir: str, dtype=None, topology=None,
+                    ) -> Tuple[Any, Dict[str, Any]]:
+    """Load an HF checkpoint directory into (Transformer, params).
+
+    ``dtype``: computation dtype for the params (default bfloat16).
+    ``topology``: optional Topology — params are placed with the model's
+    TP/pipe PartitionSpecs over its mesh (the auto-TP analog: sharded
+    serving is data placement, not module surgery).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.transformer import Transformer
+
+    import ml_dtypes
+
+    dtype = dtype if dtype is not None else jnp.bfloat16
+    family, cfg = hf_config(model_dir)
+    state = read_hf_state(model_dir)
+    host_params = map_hf_params(state, family, cfg)
+    model = Transformer(cfg)
+    # cast on host (ml_dtypes covers bf16 numpy) so each leaf ships to the
+    # devices already-sharded — never materializing a full unsharded param
+    # in one chip's HBM
+    np_dtype = np.dtype(ml_dtypes.bfloat16) if dtype == jnp.bfloat16 \
+        else np.dtype(dtype)
+    host_params = jax.tree_util.tree_map(
+        lambda a: np.ascontiguousarray(a.astype(np_dtype)), host_params)
+    if topology is not None:
+        model.bind_topology(topology)
+        from jax.sharding import NamedSharding
+
+        specs = model.partition_specs(host_params, topology)
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(topology.mesh, s), specs,
+            is_leaf=lambda x: not isinstance(x, dict))
+        params = jax.tree_util.tree_map(jax.device_put, host_params, shardings)
+    else:
+        params = jax.tree_util.tree_map(jax.device_put, host_params)
+    return model, params

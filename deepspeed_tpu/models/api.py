@@ -1,0 +1,50 @@
+"""Model-protocol adapters.
+
+``initialize()`` accepts any object with ``.init(rng) -> params`` and
+``.loss(params, batch, rng) -> scalar`` (plus optional
+``.partition_specs`` / ``.bind_topology``). These adapters wrap foreign
+model definitions into that protocol — the analog of the reference
+accepting any ``nn.Module`` (runtime/engine.py:175 wraps the client
+module directly).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+
+class FromFlax:
+    """Wrap a flax ``nn.Module`` into the native model protocol.
+
+    ``loss_fn(logits_or_output, batch) -> scalar`` defines the objective on
+    the module's output; by default the module's output is assumed to be
+    the scalar loss itself when called as ``module.apply(variables, batch)``.
+    """
+
+    def __init__(self, module: Any, example_batch: Any = None,
+                 loss_fn: Optional[Callable] = None,
+                 init_args: Tuple = (), apply_kwargs: Optional[dict] = None):
+        self.module = module
+        self.example_batch = example_batch
+        self.loss_fn = loss_fn
+        self.init_args = init_args
+        self.apply_kwargs = apply_kwargs or {}
+
+    def init(self, rng, *args):
+        batch = args[0] if args else self.example_batch
+        assert batch is not None, \
+            "FromFlax.init needs an example batch (pass example_batch=...)"
+        return self.module.init(rng, batch, *self.init_args)
+
+    def loss(self, params, batch, rng=None):
+        out = self.module.apply(params, batch, *self.init_args,
+                                **self.apply_kwargs)
+        if self.loss_fn is not None:
+            return self.loss_fn(out, batch)
+        return out
+
+
+def from_flax(module: Any, example_batch: Any = None,
+              loss_fn: Optional[Callable] = None, **kw) -> FromFlax:
+    """One-line flax adapter: ``initialize(model=from_flax(mod, batch, ce))``."""
+    return FromFlax(module, example_batch, loss_fn, **kw)

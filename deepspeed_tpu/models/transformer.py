@@ -48,7 +48,7 @@ class TransformerConfig:
     d_ff: Optional[int] = None        # default 4*d (gelu) or 8/3*d rounded (glu)
     max_seq_len: int = 2048
     norm: str = "rms"                 # rms | layer
-    activation: str = "silu_glu"      # silu_glu | gelu
+    activation: str = "silu_glu"      # silu_glu | gelu | relu
     position: str = "rope"            # rope | learned
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
@@ -253,7 +253,7 @@ class Transformer:
             up = h @ lp["w_up"]
             if c.use_bias:
                 up = up + lp["b_up"]
-            up = jax.nn.gelu(up)
+            up = jax.nn.relu(up) if c.activation == "relu" else jax.nn.gelu(up)
         down = up @ lp["w_down"]
         if c.use_bias:
             down = down + lp["b_down"]
