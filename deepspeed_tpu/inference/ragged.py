@@ -18,11 +18,10 @@ Reference surface (deepspeed/inference/v2/):
 TPU-first redesign: CUDA FastGen builds variable "ragged atoms" per step and
 launches paged-attention kernels over them. Under XLA every shape must be
 static, so the step program is fixed at ``[token_budget]`` tokens and
-``[max_seqs]`` sequence slots; inactive lanes are masked. The paged
-attention itself gathers each token's block list from the pool — the jnp
-formulation below vectorizes over tokens (fine at decode batch sizes); a
-Pallas kernel with scalar-prefetched block tables is the drop-in upgrade
-path (ops/pallas/paged_attention.py).
+``[max_seqs]`` sequence slots; inactive lanes are masked. Paged attention
+dispatches to the Pallas kernel with scalar-prefetched block tables
+(``ops/pallas/paged_attention.py``) on TPU; elsewhere a jnp gather
+formulation with identical semantics serves as fallback and oracle.
 """
 
 from __future__ import annotations
@@ -37,6 +36,16 @@ import numpy as np
 from ..ops.norms import layer_norm, rms_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
 from ..utils.logging import log_dist
+
+
+def _use_pallas_paged(head_dim: int, block: int, dtype) -> bool:
+    """Pallas paged kernel eligibility: real TPU + tileable page shape."""
+    from ..ops.attention import _on_tpu
+
+    if not _on_tpu():
+        return False
+    sublane = 32 // jnp.dtype(dtype).itemsize  # 8 fp32 / 16 any 16-bit dtype
+    return head_dim in (64, 128, 256) and block % sublane == 0
 
 
 # ----------------------------------------------------------------------
@@ -134,12 +143,13 @@ class RaggedInferenceEngine:
         self.seqs: Dict[int, SequenceDescriptor] = {}
         self._free_slots = list(range(cfg.max_seqs))
         self.max_pages = cfg.max_context // cfg.kv_block_size
-        # paged KV pool [n_layers, n_blocks + 1, block, hkv, hd]; the last
-        # page is a scratch sink for masked-out batch lanes (duplicate
-        # scatters with mixed old/new values are undefined — inactive lanes
-        # must never alias a live page)
-        pool_shape = (c.n_layers, cfg.n_kv_blocks + 1, cfg.kv_block_size,
-                      c.n_kv_heads, c.head_dim)
+        # paged KV pool [n_layers, n_blocks + 1, hkv, block, hd] — (block, hd)
+        # minor-most so each page is a native VMEM tile for the Pallas paged
+        # kernel. The last page is a scratch sink for masked-out batch lanes
+        # (duplicate scatters with mixed old/new values are undefined —
+        # inactive lanes must never alias a live page)
+        pool_shape = (c.n_layers, cfg.n_kv_blocks + 1, c.n_kv_heads,
+                      cfg.kv_block_size, c.head_dim)
         self.kv_pool = (jnp.zeros(pool_shape, cfg.dtype),
                         jnp.zeros(pool_shape, cfg.dtype))
         self._step_fn = None
@@ -302,10 +312,14 @@ class RaggedInferenceEngine:
 
     # -- the compiled ragged step ----------------------------------------
     def _build_step(self):
+        from ..ops.pallas.paged_attention import (paged_attention,
+                                                  paged_attention_reference)
+
         model = self.model
         c = model.config
         cfg = self.config
         bs = cfg.kv_block_size
+        use_pallas = _use_pallas_paged(c.head_dim, bs, self.config.dtype)
 
         def norm(x, w, b=None):
             return rms_norm(x, w, c.norm_eps) if c.norm == "rms" \
@@ -347,29 +361,21 @@ class RaggedInferenceEngine:
                 row = positions % bs
                 # inactive lanes scatter into the scratch sink page
                 page = jnp.where(active, page, cfg.n_kv_blocks)
-                kp_l = kp[li].at[page, row].set(kk.astype(kp.dtype))
-                vp_l = vp[li].at[page, row].set(vv.astype(vp.dtype))
+                # pool layout [pages, hkv, block, hd]; kk [T, hkv, hd]
+                kp_l = kp[li].at[page, :, row].set(kk.astype(kp.dtype))
+                vp_l = vp[li].at[page, :, row].set(vv.astype(vp.dtype))
                 kp = kp.at[li].set(kp_l)
                 vp = vp.at[li].set(vp_l)
-                # gather each token's context pages -> [T, max_ctx, hkv, hd]
-                keys = kp_l[tables].reshape(tables.shape[0], -1, c.n_kv_heads,
-                                            c.head_dim)
-                vals = vp_l[tables].reshape(tables.shape[0], -1, c.n_kv_heads,
-                                            c.head_dim)
-                kv_pos = (jnp.arange(self.max_pages * bs)[None, :])
-                visible = kv_pos <= positions[:, None]             # causal
-                visible &= kv_pos < ctx[:, None]
-                # paged attention (jnp path; Pallas upgrade point)
-                group = c.n_heads // c.n_kv_heads
-                keys = jnp.repeat(keys, group, axis=2)
-                vals = jnp.repeat(vals, group, axis=2)
-                logits = jnp.einsum("thd,tkhd->thk", q.astype(jnp.float32),
-                                    keys.astype(jnp.float32))
-                logits = logits / np.sqrt(c.head_dim)
-                logits = jnp.where(visible[:, None, :], logits, -1e30)
-                probs = jax.nn.softmax(logits, axis=-1)
-                attn = jnp.einsum("thk,tkhd->thd", probs,
-                                  vals.astype(jnp.float32)).astype(x.dtype)
+                # paged attention: Pallas kernel on TPU (scalar-prefetched
+                # block tables, zero gather); jnp gather path elsewhere.
+                # (positions <= ctx-1 always, so the causal mask subsumes the
+                # context-length mask; inactive lanes produce ignored junk)
+                if use_pallas:
+                    attn = paged_attention(q, kp_l, vp_l, tables, positions)
+                else:
+                    attn = paged_attention_reference(q, kp_l, vp_l, tables,
+                                                     positions)
+                attn = attn.astype(x.dtype)
                 attn = attn.reshape(-1, c.n_heads * c.head_dim) @ lp["wo"]
                 if c.use_bias:
                     attn = attn + lp["bo"]

@@ -70,12 +70,16 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = No
     return dot_product_attention(q, k, v, causal=causal, scale=scale)
 
 
-def _use_pallas(q, k, block_q: int, block_k: int) -> bool:
+def _on_tpu() -> bool:
+    """Shared platform probe for Pallas kernel dispatch."""
     try:
-        platform = jax.devices()[0].platform
+        return jax.devices()[0].platform == "tpu"
     except Exception:
         return False
-    if platform not in ("tpu",):
+
+
+def _use_pallas(q, k, block_q: int, block_k: int) -> bool:
+    if not _on_tpu():
         return False
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape

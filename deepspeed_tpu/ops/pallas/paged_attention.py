@@ -1,0 +1,159 @@
+"""Paged-attention decode kernel (Pallas TPU) with scalar-prefetched block
+tables.
+
+Reference surface: FastGen's ragged kernels
+(``deepspeed/inference/v2/kernels/ragged_ops/`` — blocked flash over a
+paged KV cache, with host-built "atoms" describing each sequence's pages).
+TPU-first redesign: the block table is a scalar-prefetch operand
+(``pltpu.PrefetchScalarGridSpec``), so each grid step's page is DMA'd
+straight from the pool in HBM via the BlockSpec index map — no [T, ctx]
+gather materialization (the jnp fallback in ``inference/ragged.py`` does
+exactly that and is correctness-only).
+
+Layout contract (chosen for TPU tiling):
+  q:        [T, hq, hd]                 one token per ragged lane
+  k_pool:   [n_pages, hkv, block, hd]   (block, hd) minor = native tiles
+  v_pool:   [n_pages, hkv, block, hd]
+  tables:   [T, max_pages] int32        per-token page list
+  positions:[T] int32                   absolute position of each token
+Output:     [T, hq, hd]
+
+Grid: (T, hkv, max_pages) with pages innermost; online softmax in VMEM
+scratch (flash-2 style, as ops/pallas/flash_attention.py). Pages past a
+token's context are skipped compute-side via ``pl.when`` AND their index
+map is clamped to the last visible page — Pallas elides the copy when the
+block index repeats, so dead pages cost no DMA either.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _kernel(tables_ref, pos_ref,          # scalar prefetch
+            q_ref, k_ref, v_ref,          # blocks
+            o_ref,                        # out
+            m_scr, l_scr, acc_scr,
+            *, scale: float, block: int):
+    t, p = pl.program_id(0), pl.program_id(2)
+    np_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[t]
+    run = p * block <= pos  # page holds at least one visible row
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)          # [group, hd]
+        k = k_ref[0, 0].astype(jnp.float32)          # [block, hd]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        row_pos = p * block + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)                   # [group, block]
+        s = jnp.where(row_pos <= pos, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        pr = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(l_scr[:, :1] * corr +
+                                    jnp.sum(pr, axis=-1, keepdims=True),
+                                    l_scr.shape)
+        v = v_ref[0, 0].astype(jnp.float32)          # [block, hd]
+        pv = jax.lax.dot_general(pr, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(p == np_pages - 1)
+    def _final():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)         # fully-masked lane guard
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, tables, positions, *,
+                    scale=None, interpret: bool = False):
+    """Decode attention over a paged KV pool. See module docstring for the
+    layout contract. Causal by construction: token t sees pool rows with
+    position <= positions[t] along its own page list."""
+    T, hq, hd = q.shape
+    n_pages, hkv, block, _ = k_pool.shape
+    max_pages = tables.shape[1]
+    group = hq // hkv
+    assert hq % hkv == 0
+    scale = scale if scale is not None else 1.0 / np.sqrt(hd)
+
+    qg = q.reshape(T, hkv, group, hd)
+    tables = tables.astype(jnp.int32)
+    positions = positions.astype(jnp.int32)
+
+    def q_index(t, h, p, tbl, pos):
+        return (t, h, 0, 0)
+
+    def kv_index(t, h, p, tbl, pos):
+        # past-the-end pages re-use the last visible page's index: Pallas
+        # skips the copy when the block index repeats, so they cost no DMA
+        p_c = jnp.minimum(p, pos[t] // block)
+        return (tbl[t, p_c], h, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T, hkv, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, hd), q_index),
+            pl.BlockSpec((1, 1, block, hd), kv_index),
+            pl.BlockSpec((1, 1, block, hd), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, hd), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((group, LANES), jnp.float32),
+            pltpu.VMEM((group, LANES), jnp.float32),
+            pltpu.VMEM((group, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, block=block),
+        out_shape=jax.ShapeDtypeStruct((T, hkv, group, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(tables, positions, qg, k_pool, v_pool)
+    return out.reshape(T, hq, hd)
+
+
+def paged_attention_reference(q, k_pool, v_pool, tables, positions, *,
+                              scale=None):
+    """jnp reference (gather-based) with identical semantics — the numerics
+    oracle for the kernel and the off-TPU fallback formulation."""
+    T, hq, hd = q.shape
+    n_pages, hkv, block, _ = k_pool.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(hd)
+    group = hq // hkv
+    # [T, max_pages, hkv, block, hd] -> [T, ctx, hkv, hd]
+    keys = k_pool[tables].transpose(0, 2, 1, 3, 4).reshape(
+        T, hkv, -1, hd).transpose(0, 2, 1, 3)
+    vals = v_pool[tables].transpose(0, 2, 1, 3, 4).reshape(
+        T, hkv, -1, hd).transpose(0, 2, 1, 3)
+    keys = jnp.repeat(keys, group, axis=2)
+    vals = jnp.repeat(vals, group, axis=2)
+    logits = jnp.einsum("thd,tkhd->thk", q.astype(jnp.float32),
+                        keys.astype(jnp.float32)) * scale
+    kv_pos = jnp.arange(keys.shape[1])[None, :]
+    visible = kv_pos <= positions[:, None]
+    logits = jnp.where(visible[:, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("thk,tkhd->thd", probs,
+                      vals.astype(jnp.float32)).astype(q.dtype)
