@@ -79,13 +79,17 @@ def _child_main():
                                                (batch_size, seq_len)).astype(np.int32)
     batch = shard_batch({"input_ids": tokens}, engine.topo)
 
+    # NB: through the axon relay block_until_ready does NOT synchronize;
+    # only a host fetch does. Fetch the loss scalar as the timing fence
+    # (steps are data-dependent through the engine state, so the device
+    # executes them serially regardless of dispatch timing).
     for _ in range(warmup):
         m = engine.train_batch(batch)
-    jax.block_until_ready(m["loss"])
+    float(m["loss"])
     t0 = time.perf_counter()
     for _ in range(steps):
         m = engine.train_batch(batch)
-    jax.block_until_ready(m["loss"])
+    float(m["loss"])
     dt = time.perf_counter() - t0
 
     tokens_per_step = batch_size * (seq_len - 1)

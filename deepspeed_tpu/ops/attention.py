@@ -60,7 +60,7 @@ def dot_product_attention(q, k, v, *, causal: bool = True,
 
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = 1024, block_k: int = 1024):
     """Blocked flash attention. Dispatches to the Pallas TPU kernel when
     running on TPU with compatible shapes; jnp reference otherwise."""
     if _use_pallas(q, k, block_q, block_k):
@@ -84,5 +84,7 @@ def _use_pallas(q, k, block_q: int, block_k: int) -> bool:
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
     bq, bk = min(block_q, sq), min(block_k, skv)
-    return (sq % bq == 0 and skv % bk == 0 and bq % 8 == 0 and bk % 8 == 0
+    # clamped blocks must stay lane-aligned (Mosaic (8,128) tiles): a seq
+    # like 264 would otherwise clamp to an untested non-multiple-of-128 block
+    return (sq % bq == 0 and skv % bk == 0 and bq % 128 == 0 and bk % 128 == 0
             and d in (64, 128, 256) and hq % hkv == 0 and skv >= sq)

@@ -71,8 +71,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32)          # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)          # [bk, d]
+        # matmul inputs stay in the storage dtype (bf16 on the training
+        # path): the MXU takes bf16 operands with fp32 accumulation natively;
+        # upcasting first would force fp32 MXU passes (~8x slower)
+        q = q_ref[0, 0]                              # [bq, d]
+        k = k_ref[0, 0]                              # [bk, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -88,8 +91,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new)                       # [bq, bk]
         corr = jnp.exp(m_prev - m_new)               # [bq, 1]
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
-        v = v_ref[0, 0].astype(jnp.float32)          # [bk, d]
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+        v = v_ref[0, 0]                              # [bk, d]
+        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_scr[:] = acc_scr[:] * corr + pv
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -105,8 +108,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_scr[:, :1]
         l_safe = jnp.where(jnp.logical_or(masked, l == 0.0), 1.0, l)
         o_ref[0, 0] = jnp.where(masked, 0.0, acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # LSE is emitted lane-replicated as [block_q, LANES]: Mosaic requires
+        # the last two block dims to tile (8, 128), so a rank-3 (1, 1, bq)
+        # block is not lowerable; callers slice [..., 0].
         lse = jnp.where(masked, -NEG_INF, m_scr[:, :1] + jnp.log(l_safe))
-        lse_ref[0, 0] = lse[:, 0]
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
 def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -140,12 +146,13 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, hq, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, sq, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),
@@ -154,7 +161,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3), lse
+    return out.transpose(0, 2, 1, 3), lse[..., 0]
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -172,21 +179,21 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]                 # [bq, 1]
-        delta = delta_ref[0, 0][:, None]             # [bq, 1]
+        q = q_ref[0, 0]                              # storage dtype (bf16)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]                   # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]               # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             s = jnp.where(_causal_mask(qi, ki, block_q, block_k, sq, skv),
                           s, NEG_INF)
-        p = jnp.exp(s - lse)                         # [bq, bk]
+        p = jnp.exp(s - lse)                         # [bq, bk] fp32
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
         acc_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32)
 
@@ -215,24 +222,25 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        q = q_ref[0, 0]                              # storage dtype (bf16)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             s = jnp.where(_causal_mask(qi, ki, block_q, block_k, sq, skv),
                           s, NEG_INF)
-        p = jnp.exp(s - lse)                         # [bq, bk]
+        p = jnp.exp(s - lse)                         # [bq, bk] fp32
         # dv += P^T @ dO
-        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale                # [bq, bk]
+        ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bq, bk]
         # dk += dS^T @ Q
         dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -248,7 +256,10 @@ def _seq_spec(block: int, d: int, index_map):
 
 
 def _row_spec(block: int, index_map):
-    return pl.BlockSpec((1, 1, block), index_map, memory_space=pltpu.VMEM)
+    # Row statistics (LSE, delta) travel lane-replicated as
+    # [..., block_q, LANES] — see _fwd_kernel._final for why.
+    return pl.BlockSpec((1, 1, block, LANES), index_map,
+                        memory_space=pltpu.VMEM)
 
 
 def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
@@ -263,6 +274,9 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
     dot = do.transpose(0, 2, 1, 3)
     ot = out.transpose(0, 2, 1, 3)
     delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
+    # lane-replicate row stats for Mosaic-tileable [bq, LANES] blocks
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, LANES))
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
     # dq: grid (b, q_head, q_block, kv_block); K/V indexed per kv-head group
     # (same trick as the forward — never expanded to q-heads)
@@ -277,8 +291,8 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
             _seq_spec(block_k, d,
                       lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
             _seq_spec(block_q, d, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            _row_spec(block_q, lambda bi, hi, qi, ki: (bi, hi, qi)),
-            _row_spec(block_q, lambda bi, hi, qi, ki: (bi, hi, qi)),
+            _row_spec(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            _row_spec(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_specs=_seq_spec(block_q, d, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
@@ -305,9 +319,9 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
             _seq_spec(block_q, d,
                       lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq), 0)),
             _row_spec(block_q,
-                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq))),
+                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq), 0)),
             _row_spec(block_q,
-                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq))),
+                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq), 0)),
         ],
         out_specs=[
             _seq_spec(block_k, d, lambda bi, hk, ki, gq: (bi, hk, ki, 0)),
@@ -329,7 +343,7 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 1024, block_k: int = 1024,
                     interpret: bool = False):
     """q: [b, sq, hq, d]; k/v: [b, skv, hkv, d] -> [b, sq, hq, d].
 

@@ -58,8 +58,8 @@ def _kernel(tables_ref, pos_ref,          # scalar prefetch
 
     @pl.when(run)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32)          # [group, hd]
-        k = k_ref[0, 0].astype(jnp.float32)          # [block, hd]
+        q = q_ref[0, 0]                              # [group, hd] bf16
+        k = k_ref[0, 0]                              # [block, hd] bf16
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         row_pos = p * block + jax.lax.broadcasted_iota(
@@ -72,8 +72,8 @@ def _kernel(tables_ref, pos_ref,          # scalar prefetch
         l_scr[:] = jnp.broadcast_to(l_scr[:, :1] * corr +
                                     jnp.sum(pr, axis=-1, keepdims=True),
                                     l_scr.shape)
-        v = v_ref[0, 0].astype(jnp.float32)          # [block, hd]
-        pv = jax.lax.dot_general(pr, v, (((1,), (0,)), ((), ())),
+        v = v_ref[0, 0]                              # [block, hd] bf16
+        pv = jax.lax.dot_general(pr.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_scr[:] = acc_scr[:] * corr + pv
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
