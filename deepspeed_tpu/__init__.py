@@ -56,7 +56,8 @@ def initialize(*,
     """
     cfg = Config.from_any(config if config is not None else config_params)
     if topology is None:
-        topology = Topology.build(cfg.mesh)
+        # hpZ / MiCS factor the data axis into data × zshard (mesh.py)
+        topology = Topology.build(cfg.mesh, zero_inner=cfg.zero.zero_inner_size())
     set_topology(topology)
     init_distributed()
     if model is not None and hasattr(model, "bind_topology"):

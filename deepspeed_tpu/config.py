@@ -211,6 +211,16 @@ class ZeroConfig:
     round_robin_gradients: bool = False
     ignore_unused_parameters: bool = True
 
+    def zero_inner_size(self) -> int:
+        """Inner (zshard) factor of the data-parallel dimension: MiCS
+        sub-group size takes precedence over the hpZ secondary partition
+        (a MiCS run shards everything at that granularity already)."""
+        if (self.mics_shard_size or 0) > 0:
+            return int(self.mics_shard_size)
+        if self.zero_hpz_partition_size > 1:
+            return int(self.zero_hpz_partition_size)
+        return 1
+
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ZeroConfig":
         if not d:

@@ -107,6 +107,46 @@ def int8_allreduce(x: jnp.ndarray, worker_error: jnp.ndarray,
     return reduced, new_error
 
 
+def int8_pmean(x: jnp.ndarray, axis_name: str, block: int = 512) -> jnp.ndarray:
+    """Stateless blockwise-int8 mean-reduce (ZeRO++ qgZ,
+    reference runtime/zero/stage3.py quantized_reduce_scatter path /
+    engine keys runtime/engine.py:836): both hops of the hierarchical
+    reduction move int8 payloads — local contribution quantized and
+    chunk-exchanged via all_to_all, the reduced chunk re-quantized for the
+    all_gather — so the wire volume drops ~4x vs fp32. Must run inside
+    shard_map with ``axis_name`` manual; x is the rank-local [n] partial
+    sum with n divisible by world*block."""
+    from ..ops.quantizer import dequantize_blockwise, quantize_blockwise
+
+    world = jax.lax.psum(1, axis_name)
+    q, s, _ = quantize_blockwise(x, bits=8, block=block)
+    q_recv = jax.lax.all_to_all(q.reshape(world, -1), axis_name, 0, 0,
+                                tiled=False).reshape(world, -1, block)
+    s_recv = jax.lax.all_to_all(s.reshape(world, -1), axis_name, 0, 0,
+                                tiled=False).reshape(world, -1)
+    chunk = jnp.mean(q_recv.astype(jnp.float32) * s_recv[..., None],
+                     axis=0).reshape(-1)
+    q2, s2, _ = quantize_blockwise(chunk, bits=8, block=block)
+    q_all = jax.lax.all_gather(q2, axis_name).reshape(-1)
+    s_all = jax.lax.all_gather(s2, axis_name).reshape(-1)
+    return dequantize_blockwise(q_all, s_all, block=block).reshape(x.shape)
+
+
+def tree_int8_pmean(grads: Any, axis_name: str, world: int,
+                    block: int = 512) -> Any:
+    """Leaf-wise int8_pmean over a gradient pytree; leaves that don't divide
+    world*block (or are tiny) fall back to dense pmean — the reference
+    similarly exempts small tensors from quantized collectives."""
+
+    def leaf(g):
+        flat = g.reshape(-1).astype(jnp.float32)
+        if g.size % (world * block) != 0 or g.size < 4 * world * block:
+            return jax.lax.pmean(flat, axis_name).reshape(g.shape)
+        return int8_pmean(flat, axis_name, block=block).reshape(g.shape)
+
+    return jax.tree_util.tree_map(leaf, grads)
+
+
 def tree_onebit_allreduce(grads: Any, worker_errors: Any, server_errors: Any,
                           axis_name: str, world: int):
     """Leaf-wise onebit_allreduce over a gradient pytree. Error buffers are

@@ -40,8 +40,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..config import MeshConfig
 from ..utils.logging import log_dist
 
-# Canonical axis order, outermost → innermost.
-MESH_AXES: Tuple[str, ...] = ("data", "seq", "pipe", "expert", "model")
+# Canonical axis order, outermost → innermost. 'zshard' is the secondary
+# ZeRO partition axis (size 1 unless ZeRO++ hpZ / MiCS factor the data
+# dimension): data-parallel replicas are laid out as data × zshard with
+# zshard the *inner* (intra-slice, fast-ICI) factor — the analog of the
+# reference's intra-node secondary groups (utils/groups.py:356
+# _create_zero_param_parallel_group, runtime/zero/mics.py:55 MiCS_Init).
+MESH_AXES: Tuple[str, ...] = ("data", "zshard", "seq", "pipe", "expert", "model")
 
 
 class Topology:
@@ -59,11 +64,23 @@ class Topology:
     # -- construction ---------------------------------------------------
     @classmethod
     def build(cls, mesh_config: Optional[MeshConfig] = None,
-              devices: Optional[Sequence[jax.Device]] = None) -> "Topology":
+              devices: Optional[Sequence[jax.Device]] = None,
+              zero_inner: int = 1) -> "Topology":
+        """``zero_inner`` > 1 factors the data-parallel dimension into
+        data × zshard (zshard = inner, size ``zero_inner``) for ZeRO++ hpZ /
+        MiCS hierarchical sharding."""
         mesh_config = mesh_config or MeshConfig()
         if devices is None:
             devices = jax.devices()
         sizes = mesh_config.resolve(len(devices))
+        sizes.setdefault("zshard", 1)
+        if zero_inner > 1:
+            dp = sizes["data"] * sizes["zshard"]
+            if dp % zero_inner != 0:
+                raise ValueError(
+                    f"zero_inner={zero_inner} must divide the data-parallel "
+                    f"size {dp} (hpz_partition_size / mics_shard_size)")
+            sizes["data"], sizes["zshard"] = dp // zero_inner, zero_inner
         shape = tuple(sizes[a] for a in MESH_AXES)
         dev_array = np.asarray(devices).reshape(shape)
         mesh = Mesh(dev_array, MESH_AXES)
@@ -73,12 +90,14 @@ class Topology:
     @classmethod
     def build_virtual(cls, sizes: Dict[str, int]) -> "Topology":
         """Build a mesh with explicit axis sizes (tests / dry runs), using
-        only as many devices as the axes require."""
-        cfg = MeshConfig(**{a: sizes.get(a, 1) for a in MESH_AXES})
+        only as many devices as the axes require. A 'zshard' entry factors
+        the data dimension (hpZ / MiCS inner partition)."""
+        inner = sizes.get("zshard", 1)
+        cfg = MeshConfig(**{a: sizes.get(a, 1) for a in MeshConfig.AXES})
         n = 1
-        for a in MESH_AXES:
+        for a in MeshConfig.AXES:
             n *= sizes.get(a, 1)
-        return cls.build(cfg, devices=jax.devices()[:n])
+        return cls.build(cfg, devices=jax.devices()[:n], zero_inner=inner)
 
     # -- size / rank queries (parity with groups.py get_* helpers) ------
     def axis_size(self, axis: str) -> int:
@@ -90,7 +109,16 @@ class Topology:
 
     @property
     def data_parallel_size(self) -> int:
-        return self._sizes["data"]
+        return self._sizes["data"] * self._sizes["zshard"]
+
+    @property
+    def zero_secondary_size(self) -> int:
+        """Size of the inner (hpZ / MiCS) partition factor."""
+        return self._sizes["zshard"]
+
+    def data_axes(self) -> Tuple[str, ...]:
+        """Mesh axes jointly forming the data-parallel dimension."""
+        return ("data", "zshard") if self._sizes["zshard"] > 1 else ("data",)
 
     @property
     def model_parallel_size(self) -> int:
@@ -111,7 +139,7 @@ class Topology:
     @property
     def sequence_data_parallel_size(self) -> int:
         # reference groups.py:489 _get_sequence_data_parallel_group
-        return self._sizes["seq"] * self._sizes["data"]
+        return self._sizes["seq"] * self.data_parallel_size
 
     def zero_partition_axes(self) -> Tuple[str, ...]:
         """Axes ZeRO shards params/grads/optimizer state over.
@@ -119,13 +147,20 @@ class Topology:
         The reference uses the (seq-)data-parallel group as ZeRO's dp group
         (engine.py:1122); expert replicas join for non-expert params.
         """
-        axes = [a for a in ("data", "seq") if self._sizes[a] > 1]
+        axes = [a for a in ("data", "zshard", "seq") if self._sizes[a] > 1]
         return tuple(axes) if axes else ("data",)
+
+    def zero_secondary_axes(self) -> Tuple[str, ...]:
+        """Inner partition axes for hpZ secondary param shards / MiCS
+        sub-group sharding (reference partition_parameters.py:883,
+        mics.py:227): the fast-ICI factor of the data dimension (+ seq)."""
+        axes = [a for a in ("zshard", "seq") if self._sizes[a] > 1]
+        return tuple(axes) if axes else ("zshard",)
 
     def expert_data_axes(self) -> Tuple[str, ...]:
         """Replica axes for expert parameters (expert-data-parallel group,
         reference groups.py:113)."""
-        axes = [a for a in ("data", "seq") if self._sizes[a] > 1]
+        axes = [a for a in ("data", "zshard", "seq") if self._sizes[a] > 1]
         return tuple(axes) if axes else ("data",)
 
     # -- sharding helpers ----------------------------------------------
@@ -136,16 +171,17 @@ class Topology:
         return NamedSharding(self.mesh, PartitionSpec())
 
     def data_sharding(self, ndim: int = 1) -> NamedSharding:
-        """Batch sharding: leading dim over ('data',) — and 'seq' folds into
-        batch for the dataloader when sequence parallelism is off."""
-        spec = [None] * ndim
-        spec[0] = "data"
+        """Batch sharding: leading dim over the data axes — and 'seq' folds
+        into batch for the dataloader when sequence parallelism is off."""
+        spec: list = [None] * ndim
+        spec[0] = self.data_axes()
         return NamedSharding(self.mesh, PartitionSpec(*spec))
 
     def batch_sharding(self, ndim: int = 2) -> NamedSharding:
-        """[batch, seq, ...] sharding: batch over 'data', seq over 'seq'."""
+        """[batch, seq, ...] sharding: batch over the data axes, seq over
+        'seq'."""
         spec: list = [None] * ndim
-        spec[0] = "data"
+        spec[0] = self.data_axes()
         if ndim > 1 and self._sizes["seq"] > 1:
             spec[1] = "seq"
         return NamedSharding(self.mesh, PartitionSpec(*spec))

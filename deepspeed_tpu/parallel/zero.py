@@ -100,8 +100,28 @@ class ZeroShardingRules:
     def __init__(self, topo: Topology, zero_config: Optional[ZeroConfig] = None):
         self.topo = topo
         self.config = zero_config or ZeroConfig()
-        self.zero_axes = topo.zero_partition_axes()
+        # MiCS (reference runtime/zero/mics.py:55): everything shards within
+        # the sub-group (the fast-ICI 'zshard' factor) and REPLICATES across
+        # the outer 'data' factor; XLA then emits the hierarchical
+        # reduce-scatter(zshard) + all-reduce(data) gradient schedule that
+        # mics.py:227 builds by hand.
+        self.mics = (self.config.mics_shard_size or 0) > 0
+        if self.mics and topo.zero_secondary_size > 1:
+            self.zero_axes = topo.zero_secondary_axes()
+        else:
+            self.zero_axes = topo.zero_partition_axes()
         self.zero_size = _axes_size(topo, self.zero_axes)
+        # hpZ (reference partition_parameters.py:883): primary partition over
+        # the full ZeRO group (opt state / master params / grads), secondary
+        # bf16 compute copy sharded over 'zshard' only so per-layer forward
+        # all-gathers never cross the outer axis. The engine applies
+        # secondary_param_shardings at the compute-cast boundary.
+        self.hpz = (not self.mics
+                    and self.config.zero_hpz_partition_size > 1
+                    and topo.zero_secondary_size > 1
+                    and self.config.stage >= 3)
+        self.secondary_axes = topo.zero_secondary_axes()
+        self.secondary_size = _axes_size(topo, self.secondary_axes)
 
     # -- per-leaf specs -------------------------------------------------
     def param_spec(self, shape: Tuple[int, ...], base_spec: Optional[PartitionSpec] = None) -> PartitionSpec:
@@ -125,9 +145,27 @@ class ZeroShardingRules:
             return jax.tree_util.tree_map(lambda s: leaf_fn(tuple(s.shape), None), shapes)
         return jax.tree_util.tree_map(lambda s, t: leaf_fn(tuple(s.shape), t), shapes, tp_specs)
 
+    def secondary_param_spec(self, shape: Tuple[int, ...],
+                             base_spec: Optional[PartitionSpec] = None) -> PartitionSpec:
+        """hpZ secondary-copy spec: sharded over the inner axes only."""
+        return shard_leaf_spec(
+            shape, self.secondary_axes, base_spec,
+            threshold=self.config.stage3_param_persistence_threshold,
+            axes_size=self.secondary_size,
+        )
+
     def param_shardings(self, param_shapes: Any, tp_specs: Optional[Any] = None) -> Any:
         mesh = self.topo.mesh
         specs = self._tree_specs(param_shapes, tp_specs, self.param_spec)
+        return jax.tree_util.tree_map(lambda sp: NamedSharding(mesh, sp), specs,
+                                      is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+    def secondary_param_shardings(self, param_shapes: Any,
+                                  tp_specs: Optional[Any] = None) -> Any:
+        """hpZ secondary (compute-copy) shardings — replicated over the outer
+        'data' factor, sharded over 'zshard' (+ seq)."""
+        mesh = self.topo.mesh
+        specs = self._tree_specs(param_shapes, tp_specs, self.secondary_param_spec)
         return jax.tree_util.tree_map(lambda sp: NamedSharding(mesh, sp), specs,
                                       is_leaf=lambda x: isinstance(x, PartitionSpec))
 

@@ -1,0 +1,162 @@
+"""ZeRO++ (hpZ / qwZ / qgZ) and MiCS tests on the 8-device virtual mesh.
+
+Parity targets: reference tests/unit/runtime/zero/test_zeropp.py
+(quantized weights/gradients + hierarchical partitioning train and match
+the dense baseline) and runtime/zero/mics.py (sub-group sharding).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.parallel.mesh import Topology
+from simple_model import mlp_loss
+
+
+def big_mlp_params(rng, in_dim=64, hidden=512, out_dim=64, n_layers=3):
+    """Leaves big enough to exercise the int8 collective (not the dense
+    fallback for tiny tensors)."""
+    params = {}
+    dims = [in_dim] + [hidden] * (n_layers - 1) + [out_dim]
+    for i in range(len(dims) - 1):
+        rng, k = jax.random.split(rng)
+        params[f"layer_{i}"] = {
+            "w": jax.random.normal(k, (dims[i], dims[i + 1]), jnp.float32) * 0.05,
+            "b": jnp.zeros((dims[i + 1],), jnp.float32),
+        }
+    return params
+
+
+def big_batch(n=32, in_dim=64, out_dim=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"x": rng.normal(size=(n, in_dim)).astype(np.float32),
+            "y": rng.normal(size=(n, out_dim)).astype(np.float32)}
+
+
+def _engine(zero_extra=None, stage=3, batch=32, lr=1e-2):
+    cfg = {
+        "train_batch_size": batch,
+        "optimizer": {"type": "adamw", "params": {"lr": lr}},
+        "zero_optimization": {"stage": stage,
+                              "stage3_param_persistence_threshold": 0,
+                              **(zero_extra or {})},
+        "steps_per_print": 1000,
+    }
+    params = big_mlp_params(jax.random.PRNGKey(0))
+    engine, _, _, _ = dst.initialize(loss_fn=mlp_loss, params=params, config=cfg)
+    return engine
+
+
+def _losses(engine, steps=5):
+    batch = big_batch(engine.train_batch_size)
+    return [float(engine.train_batch(batch)["loss"]) for _ in range(steps)]
+
+
+def _leaf_axes(shardings):
+    axes = set()
+    for sh in jax.tree_util.tree_leaves(shardings):
+        for e in sh.spec:
+            if e is None:
+                continue
+            axes.update(e if isinstance(e, tuple) else (e,))
+    return axes
+
+
+# ---------------------------------------------------------------- hpZ
+def test_hpz_mesh_factoring():
+    topo = Topology.build_virtual({"data": 8, "zshard": 2})
+    assert topo.data_parallel_size == 8
+    assert topo.zero_secondary_size == 2
+    assert topo.axis_size("data") == 4
+    assert topo.data_axes() == ("data", "zshard")
+
+
+def test_hpz_secondary_shardings_inner_only():
+    engine = _engine({"zero_hpz_partition_size": 2})
+    assert engine.topo.zero_secondary_size == 2
+    assert engine._secondary_shardings is not None
+    # primary (master/opt) partition spans the full ZeRO group...
+    assert _leaf_axes(engine.param_shardings) == {"data", "zshard"}
+    # ...secondary compute copy only the inner axis (fast-ICI gathers)
+    assert _leaf_axes(engine._secondary_shardings) == {"zshard"}
+
+
+def test_hpz_matches_plain_stage3():
+    dense = _losses(_engine(), steps=5)
+    hpz = _losses(_engine({"zero_hpz_partition_size": 2}), steps=5)
+    np.testing.assert_allclose(hpz, dense, rtol=1e-4, atol=1e-5)
+    assert hpz[-1] < hpz[0]
+
+
+# ---------------------------------------------------------------- qwZ
+def test_qwz_trains_and_quantization_is_live():
+    dense = _losses(_engine(), steps=5)
+    qwz = _losses(_engine({"zero_quantized_weights": True,
+                           "zero_hpz_partition_size": 2}), steps=5)
+    # step-0 forward sees int8-dequantized weights: near the dense loss but
+    # NOT identical — proves the quantized gather path is actually engaged
+    np.testing.assert_allclose(qwz[0], dense[0], rtol=5e-3)
+    assert qwz[0] != dense[0], "qwZ path inactive (losses bit-identical)"
+    # the straight-through estimator must let the quantized WEIGHTS learn —
+    # bias-only drift (the symptom of a zero-grad quantize round trip)
+    # cannot cut the loss this much
+    assert qwz[-1] < 0.8 * qwz[0], f"qwZ barely learning (STE broken?): {qwz}"
+    assert np.all(np.isfinite(qwz))
+
+
+# ---------------------------------------------------------------- qgZ
+def test_qgz_trains_close_to_dense():
+    dense = _losses(_engine(stage=2, lr=1e-3), steps=5)
+    qgz = _losses(_engine({"zero_quantized_gradients": True}, stage=2,
+                          lr=1e-3), steps=5)
+    assert qgz[-1] < qgz[0], f"qgZ loss did not decrease: {qgz}"
+    np.testing.assert_allclose(qgz, dense, rtol=0.1, atol=0.02)
+
+
+def test_qgz_gradients_match_dense_psum():
+    """One-step gradient comparison: int8-reduced vs dense grads."""
+    e_dense = _engine(stage=2)
+    e_qgz = _engine({"zero_quantized_gradients": True}, stage=2)
+    batch = big_batch(32)
+    scale = jnp.ones([], jnp.float32)
+    g_d, l_d, _ = jax.jit(e_dense._loss_and_grads)(
+        e_dense.params, batch, jax.random.PRNGKey(1), scale)
+    g_q, l_q, _ = jax.jit(e_qgz._loss_and_grads)(
+        e_qgz.params, batch, jax.random.PRNGKey(1), scale)
+    np.testing.assert_allclose(float(l_q), float(l_d), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g_q),
+                    jax.tree_util.tree_leaves(g_d)):
+        a, b = np.asarray(a), np.asarray(b)
+        denom = np.maximum(np.abs(b).max(), 1e-6)
+        assert np.abs(a - b).max() / denom < 0.05, "int8 grads too far off"
+
+
+# ---------------------------------------------------------------- MiCS
+def test_mics_shards_inner_group_only():
+    engine = _engine({"mics_shard_size": 2})
+    assert engine.topo.zero_secondary_size == 2
+    # MiCS: params sharded within the sub-group, replicated across 'data'
+    assert _leaf_axes(engine.param_shardings) == {"zshard"}
+    assert _leaf_axes(engine.opt_state_shardings) == {"zshard"}
+
+
+def test_mics_trains_matching_dense():
+    dense = _losses(_engine(), steps=5)
+    mics = _losses(_engine({"mics_shard_size": 2}), steps=5)
+    np.testing.assert_allclose(mics, dense, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------- stack
+def test_zeropp_full_stack_trains():
+    losses = _losses(_engine({"zero_hpz_partition_size": 2,
+                              "zero_quantized_weights": True,
+                              "zero_quantized_gradients": True}), steps=6)
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0], f"full ZeRO++ stack diverged: {losses}"
+
+
+def test_zero_inner_must_divide_dp():
+    with pytest.raises(Exception):
+        Topology.build_virtual({"data": 8, "zshard": 3})
