@@ -72,7 +72,29 @@ def initialize(*,
             raise ValueError("initialize() needs params, or a model exposing .init()")
         import jax
 
-        params = model.init(rng if rng is not None else jax.random.PRNGKey(cfg.train_seed), *model_args)
+        from .parallel.zero import ZeroShardingRules
+
+        # sharded init (zero.Init parity, reference
+        # runtime/zero/partition_parameters.py:734): the param tree is
+        # constructed BY a jitted init with ZeRO/TP out_shardings, so each
+        # device only ever materializes its own shard — models larger than
+        # one host/chip can construct. eval_shape costs nothing.
+        init_rng = rng if rng is not None else jax.random.PRNGKey(cfg.train_seed)
+        try:
+            param_shapes = jax.eval_shape(model.init, init_rng, *model_args)
+        except TypeError:
+            # non-array model_args (e.g. a dtype) can't trace — fall back to
+            # eager init; the engine re-places the tree afterwards
+            param_shapes = None
+        if param_shapes is not None:
+            if tp_specs is None and hasattr(model, "partition_specs"):
+                tp_specs = model.partition_specs(param_shapes, topology)
+            rules = ZeroShardingRules(topology, cfg.zero)
+            init_shardings = rules.param_shardings(param_shapes, tp_specs)
+            params = jax.jit(model.init,
+                             out_shardings=init_shardings)(init_rng, *model_args)
+        else:
+            params = model.init(init_rng, *model_args)
     if tp_specs is None and model is not None and hasattr(model, "partition_specs"):
         tp_specs = model.partition_specs(params, topology)
 

@@ -32,12 +32,16 @@ __all__ = ["read_hf_state", "hf_config", "map_hf_params", "from_pretrained"]
 # ----------------------------------------------------------------------
 # raw tensor reading
 def _to_numpy(t) -> np.ndarray:
-    """torch tensor -> numpy (bf16 upcast through fp32, torch has no
-    numpy bf16 bridge)."""
+    """torch tensor -> numpy. bf16 is reinterpreted bit-exact through a
+    uint16 view into an ml_dtypes.bfloat16 array (torch has no numpy bf16
+    bridge) — NEVER upcast through fp32, which would transiently need 2x
+    the checkpoint size in host RAM (28 GB for a 7B bf16 checkpoint)."""
     import torch
 
     if t.dtype == torch.bfloat16:
-        return t.to(torch.float32).numpy()
+        import ml_dtypes
+
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
     return t.numpy()
 
 
@@ -156,8 +160,10 @@ def hf_config(model_dir: str):
 # ----------------------------------------------------------------------
 # weight mapping (per family)
 def _stack(state, fmt: str, n: int, transpose=False) -> np.ndarray:
-    """Stack per-layer tensors into one [n, ...] array."""
-    arrs = [state[fmt.format(i)] for i in range(n)]
+    """Stack per-layer tensors into one [n, ...] array, POPPING the source
+    entries so host peak memory decays as the stacked layout is built
+    (one stacked copy + the not-yet-consumed remainder, instead of 2x)."""
+    arrs = [state.pop(fmt.format(i)) for i in range(n)]
     if transpose:
         arrs = [a.T for a in arrs]
     return np.stack(arrs)
@@ -197,8 +203,8 @@ def _map_gpt2(state, c) -> Dict[str, Any]:
     L = pre + "h.{}."
     # HF Conv1D stores [in, out] — native orientation already; fused c_attn
     # splits [d, 3d] -> q, k, v along the output dim
-    qkv_w = [state[(L + "attn.c_attn.weight").format(i)] for i in range(n)]
-    qkv_b = [state[(L + "attn.c_attn.bias").format(i)] for i in range(n)]
+    qkv_w = [state.pop((L + "attn.c_attn.weight").format(i)) for i in range(n)]
+    qkv_b = [state.pop((L + "attn.c_attn.bias").format(i)) for i in range(n)]
     layers = {
         "attn_norm_w": _stack(state, L + "ln_1.weight", n),
         "attn_norm_b": _stack(state, L + "ln_1.bias", n),
@@ -270,7 +276,12 @@ _MAPPERS: Dict[str, Callable] = {
 
 
 def map_hf_params(state: Dict[str, np.ndarray], family: str, config) -> Dict[str, Any]:
-    """HF state dict -> native stacked params pytree (numpy, fp32)."""
+    """HF state dict -> native stacked params pytree (numpy, source dtype —
+    bf16 checkpoints stay ml_dtypes.bfloat16).
+
+    CONSUMES ``state``: per-layer entries are popped as they are stacked so
+    host peak memory decays during mapping. Pass a copy if you need the
+    flat dict afterwards."""
     if family not in _MAPPERS:
         raise ValueError(f"unsupported family '{family}'")
     return _MAPPERS[family](state, config)
@@ -297,14 +308,16 @@ def from_pretrained(model_dir: str, dtype=None, topology=None,
     family, cfg = hf_config(model_dir)
     state = read_hf_state(model_dir)
     host_params = map_hf_params(state, family, cfg)
+    del state  # mappers pop what they stack; drop the embeds' extra refs too
     model = Transformer(cfg)
     # cast on host (ml_dtypes covers bf16 numpy) so each leaf ships to the
     # devices already-sharded — never materializing a full unsharded param
-    # in one chip's HBM
+    # in one chip's HBM; copy=False keeps bf16 checkpoints zero-copy here
     np_dtype = np.dtype(ml_dtypes.bfloat16) if dtype == jnp.bfloat16 \
         else np.dtype(dtype)
     host_params = jax.tree_util.tree_map(
-        lambda a: np.ascontiguousarray(a.astype(np_dtype)), host_params)
+        lambda a: np.ascontiguousarray(a.astype(np_dtype, copy=False)),
+        host_params)
     if topology is not None:
         model.bind_topology(topology)
         from jax.sharding import NamedSharding

@@ -161,6 +161,35 @@ class TrainEngine:
         params = _cast_tree(params, jnp.float32)
         self.params = jax.device_put(params, self.param_shardings)
 
+        # -- ZeRO-3 param offload (reference runtime/zero/stage3.py:558 +
+        # partitioned_param_swapper.py): master param shards parked in
+        # pinned host memory ("cpu") or on disk via the aio engine ("nvme")
+        # between steps; uploaded around each step. The compute copy inside
+        # the step is unchanged (bf16, per-layer gathers).
+        self._param_offload_device = (config.zero.offload_param.device
+                                      if config.zero.stage >= 3 else "none")
+        self._param_host_shardings = None
+        self._param_nvme_swapper = None
+        if self._param_offload_device == "cpu":
+            # pinned-host shardings gate only the 'cpu' mode — the nvme path
+            # never uses them (it stages through the aio swapper)
+            try:
+                self._param_host_shardings = jax.tree_util.tree_map(
+                    lambda sh, x: (sh.with_memory_kind("pinned_host")
+                                   if getattr(x, "ndim", 0) >= 1 else sh),
+                    self.param_shardings, self.params)
+            except Exception as e:  # platform without host memory space
+                logger.warning(f"param offload unavailable: {e}")
+                self._param_offload_device = "none"
+        if self._param_offload_device == "nvme":
+            from .swap_tensor import OptimizerSwapper
+
+            path = (config.zero.offload_param.nvme_path
+                    or "/tmp/ds_tpu_param_swap")
+            self._param_nvme_swapper = OptimizerSwapper(path)
+        # actual parking happens after optimizer-state init below
+        # (the optimizer init consumes the device-resident params)
+
         # -- optimizer + schedule
         base_lr = float(config.optimizer.params.get("lr", 1e-3))
         if lr_scheduler is not None and callable(lr_scheduler):
@@ -209,6 +238,11 @@ class TrainEngine:
         self.opt_state = jax.jit(
             self.optimizer.init, out_shardings=self.opt_state_shardings
         )(self.params)
+        # struct-only checkpoint template captured while everything is still
+        # device-resident: load_checkpoint must not have to swap offloaded
+        # state in from disk just to learn the tree structure
+        self._params_struct = jax.eval_shape(lambda p: p, self.params)
+        self._opt_struct = jax.eval_shape(lambda o: o, self.opt_state)
         if self._opt_host_shardings is not None:
             # park in host memory outside jit (memory-kind out_shardings on
             # scalar leaves trip the SPMD partitioner)
@@ -217,6 +251,7 @@ class TrainEngine:
         if self._offload_device == "nvme":
             self._nvme_swapper.swap_out(self.opt_state)
             self.opt_state = None  # lives on disk between steps
+        self._params_to_offload()
 
         # -- loss scaling state
         if config.fp16.enabled:
@@ -512,8 +547,10 @@ class TrainEngine:
             # pinned host -> device upload (the reference offload engine's
             # per-step copy-in)
             self.opt_state = jax.device_put(self.opt_state, self.opt_state_shardings)
+        self._params_to_device()
         self.params, self.opt_state, self.scaler_state, self.rng, metrics = self._train_step_fn(
             self.params, self.opt_state, self.scaler_state, self.rng, batch)
+        self._params_to_offload()
         if self._offload_device == "nvme":
             self._nvme_swapper.swap_out(self.opt_state)
             self.opt_state = None
@@ -528,6 +565,21 @@ class TrainEngine:
         self._last_loss = metrics["loss"]
         return metrics
 
+    # -- param offload staging (ZeRO-3 offload_param)
+    def _params_to_device(self) -> None:
+        if self._param_offload_device == "nvme":
+            if self.params is None:
+                self.params = self._param_nvme_swapper.swap_in(self.param_shardings)
+        elif self._param_offload_device == "cpu":
+            self.params = jax.device_put(self.params, self.param_shardings)
+
+    def _params_to_offload(self) -> None:
+        if self._param_offload_device == "nvme":
+            self._param_nvme_swapper.swap_out(self.params)
+            self.params = None
+        elif self._param_offload_device == "cpu":
+            self.params = jax.device_put(self.params, self._param_host_shardings)
+
     # ==================================================================
     # DeepSpeed-compatible micro-step path
     def forward(self, batch: Any) -> Any:
@@ -535,6 +587,7 @@ class TrainEngine:
         ``backward`` recomputes through ``jax.grad`` (forward+backward fuse
         on TPU, so the split exists only at the Python API level)."""
         self._reject_if_pipelined()
+        self._params_to_device()
         loss, _aux = self._jitted_eval()(self.params, batch, self._next_rng())
         self._last_loss = loss
         return loss
@@ -543,6 +596,7 @@ class TrainEngine:
         """Accumulate gradient shards for one microbatch (parity with
         engine.backward engine.py:1902 + ZeRO IPG accumulation)."""
         self._reject_if_pipelined()
+        self._params_to_device()
         if self._micro_grad_fn is None:
             self._micro_grad_fn = jax.jit(
                 lambda p, b, r, s: self._loss_and_grads(p, b, r, s)[:2],
@@ -592,9 +646,11 @@ class TrainEngine:
             donate = (0, 1, 2, 3) if self._donate else ()
             self._apply_update_fn = jax.jit(apply_update, donate_argnums=donate)
 
+        self._params_to_device()
         self.params, self.opt_state, self.scaler_state, gnorm, skipped = self._apply_update_fn(
             self.params, self.opt_state, self.scaler_state, self._acc_grads)
         self._acc_grads = None
+        self._params_to_offload()
         self.global_steps += 1
         if bool(skipped):
             self.skipped_steps += 1
@@ -603,6 +659,7 @@ class TrainEngine:
 
     # ==================================================================
     def eval_batch(self, batch: Any) -> Any:
+        self._params_to_device()
         loss, aux = self._jitted_eval()(self.params, batch, self._next_rng())
         return loss
 
@@ -634,12 +691,19 @@ class TrainEngine:
 
     # ==================================================================
     # checkpointing (parity with engine.save_checkpoint engine.py:3010)
+    def _materialized_params(self) -> Any:
+        """Params for read-out (export/eval/state-dict): swapped in from
+        disk under nvme offload WITHOUT mutating the engine's parked state."""
+        if self._param_offload_device == "nvme" and self.params is None:
+            return self._param_nvme_swapper.swap_in()
+        return self.params
+
     def _state_dict(self) -> Dict[str, Any]:
         opt_state = self.opt_state
         if self._offload_device == "nvme" and opt_state is None:
             opt_state = self._nvme_swapper.swap_in()
         return {
-            "params": self.params,
+            "params": self._materialized_params(),
             "opt_state": opt_state,
             "scaler": self.scaler_state,
             "step": jnp.asarray(self.global_steps, jnp.int32),
@@ -660,13 +724,22 @@ class TrainEngine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True) -> Optional[Dict[str, Any]]:
-        template = jax.tree_util.tree_map(lambda x: x, self._state_dict())
+        # struct-only template: never swaps offloaded state in from disk
+        # just to learn the tree structure
+        template = {
+            "params": self._params_struct,
+            "opt_state": self._opt_struct,
+            "scaler": self.scaler_state,
+            "step": jnp.asarray(self.global_steps, jnp.int32),
+            "rng": self.rng,
+        }
         result = self.ckpt_engine.load(load_dir, tag, template=template)
         if result is None:
             return None
         state = result["state"]
         repl = self.topo.replicated()
         self.params = jax.device_put(state["params"], self.param_shardings)
+        self._params_to_offload()
         if load_optimizer_states:
             if self._offload_device == "nvme":
                 self._nvme_swapper.swap_out(state["opt_state"])
@@ -689,7 +762,8 @@ class TrainEngine:
         """Consolidated 16-bit export (reference engine.save_16bit_model
         engine.py:3492 + zero_to_fp32 consolidation)."""
         os.makedirs(save_dir, exist_ok=True)
-        flat = consolidate_full_state(_cast_tree(self.params, jnp.bfloat16))
+        flat = consolidate_full_state(
+            _cast_tree(self._materialized_params(), jnp.bfloat16))
         leaves, treedef = jax.tree_util.tree_flatten_with_path(flat)
         out = {jax.tree_util.keystr(k): np.asarray(v) for k, v in leaves}
         path = os.path.join(save_dir, filename)
@@ -697,4 +771,5 @@ class TrainEngine:
         return path
 
     def get_fp32_state_dict(self) -> Any:
-        return consolidate_full_state(self.params, dtype=np.float32)
+        return consolidate_full_state(self._materialized_params(),
+                                      dtype=np.float32)

@@ -61,6 +61,37 @@ def test_hf_logits_parity(tmp_path, family, safe):
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
 
 
+def test_hf_bf16_checkpoint_no_fp32_roundtrip(tmp_path):
+    """bf16 checkpoints ingest bit-exact through a uint16 reinterpret —
+    never upcast through fp32 on host (the 2x-RAM blow-up VERDICT r2 #9)."""
+    import ml_dtypes
+
+    from deepspeed_tpu.checkpoint.hf import read_hf_state
+
+    hf_model, d = _save_tiny(tmp_path, "llama", safe=False)
+    hf_model = hf_model.to(torch.bfloat16)
+    hf_model.save_pretrained(str(d), safe_serialization=False)
+
+    state = read_hf_state(d)
+    # raw read preserves bf16 — the blow-up-proof property
+    kinds = {a.dtype for a in state.values()}
+    assert kinds == {np.dtype(ml_dtypes.bfloat16)}, kinds
+    # bit-exactness vs torch's own bf16 view
+    w = hf_model.model.embed_tokens.weight.detach()
+    np.testing.assert_array_equal(
+        state["model.embed_tokens.weight"].view(np.uint16),
+        w.view(torch.uint16).numpy())
+
+    model, params = from_pretrained(d, dtype=jnp.bfloat16)
+    assert all(a.dtype == jnp.bfloat16
+               for a in jax.tree_util.tree_leaves(params))
+    tokens = np.random.default_rng(0).integers(1, 250, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens, dtype=torch.long)).logits.float().numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.15)  # bf16 compute
+
+
 def test_hf_greedy_decode_matches_torch(tmp_path):
     """Greedy generation through the native InferenceEngine reproduces the
     HF greedy continuation token-for-token."""
