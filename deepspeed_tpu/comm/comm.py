@@ -70,21 +70,32 @@ def _get_bw(comm_op: str, size_bytes: int, duration_s: float, n: int) -> tuple:
 
 @dataclass
 class CommsLogger:
-    """Records per-op counts/sizes (+latency when measurable).
+    """Records per-op counts/sizes at trace time; real latencies come from
+    :func:`measure_comm_latencies`, which replays every recorded
+    (op, size, axis) as a standalone timed program on the live mesh — the
+    TPU analog of the reference's CUDA-event ``timed_op`` (comm.py:101),
+    since XLA collectives only execute inside compiled programs.
 
     ``log_summary()`` prints the table like ``dist.log_summary`` in the
-    reference (comm/comm.py:422).
+    reference (comm/comm.py:422), with algbw/busbw once measured.
     """
 
     enabled: bool = False
     verbose: bool = False
     records: Dict[str, Dict[int, List[float]]] = field(default_factory=dict)
+    axes: Dict[tuple, str] = field(default_factory=dict)
+    worlds: Dict[tuple, int] = field(default_factory=dict)
 
-    def append(self, op_name: str, size_bytes: int, duration_s: float, world: int) -> None:
+    def append(self, op_name: str, size_bytes: int, duration_s: float,
+               world: int, axis_name: Optional[str] = None) -> None:
         if not self.enabled:
             return
         per_op = self.records.setdefault(op_name, {})
         per_op.setdefault(size_bytes, []).append(duration_s)
+        if axis_name is not None:
+            self.axes[(op_name, size_bytes)] = axis_name
+        if world:
+            self.worlds[(op_name, size_bytes)] = world
         if self.verbose:
             algbw, busbw = _get_bw(op_name, size_bytes, duration_s, world)
             log_dist(
@@ -92,19 +103,33 @@ class CommsLogger:
                 f" | algbw: {algbw:.2f} GB/s | busbw: {busbw:.2f} GB/s"
             )
 
+    def backfill(self, op_name: str, size_bytes: int, duration_s: float) -> None:
+        """Replace trace-time placeholder durations with a measured one."""
+        durs = self.records.get(op_name, {}).get(size_bytes)
+        if durs:
+            self.records[op_name][size_bytes] = [duration_s] * len(durs)
+
     def log_summary(self) -> str:
-        lines = [f"{'Comm. Op':<20}{'Message Size':>16}{'Count':>8}{'Total Lat(ms)':>16}{'Avg Lat(ms)':>14}"]
+        lines = [f"{'Comm. Op':<20}{'Message Size':>16}{'Count':>8}"
+                 f"{'Total Lat(ms)':>16}{'Avg Lat(ms)':>14}"
+                 f"{'algbw(GB/s)':>14}{'busbw(GB/s)':>14}"]
         for op, sizes in self.records.items():
             lines.append(op)
             for size, durs in sorted(sizes.items()):
                 total = sum(durs) * 1e3
-                lines.append(f"{'':<20}{size:>16}{len(durs):>8}{total:>16.2f}{total / len(durs):>14.2f}")
+                avg = total / len(durs)
+                world = self.worlds.get((op, size), 0)
+                algbw, busbw = _get_bw(op, size, avg / 1e3, world)
+                lines.append(f"{'':<20}{size:>16}{len(durs):>8}{total:>16.2f}"
+                             f"{avg:>14.3f}{algbw:>14.2f}{busbw:>14.2f}")
         table = "\n".join(lines)
         logger.info(table)
         return table
 
     def reset(self) -> None:
         self.records.clear()
+        self.axes.clear()
+        self.worlds.clear()
 
 
 _COMMS_LOGGER = CommsLogger()
@@ -130,10 +155,77 @@ def _nbytes(x: Any) -> int:
         return 0
 
 
-def _record(op: str, x: Any, axis_size: int) -> None:
-    # Inside jit we cannot time the transfer (XLA schedules it); record the
-    # traced call with zero duration so op counts/sizes still show up.
-    _COMMS_LOGGER.append(op, _nbytes(x), 0.0, axis_size)
+def _record(op: str, x: Any, axis_name: Optional[str]) -> None:
+    # Inside jit the transfer can't be timed at the call site (XLA schedules
+    # it); record op/size/axis now, measure_comm_latencies() backfills real
+    # durations via timed standalone replays.
+    _COMMS_LOGGER.append(op, _nbytes(x), 0.0, 0, axis_name)
+
+
+def measure_comm_latencies(mesh=None, iters: int = 10) -> str:
+    """Replay every recorded collective on the live mesh and backfill real
+    per-op latencies (reference timed_op comm.py:101 / comms benchmark
+    suite). Each replay chains ``iters`` data-dependent repetitions inside
+    ONE jitted shard_map and fences with a host fetch — dispatch overhead
+    and async-dispatch illusions (block_until_ready is not a fence through
+    the axon relay) are amortized away. Returns the updated summary table.
+    """
+    from ..parallel.mesh import get_topology
+
+    mesh = mesh if mesh is not None else get_topology().mesh
+    log = _COMMS_LOGGER
+
+    def collective(op, axis):
+        if op == "all_reduce":
+            return lambda x: jax.lax.psum(x, axis)
+        if op == "all_gather":
+            return lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=True)
+        if op == "reduce_scatter":
+            return lambda x: jax.lax.psum_scatter(x, axis, tiled=True)
+        if op == "all_to_all":
+            return lambda x: jax.lax.all_to_all(x, axis, 0, 0, tiled=True)
+        if op == "broadcast":
+            return lambda x: jax.lax.psum(
+                jnp.where(jax.lax.axis_index(axis) == 0, x, jnp.zeros_like(x)),
+                axis)
+        if op == "ppermute":
+            return None  # perm is call-specific; skip replay
+        return None
+
+    from jax.sharding import PartitionSpec as P
+
+    for op, sizes in list(log.records.items()):
+        for size in list(sizes):
+            axis = log.axes.get((op, size))
+            if axis is None or axis not in mesh.axis_names:
+                continue
+            world = mesh.shape[axis]
+            log.worlds[(op, size)] = world
+            fn = collective(op, axis)
+            n = max(size // 4, world)
+            n -= n % world or 0
+            if fn is None or n < world:
+                continue
+
+            def replay(x, fn=fn):
+                def body(_, x):
+                    y = fn(x)
+                    return x + 1e-30 * jnp.sum(y)  # data dep: no DCE/overlap
+                return jax.lax.fori_loop(0, iters, body, x)
+
+            spmd = jax.shard_map(replay, mesh=mesh, axis_names={axis},
+                                 in_specs=P(axis), out_specs=P(axis),
+                                 check_vma=False)
+            run = jax.jit(lambda x: jnp.sum(spmd(x)))
+            x = jnp.zeros((world * n,), jnp.float32)
+            float(run(x))  # compile + warm
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                float(run(x))
+                best = min(best, time.perf_counter() - t0)
+            log.backfill(op, size, best / iters)
+    return log.log_summary()
 
 
 # ----------------------------------------------------------------------
@@ -203,7 +295,7 @@ def barrier() -> None:
 
 def all_reduce(x, axis_name: str, op: ReduceOp = ReduceOp.SUM):
     """lax.psum/pmax/... over a named mesh axis. Reference: comm.py:483."""
-    _record("all_reduce", x, 0)
+    _record("all_reduce", x, axis_name)
     if op in (ReduceOp.SUM, ReduceOp.AVG):
         y = jax.lax.psum(x, axis_name)
         if op == ReduceOp.AVG:
@@ -218,19 +310,19 @@ def all_reduce(x, axis_name: str, op: ReduceOp = ReduceOp.SUM):
 
 def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
     """lax.all_gather over a named axis. Reference: comm.py:228."""
-    _record("all_gather", x, 0)
+    _record("all_gather", x, axis_name)
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name: str, scatter_dimension: int = 0):
     """lax.psum_scatter. Reference: comm.py:446 (reduce_scatter_tensor)."""
-    _record("reduce_scatter", x, 0)
+    _record("reduce_scatter", x, axis_name)
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension, tiled=True)
 
 
 def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int, tiled: bool = True):
     """lax.all_to_all. Reference: comm.py:331 (all_to_all_single)."""
-    _record("all_to_all", x, 0)
+    _record("all_to_all", x, axis_name)
     return jax.lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
 
 
@@ -240,7 +332,7 @@ def broadcast(x, axis_name: str, src_index: int = 0):
     Reference: comm.py:217 (broadcast). Implemented as select+psum so it
     lowers to one collective.
     """
-    _record("broadcast", x, 0)
+    _record("broadcast", x, axis_name)
     idx = jax.lax.axis_index(axis_name)
     masked = jnp.where(idx == src_index, x, jnp.zeros_like(x))
     return jax.lax.psum(masked, axis_name)
@@ -252,5 +344,5 @@ def ppermute(x, axis_name: str, perm):
     Reference: send/recv in comm.py:356-:374 and runtime/pipe/p2p.py — on TPU
     neighbor exchange is a collective-permute riding ICI.
     """
-    _record("ppermute", x, 0)
+    _record("ppermute", x, axis_name)
     return jax.lax.ppermute(x, axis_name, perm)
