@@ -1,0 +1,6 @@
+from .compress import (  # noqa: F401
+    CompressionConfig,
+    Compressor,
+    init_compression,
+    redundancy_clean,
+)

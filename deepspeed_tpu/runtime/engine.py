@@ -283,6 +283,11 @@ class TrainEngine:
         self._acc_grads: Optional[Any] = None
         self._last_loss = None
 
+        # optional traced transform applied to the compute-copy params
+        # (compression QAT / pruning masks — compression/compress.py)
+        self._param_transform: Optional[Callable[[Any], Any]] = None
+        self._step_hooks: list = []
+
         self._train_step_fn = None
         self._eval_step_fn = None
         self._micro_grad_fn = None
@@ -336,6 +341,8 @@ class TrainEngine:
         cross-'data' all-gather moves 1 byte/elt), hpZ re-shards onto the
         inner axes only (per-layer gathers stay on fast ICI)."""
         pc = _cast_tree(params, self.compute_dtype)
+        if self._param_transform is not None:
+            pc = self._param_transform(pc)
         if self._secondary_shardings is None:
             return pc
         from ..ops.quantizer import dequantize_blockwise, quantize_blockwise
@@ -536,6 +543,8 @@ class TrainEngine:
         """One full optimizer step over a global batch of
         ``train_batch_size`` samples (parity with PipelineEngine.train_batch
         semantics for the non-pipelined engine)."""
+        for hook in self._step_hooks:
+            hook(self, self.global_steps)
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         self.tput.start()
@@ -565,7 +574,19 @@ class TrainEngine:
         self._last_loss = metrics["loss"]
         return metrics
 
-    # -- param offload staging (ZeRO-3 offload_param)
+    def register_param_transform(self, fn: Optional[Callable[[Any], Any]]) -> None:
+        """Install/replace a traced params transform applied at the
+        compute-cast boundary (compression QAT, pruning masks). Invalidates
+        compiled step functions — call sparingly (schedule boundaries)."""
+        self._param_transform = fn
+        self._train_step_fn = None
+        self._micro_grad_fn = None
+        self._eval_step_fn = None
+
+    def register_step_hook(self, fn: Callable[["TrainEngine", int], None]) -> None:
+        """fn(engine, global_step) before each train_batch (compression
+        schedule gating, reference scheduler.py analog)."""
+        self._step_hooks.append(fn)
     def _params_to_device(self) -> None:
         if self._param_offload_device == "nvme":
             if self.params is None:

@@ -1,0 +1,168 @@
+"""Compression suite tests (reference tests/unit/compression parity):
+QAT weight quantization, magnitude pruning masks with schedule offsets,
+head pruning, layer reduction, redundancy_clean."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.compression import (CompressionConfig, init_compression,
+                                       redundancy_clean)
+from deepspeed_tpu.models import Llama
+from deepspeed_tpu.runtime.dataloader import shard_batch
+
+
+def _model(n_layers=2):
+    return Llama("tiny", n_layers=n_layers, d_model=32, n_heads=4,
+                 n_kv_heads=4, vocab_size=64, max_seq_len=16,
+                 use_flash=False, remat=False)
+
+
+def _engine(model=None):
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+           "mesh": {"data": 8}, "steps_per_print": 1000}
+    engine, _, _, _ = dst.initialize(model=model or _model(), config=cfg,
+                                     rng=jax.random.PRNGKey(0))
+    return engine
+
+
+def _batch(seed=0):
+    return {"input_ids": np.random.default_rng(seed).integers(
+        0, 64, (8, 16)).astype(np.int32)}
+
+
+SPARSE_CFG = {"compression_training": {
+    "sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                              "method": "l1"},
+        "different_groups": {"sp1": {"params": {"dense_ratio": 0.3},
+                                     "modules": ["w_up", "w_down"]}},
+    }}}
+
+QAT_CFG = {"compression_training": {
+    "weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"wq1": {"params": {"target_bits": 8},
+                                     "modules": ["*"]}},
+    }}}
+
+
+def test_config_parsing_reference_vocabulary():
+    cfg = CompressionConfig.from_dict(SPARSE_CFG)
+    assert len(cfg.sparse_pruning) == 1
+    g = cfg.sparse_pruning[0]
+    assert g["dense_ratio"] == 0.3 and g["schedule_offset"] == 2
+    assert g["modules"] == ["w_up", "w_down"]
+    assert not cfg.weight_quantization
+
+
+def test_sparse_pruning_schedule_and_masks():
+    engine = _engine()
+    comp = init_compression(engine, SPARSE_CFG)
+    assert not comp.masks  # offset 2 not reached
+    for i in range(4):
+        engine.train_batch(shard_batch(_batch(i), engine.topo))
+    assert comp.masks, "masks never activated"
+    # masked leaves really are ~30% dense in the compute copy
+    pc = comp.transform(engine.params)
+    leaves, _ = jax.tree_util.tree_flatten_with_path(pc)
+    checked = 0
+    for path, leaf in leaves:
+        p = jax.tree_util.keystr(path)
+        if "w_up" in p or "w_down" in p:
+            density = float(jnp.mean((jnp.asarray(leaf) != 0)))
+            assert 0.15 < density < 0.45, (p, density)
+            checked += 1
+    assert checked >= 2
+    # training continues after activation (masked grads flow)
+    loss = float(engine.train_batch(shard_batch(_batch(9), engine.topo))["loss"])
+    assert np.isfinite(loss)
+
+
+def test_qat_changes_forward_and_trains():
+    e_plain = _engine()
+    base = float(e_plain.eval_batch(shard_batch(_batch(0), e_plain.topo)))
+    from deepspeed_tpu.parallel.mesh import reset_topology
+    reset_topology()
+    engine = _engine()
+    init_compression(engine, QAT_CFG)
+    quant = float(engine.eval_batch(shard_batch(_batch(0), engine.topo)))
+    assert quant != base, "QAT transform inactive"
+    np.testing.assert_allclose(quant, base, rtol=0.05)
+    losses = [float(engine.train_batch(
+        shard_batch(_batch(i), engine.topo))["loss"]) for i in range(4)]
+    assert losses[-1] < losses[0]
+
+
+def test_head_pruning_masks_whole_heads():
+    engine = _engine()
+    cfg = {"compression_training": {"head_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"hp1": {"params": {"dense_ratio": 0.5,
+                                                "num_heads": 4},
+                                     "modules": ["wo"]}},
+    }}}
+    # plant a dominant head in wo so importance ranking is observable
+    params = dict(engine.params)
+    layers = dict(params["layers"])
+    wo = np.array(layers["wo"], np.float32)         # [n_layers, d, d] (copy)
+    nh, hd = 4, 32 // 4
+    wo[:, 2 * hd:3 * hd, :] *= 100.0                 # head 2 dominates
+    layers["wo"] = jnp.asarray(wo)
+    params["layers"] = layers
+    engine.params = params
+    comp = init_compression(engine, cfg)
+    assert comp.masks
+    (path, mask), = [(p, m) for p, m in comp.masks.items() if "wo" in p]
+    # head-block structure: mask rows constant within each head
+    head_rows = mask.reshape(nh, hd, -1)
+    for h in range(nh):
+        assert len(np.unique(head_rows[h])) == 1
+    assert 0 < mask.mean() < 1
+    # the dominant head must survive the ranking
+    assert head_rows[2].max() == 1.0, "dominant head pruned (bad scoring)"
+
+
+def test_layer_reduction_student_init():
+    model = _model(n_layers=4)
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layer": 2, "teacher_layer": [0, 3]}}}
+    comp = init_compression(params, cfg)
+    student = comp.student_params(params)
+    for leaf in jax.tree_util.tree_leaves(student["layers"]):
+        assert leaf.shape[0] == 2
+    # kept layers are teacher layers 0 and 3
+    src = jax.tree_util.tree_leaves(params["layers"])[0]
+    dst_leaf = jax.tree_util.tree_leaves(student["layers"])[0]
+    np.testing.assert_array_equal(np.asarray(dst_leaf[1]), np.asarray(src[3]))
+
+
+def test_layer_reduction_on_engine_raises():
+    engine = _engine()
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layer": 1}}}
+    try:
+        init_compression(engine, cfg)
+        raise AssertionError("should have raised")
+    except ValueError as e:
+        assert "before initialize" in str(e)
+
+
+def test_redundancy_clean_bakes_masks():
+    engine = _engine()
+    comp = init_compression(engine, SPARSE_CFG)
+    for i in range(3):
+        engine.train_batch(shard_batch(_batch(i), engine.topo))
+    cleaned = redundancy_clean(engine, SPARSE_CFG, compressor=comp)
+    leaves, _ = jax.tree_util.tree_flatten_with_path(cleaned)
+    hit = 0
+    for path, leaf in leaves:
+        p = jax.tree_util.keystr(path)
+        if p in comp.masks:
+            zeros = float(jnp.mean(jnp.asarray(leaf) == 0))
+            assert zeros > 0.4, (p, zeros)
+            hit += 1
+    assert hit >= 2
