@@ -142,3 +142,58 @@ def test_onebit_adam_converges():
     # stays in the neighborhood the dense phase reached, far below start
     assert min(compressed_phase) < losses[0] * 0.1
     assert max(compressed_phase) < losses[0]
+
+
+def test_onebit_lamb_converges():
+    """1-bit LAMB (reference onebit/lamb.py): trust-ratio update trains
+    through warmup and the compressed phase."""
+    from deepspeed_tpu.runtime.onebit import OnebitLamb
+
+    topo = mesh_mod.Topology.build_virtual({"data": 4})
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(16, 4))
+    X = rng.normal(size=(64, 16)).astype(np.float32)
+    Y = (X @ w_true).astype(np.float32)
+
+    def loss_fn(params, batch, _):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    # LAMB's trust ratio scales updates by ||p||/||u|| — zero-init params
+    # would clamp it to the floor; start near the task's weight scale
+    params = {"w": jnp.asarray(rng.normal(size=(16, 4)) * 0.3, jnp.float32)}
+    opt = OnebitLamb(loss_fn, params, topo.mesh, lr=0.05, freeze_step=60)
+    batch = {"x": jnp.asarray(X), "y": jnp.asarray(Y)}
+    losses = [opt.step(batch) for _ in range(150)]
+    assert losses[10] < losses[0]
+    assert opt.compression_active
+    assert np.isfinite(losses).all()
+    assert min(losses[60:]) < losses[0] * 0.1
+
+
+def test_zero_one_adam_local_steps_and_convergence():
+    """0/1 Adam (reference onebit/zoadam.py): syncs run at growing
+    intervals (real comm skipped on local steps), still converges."""
+    from deepspeed_tpu.runtime.onebit import ZeroOneAdam
+
+    topo = mesh_mod.Topology.build_virtual({"data": 4})
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(16, 4))
+    X = rng.normal(size=(64, 16)).astype(np.float32)
+    Y = (X @ w_true).astype(np.float32)
+
+    def loss_fn(params, batch, _):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    params = {"w": jnp.zeros((16, 4), jnp.float32)}
+    opt = ZeroOneAdam(loss_fn, params, topo.mesh, lr=0.03,
+                      var_freeze_step=40, local_step_scaler=20,
+                      local_step_clipper=8)
+    batch = {"x": jnp.asarray(X), "y": jnp.asarray(Y)}
+    losses = [opt.step(batch) for _ in range(100)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.1
+    # local stepping really reduced sync frequency
+    assert opt.sync_steps < opt.steps * 0.7
+    assert opt.sync_steps >= 5
