@@ -1,0 +1,120 @@
+"""Universal-checkpoint tooling (CLI).
+
+Reference surface: ``deepspeed/checkpoint/ds_to_universal.py:286`` (convert
+a sharded ZeRO checkpoint into topology-free per-param files) and
+``deepspeed/utils/zero_to_fp32.py`` (offline consolidation of ZeRO shards
+into a plain fp32 state dict).
+
+The native checkpoint layout is ALREADY topology-independent — every leaf
+is stored as a full logical array (runtime/checkpoint.py), so no shard
+merging is needed. These tools exist for the same downstream uses as the
+reference's:
+
+* ``to-universal`` — explode a checkpoint into one ``.npy`` file per param
+  plus ``universal_index.json`` (framework-free consumption, surgical
+  editing, partial loads);
+* ``zero-to-fp32`` — one ``.npz`` with every param consolidated to fp32
+  (drop-in for the reference's ``zero_to_fp32.py`` output).
+
+Usage:
+    python -m deepspeed_tpu.checkpoint.universal to-universal CKPT_DIR OUT_DIR [--tag TAG]
+    python -m deepspeed_tpu.checkpoint.universal zero-to-fp32 CKPT_DIR OUT_FILE [--tag TAG]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _load_state(ckpt_dir: str, tag: Optional[str] = None) -> Dict[str, Any]:
+    import orbax.checkpoint as ocp
+
+    if tag is None:
+        latest = os.path.join(ckpt_dir, "latest")
+        if not os.path.isfile(latest):
+            raise FileNotFoundError(f"no 'latest' pointer in {ckpt_dir}; pass --tag")
+        with open(latest) as f:
+            tag = f.read().strip()
+    state_path = os.path.join(ckpt_dir, str(tag), "state")
+    if not os.path.isdir(state_path):
+        raise FileNotFoundError(f"checkpoint state dir not found: {state_path}")
+    restored = ocp.StandardCheckpointer().restore(os.path.abspath(state_path))
+    return restored
+
+
+def _flat_params(state: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    import jax
+
+    params = state.get("params", state)
+    leaves, _ = jax.tree_util.tree_flatten_with_path(params)
+    out = {}
+    for path, leaf in leaves:
+        key = jax.tree_util.keystr(path)
+        key = re.sub(r"[^A-Za-z0-9_.]+", ".", key).strip(".")
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def to_universal(ckpt_dir: str, out_dir: str, tag: Optional[str] = None) -> str:
+    """Explode a checkpoint into per-param .npy files + an index
+    (reference ds_to_universal.py:286 main)."""
+    flat = _flat_params(_load_state(ckpt_dir, tag))
+    os.makedirs(out_dir, exist_ok=True)
+    index = {}
+    for key, arr in flat.items():
+        fname = f"{key}.npy"
+        np.save(os.path.join(out_dir, fname), arr)
+        index[key] = {"file": fname, "shape": list(arr.shape),
+                      "dtype": str(arr.dtype)}
+    with open(os.path.join(out_dir, "universal_index.json"), "w") as f:
+        json.dump({"version": 1, "params": index}, f, indent=2)
+    return out_dir
+
+
+def zero_to_fp32(ckpt_dir: str, out_file: str, tag: Optional[str] = None) -> str:
+    """Consolidate every param to fp32 in one .npz (reference
+    utils/zero_to_fp32.py convert_zero_checkpoint_to_fp32_state_dict)."""
+    flat = _flat_params(_load_state(ckpt_dir, tag))
+    fp32 = {k: np.asarray(v, np.float32) for k, v in flat.items()}
+    os.makedirs(os.path.dirname(os.path.abspath(out_file)), exist_ok=True)
+    np.savez(out_file, **fp32)
+    return out_file
+
+
+def load_universal(universal_dir: str) -> Dict[str, np.ndarray]:
+    """Read a to-universal directory back into a flat {key: array} dict."""
+    with open(os.path.join(universal_dir, "universal_index.json")) as f:
+        index = json.load(f)["params"]
+    return {k: np.load(os.path.join(universal_dir, meta["file"]))
+            for k, meta in index.items()}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="deepspeed_tpu.checkpoint.universal",
+                                description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pu = sub.add_parser("to-universal")
+    pu.add_argument("ckpt_dir")
+    pu.add_argument("out_dir")
+    pu.add_argument("--tag", default=None)
+    pf = sub.add_parser("zero-to-fp32")
+    pf.add_argument("ckpt_dir")
+    pf.add_argument("out_file")
+    pf.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    if args.cmd == "to-universal":
+        out = to_universal(args.ckpt_dir, args.out_dir, args.tag)
+    else:
+        out = zero_to_fp32(args.ckpt_dir, args.out_file, args.tag)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -73,8 +73,19 @@ class CheckpointEngine:
             shutil.rmtree(state_path)
         os.makedirs(path, exist_ok=True)
         self._ckptr.save(os.path.abspath(state_path), state)
-        if self._async_save:
+        # orbax may finalize in the background even on the "sync" path (the
+        # state dir appears as *.orbax-checkpoint-tmp until renamed) — wait
+        # so callers can read the checkpoint immediately after save()
+        if hasattr(self._ckptr, "wait_until_finished"):
             self._ckptr.wait_until_finished()
+        import time as _time
+
+        for _ in range(600):
+            if os.path.isdir(state_path):
+                break
+            _time.sleep(0.05)
+        else:
+            raise RuntimeError(f"checkpoint finalize timed out: {state_path}")
         meta = {
             "tag": tag,
             "client_state": client_state or {},
