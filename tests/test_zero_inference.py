@@ -1,0 +1,74 @@
+"""ZeRO-Inference weight-quantized serving (reference
+inference/quantization/: int8/int4 weight-only quantization cutting HBM so
+bigger models fit; README.md:22 '20x faster inference' pillar)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.models import Llama
+
+
+def _model():
+    return Llama("tiny", n_layers=2, d_model=256, n_heads=4, n_kv_heads=2,
+                 vocab_size=512, max_seq_len=64, use_flash=False, remat=False)
+
+
+def _engine(quant=None):
+    from deepspeed_tpu.parallel.mesh import reset_topology
+
+    reset_topology()
+    cfg = {"dtype": "float32", "tensor_parallel": 1, "temperature": 0.0}
+    if quant:
+        cfg["quant"] = quant
+    return dst.init_inference((_model(), _model().init(jax.random.PRNGKey(0))),
+                              config=cfg)
+
+
+def test_quantized_params_stored_compressed():
+    dense = _engine()
+    q8 = _engine({"enabled": True, "bits": 8})
+    # weights really are held int8: >=3x smaller than fp32 storage
+    assert q8.param_bytes() < dense.param_bytes() / 3
+    from deepspeed_tpu.inference.engine import _is_wq
+
+    n_q = sum(1 for leaf in jax.tree_util.tree_leaves(q8.params, is_leaf=_is_wq)
+              if _is_wq(leaf))
+    assert n_q >= 6
+
+
+def test_quantized_logits_close_and_greedy_matches():
+    tokens = np.random.default_rng(0).integers(1, 500, (2, 12)).astype(np.int32)
+    dense = _engine()
+    ref = np.asarray(dense.forward(tokens), np.float32)
+    q8 = _engine({"enabled": True, "bits": 8})
+    got = np.asarray(q8.forward(tokens), np.float32)
+    # int8 block-256 weight quantization: small logit perturbation
+    assert np.abs(got - ref).max() < 0.25 * np.abs(ref).max()
+
+    out_d = dense.generate(tokens, max_new_tokens=6)
+    out_q = q8.generate(tokens, max_new_tokens=6)
+    assert out_q.shape == out_d.shape
+    # random-init logits are near-uniform so greedy picks may diverge; the
+    # decode path itself must run and emit valid ids
+    assert (out_q[:, :12] == tokens).all()
+    assert (out_q >= 0).all() and (out_q < 512).all()
+
+
+def test_int4_quantization_runs_and_is_really_4bit():
+    q4 = _engine({"enabled": True, "bits": 4, "group_size": 128})
+    tokens = np.random.default_rng(1).integers(1, 500, (1, 8)).astype(np.int32)
+    out = q4.generate(tokens, max_new_tokens=4)
+    assert out.shape == (1, 12)
+    dense = _engine()
+    q8 = _engine({"enabled": True, "bits": 8})
+    # nibble packing: int4 residency is really ~half of int8, ~7x of fp32
+    assert q4.param_bytes() < dense.param_bytes() / 5
+    assert q4.param_bytes() < q8.param_bytes() * 0.75
+    # int4 forward still tracks the dense logits loosely
+    ref = np.asarray(dense.forward(tokens), np.float32)
+    got = np.asarray(q4.forward(tokens), np.float32)
+    assert np.isfinite(got).all()
+    assert np.abs(got - ref).max() < 0.6 * np.abs(ref).max()
