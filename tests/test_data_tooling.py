@@ -1,0 +1,82 @@
+"""Indexed dataset + DataAnalyzer (reference data_sampling/
+indexed_dataset.py + data_analyzer.py parity)."""
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+    DataAnalyzer, load_sample_to_metric, metric_seqlen,
+    samples_up_to_difficulty)
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset, make_builder)
+
+
+def _write_corpus(tmp_path, n=50, seed=0):
+    rng = np.random.default_rng(seed)
+    prefix = str(tmp_path / "corpus")
+    builder = make_builder(prefix, dtype=np.int32)
+    seqs = []
+    for i in range(n):
+        seq = rng.integers(0, 1000, size=rng.integers(4, 40)).astype(np.int32)
+        seqs.append(seq)
+        builder.add_item(seq)
+        if i % 10 == 9:
+            builder.end_document()
+    builder.finalize(prefix + ".idx")
+    return prefix, seqs
+
+
+def test_mmap_roundtrip(tmp_path):
+    prefix, seqs = _write_corpus(tmp_path)
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == len(seqs)
+    assert ds.dtype == np.int32
+    for i in (0, 7, 23, 49):
+        np.testing.assert_array_equal(ds[i], seqs[i])
+    # document boundaries recorded every 10 sequences
+    assert list(ds.doc_idx) == [0, 10, 20, 30, 40, 50]
+
+
+def test_mmap_partial_get(tmp_path):
+    prefix, seqs = _write_corpus(tmp_path)
+    ds = MMapIndexedDataset(prefix)
+    i = max(range(len(seqs)), key=lambda j: len(seqs[j]))
+    np.testing.assert_array_equal(ds.get(i, offset=2, length=3), seqs[i][2:5])
+
+
+def test_mmap_is_zero_copy(tmp_path):
+    prefix, seqs = _write_corpus(tmp_path)
+    ds = MMapIndexedDataset(prefix)
+    view = ds[0]
+    assert isinstance(view, np.ndarray)
+    assert not view.flags.owndata  # a view into the mmap, not a copy
+
+
+def test_bad_magic_rejected(tmp_path):
+    bad = tmp_path / "bad"
+    (tmp_path / "bad.bin").write_bytes(b"data")
+    (tmp_path / "bad.idx").write_bytes(b"NOTMMIDX\x00\x00" + b"\x00" * 32)
+    try:
+        MMapIndexedDataset(str(bad))
+        raise AssertionError("should reject bad magic")
+    except ValueError as e:
+        assert "magic" in str(e)
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    prefix, seqs = _write_corpus(tmp_path)
+    ds = MMapIndexedDataset(prefix)
+    analyzer = DataAnalyzer(ds, ["seqlen"], [metric_seqlen],
+                            save_path=str(tmp_path / "analysis"),
+                            batch_size=16)
+    result = analyzer.run_map_reduce()
+    info = result["seqlen"]
+    # sample_to_metric roundtrips as the true lengths
+    vals = load_sample_to_metric(info["sample_to_metric"])
+    np.testing.assert_array_equal(vals, [len(s) for s in seqs])
+    assert info["min"] == min(len(s) for s in seqs)
+    assert info["max"] == max(len(s) for s in seqs)
+    # curriculum query: difficulty cap really bounds the pool
+    easy = samples_up_to_difficulty(info["metric_to_sample"], 10)
+    assert all(len(seqs[i]) <= 10 for i in easy)
+    everything = samples_up_to_difficulty(info["metric_to_sample"], 40)
+    assert len(everything) == len(seqs)
