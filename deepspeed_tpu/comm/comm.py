@@ -178,7 +178,9 @@ def measure_comm_latencies(mesh=None, iters: int = 10) -> str:
     def collective(op, axis):
         if op == "all_reduce":
             return lambda x: jax.lax.psum(x, axis)
-        if op == "all_gather":
+        if op in ("all_gather", "sparse_allreduce"):
+            # sparse_allreduce's wire cost IS its all_gathers (rows+indices,
+            # recorded as one combined payload); the scatter-add is local
             return lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=True)
         if op == "reduce_scatter":
             return lambda x: jax.lax.psum_scatter(x, axis, tiled=True)
@@ -336,6 +338,27 @@ def broadcast(x, axis_name: str, src_index: int = 0):
     idx = jax.lax.axis_index(axis_name)
     masked = jnp.where(idx == src_index, x, jnp.zeros_like(x))
     return jax.lax.psum(masked, axis_name)
+
+
+def sparse_allreduce(rows, indices, axis_name: str, dense_dim: int):
+    """Sparse (embedding-)gradient allreduce: each rank contributes only the
+    rows its batch touched — ``rows [k, d]`` at ``indices [k]`` — and the
+    wire moves ``world*k*d`` elements instead of the dense ``vocab*d``.
+
+    Reference: ``runtime/engine.py`` ``sparse_allreduce_bucket`` /
+    ``sparse_gradients_enabled`` (torch SparseTensor allreduce for
+    ``nn.Embedding``). Returns the dense [dense_dim, d] reduced gradient.
+    Must run inside shard_map with ``axis_name`` manual; ``k`` must be
+    equal across ranks (pad with a repeated index — scatter-add makes
+    duplicate indices safe)."""
+    # wire payload = rows AND indices (both all_gathered below)
+    _COMMS_LOGGER.append("sparse_allreduce",
+                         _nbytes(rows) + _nbytes(indices), 0.0, 0, axis_name)
+    rows_all = jax.lax.all_gather(rows, axis_name, axis=0, tiled=True)
+    idx_all = jax.lax.all_gather(indices, axis_name, axis=0, tiled=True)
+    dense = jnp.zeros((dense_dim,) + rows.shape[1:],
+                      jnp.promote_types(rows.dtype, jnp.float32))
+    return dense.at[idx_all].add(rows_all.astype(dense.dtype))
 
 
 def ppermute(x, axis_name: str, perm):

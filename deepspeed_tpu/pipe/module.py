@@ -12,8 +12,12 @@ methods uniform/parameters/type:regex) — re-designed for functional JAX:
   uses reference the same leaf;
 * partitioning returns stage boundaries; execution is either the compiled
   rotating-microbatch pipeline (``parallel/pipeline.py``) when every stage
-  is structurally identical (the transformer fast path), or a sequential
-  composition under GSPMD with per-stage sharding hints otherwise.
+  is structurally identical (the transformer fast path), or — for
+  heterogeneous graphs — :meth:`pipeline_loss` pipelines the longest run
+  of structurally identical layers (the repeated trunk) and runs the
+  asymmetric prefix/suffix (embedding, head, reshapes) under plain GSPMD,
+  exactly how the reference's ``partition_method`` ends up treating the
+  embed/head stages (runtime/pipe/module.py:86).
 """
 
 from __future__ import annotations
@@ -122,6 +126,8 @@ class PipelineModule:
         self.loss_fn = loss_fn
         self._built = [s.build() if isinstance(s, LayerSpec) else s for s in self.specs]
         self.parts = self._partition_layers()
+        self._mesh = None
+        self._pipe_size = 1
 
     # -- partitioning ---------------------------------------------------
     def _layer_param_counts(self) -> List[float]:
@@ -185,9 +191,136 @@ class PipelineModule:
     def apply(self, params: Dict[str, Any], x: Any, **kwargs) -> Any:
         """Sequential forward through all layers. Under GSPMD with the
         ``pipe``-axis placement from :meth:`partition_specs` this is the
-        correctness path; the homogeneous-stage fast path goes through
-        ``parallel/pipeline.py`` (see models/transformer.py)."""
-        for i, (spec, layer) in enumerate(zip(self.specs, self._built)):
+        correctness path; :meth:`pipeline_loss` pipelines the homogeneous
+        trunk through ``parallel/pipeline.py``."""
+        return self._apply_range(params, x, 0, len(self._built))
+
+    def loss(self, params: Dict[str, Any], batch: Any, rng=None) -> jnp.ndarray:
+        assert self.loss_fn is not None, "PipelineModule needs loss_fn for training"
+        out = self.apply(params, batch["input"] if isinstance(batch, dict) else batch)
+        target = batch["target"] if isinstance(batch, dict) else None
+        return self.loss_fn(out, target)
+
+    # -- heterogeneous-graph pipelining ---------------------------------
+    def bind_topology(self, topo) -> None:
+        self._mesh = topo.mesh
+        self._pipe_size = topo.pipe_parallel_size
+
+    def _param_signature(self, i: int):
+        layer = self._built[i]
+        spec = self.specs[i]
+        if not _is_layer_obj(layer) or isinstance(spec, TiedLayerSpec):
+            return None
+        shapes = jax.eval_shape(lambda l=layer: l.init(jax.random.PRNGKey(0)))
+        leaves, treedef = jax.tree_util.tree_flatten(shapes)
+        # construction args are part of the identity: two same-class layers
+        # with identical param shapes but different config (activation,
+        # flags, ...) must NOT merge into one trunk — the scan body applies
+        # ONE layer's behavior to every trunk slice. Layers whose apply is
+        # arg-independent (e.g. only an init seed differs) opt out by
+        # exposing pipeline_signature().
+        if hasattr(layer, "pipeline_signature"):
+            behavior = (repr(layer.pipeline_signature()),)
+        elif isinstance(spec, LayerSpec):
+            behavior = (repr(spec.args), repr(sorted(spec.kwargs.items())))
+        else:
+            behavior = (repr(sorted((k, repr(v)) for k, v in
+                                    vars(layer).items()))
+                        if hasattr(layer, "__dict__") else "",)
+        return (type(layer).__name__, str(treedef),
+                tuple((tuple(s.shape), str(s.dtype)) for s in leaves),
+                behavior)
+
+    def _signatures(self):
+        if not hasattr(self, "_sig_cache") or self._sig_cache is None:
+            self._sig_cache = [self._param_signature(i)
+                               for i in range(len(self._built))]
+        return self._sig_cache
+
+    def pipeline_trunk(self, stages: Optional[int] = None) -> Tuple[int, int]:
+        """[start, end) of the longest run of structurally identical layers
+        whose length divides by the executing stage count (the bound
+        topology's pipe size when available — NOT necessarily
+        ``num_stages``) — the pipelinable middle of an
+        embed/trunk/head-asymmetric graph.
+
+        Signature identity means: same layer class, same construction
+        args, same param treedef/shapes/dtypes. Layers whose apply does
+        not depend on constructor args (e.g. only an init seed differs)
+        expose ``pipeline_signature()`` to merge anyway."""
+        if stages is None:
+            stages = (self._pipe_size if getattr(self, "_pipe_size", 1) > 1
+                      else self.num_stages)
+        sigs = self._signatures()
+        best = (0, 0)
+        i = 0
+        while i < len(sigs):
+            if sigs[i] is None:
+                i += 1
+                continue
+            j = i
+            while j < len(sigs) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        start, end = best
+        n = end - start
+        usable = n - (n % stages)
+        return start, start + usable
+
+    def pipeline_loss(self, params: Dict[str, Any], batch: Any, rng,
+                      num_microbatches: int) -> jnp.ndarray:
+        """Pipelined loss for heterogeneous graphs: prefix and suffix
+        layers run sequentially under GSPMD on the full batch; the
+        homogeneous trunk runs through the rotating-microbatch executor
+        over the ``pipe`` axis (stage body = scan over its trunk slice)."""
+        from ..parallel.pipeline import (microbatch, pipeline_apply,
+                                         stack_stage_params)
+
+        assert self.loss_fn is not None, "PipelineModule needs loss_fn"
+        assert getattr(self, "_pipe_size", 1) > 1 and self._mesh is not None, \
+            "pipeline_loss requires bind_topology with pipe axis > 1"
+        # stage count for EXECUTION is the bound topology's pipe size —
+        # num_stages (the partitioning hint) may differ
+        start, end = self.pipeline_trunk(self._pipe_size)
+        if end - start < self._pipe_size:
+            # nothing pipelinable — fall back to the sequential GSPMD path
+            return self.loss(params, batch, rng)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        x = batch["input"] if isinstance(batch, dict) else batch
+        target = batch["target"] if isinstance(batch, dict) else None
+        x = self._apply_range(params, x, 0, start)
+
+        trunk_idx = list(range(start, end))
+        trunk_apply = self._built[start].apply
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves),
+            *[params["layers"][str(i)] for i in trunk_idx])
+        stage_params = stack_stage_params(stacked, self._pipe_size)
+
+        xs = microbatch(x, num_microbatches).astype(jnp.float32)
+
+        def stage_fn(lp_stage, xmb, consts, sub_rng, valid):
+            dtype = x.dtype
+
+            def body(y, lp):
+                return trunk_apply(lp, y.astype(dtype)).astype(jnp.float32), None
+
+            y, _ = jax.lax.scan(body, xmb.astype(jnp.float32), lp_stage)
+            return y, jnp.zeros([], jnp.float32)
+
+        ys, _ = pipeline_apply(stage_fn, stage_params, xs, rng, self._mesh,
+                               consts=jnp.zeros([], jnp.float32))
+        y = ys.reshape((-1,) + ys.shape[2:]).astype(x.dtype)
+        y = self._apply_range(params, y, end, len(self._built))
+        return self.loss_fn(y, target)
+
+    def _apply_range(self, params, x, lo: int, hi: int):
+        for i in range(lo, hi):
+            spec, layer = self.specs[i], self._built[i]
             if _is_layer_obj(layer):
                 if isinstance(spec, TiedLayerSpec):
                     p = params["tied"][spec.key]
@@ -198,12 +331,6 @@ class PipelineModule:
             else:
                 x = layer(x)
         return x
-
-    def loss(self, params: Dict[str, Any], batch: Any, rng=None) -> jnp.ndarray:
-        assert self.loss_fn is not None, "PipelineModule needs loss_fn for training"
-        out = self.apply(params, batch["input"] if isinstance(batch, dict) else batch)
-        target = batch["target"] if isinstance(batch, dict) else None
-        return self.loss_fn(out, target)
 
     def __len__(self):
         return len(self.specs)

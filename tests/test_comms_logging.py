@@ -68,3 +68,26 @@ def test_measured_latencies_are_real(logger_on):
     assert data_rows
     # avg-latency column (third from the right) shows real measured ms
     assert all(float(ln.split()[-3]) > 0 for ln in data_rows)
+
+
+def test_sparse_allreduce_matches_dense(logger_on):
+    """Sparse embedding-grad reduction == dense scatter + psum."""
+    topo = Topology.build_virtual({"data": 4})
+    set_topology(topo)
+    V, d, k = 32, 8, 4
+    rng = np.random.default_rng(0)
+    rows = jnp.asarray(rng.normal(size=(4, k, d)), jnp.float32)
+    idx = jnp.asarray(rng.integers(0, V, (4, k)), jnp.int32)
+
+    def spmd(rows, idx):
+        return comm.sparse_allreduce(rows[0], idx[0], "data", V)[None]
+
+    got = jax.jit(jax.shard_map(
+        spmd, mesh=topo.mesh, axis_names={"data"},
+        in_specs=(P("data"), P("data")), out_specs=P("data"),
+        check_vma=False))(rows, idx)
+    dense = np.zeros((V, d), np.float32)
+    for r in range(4):
+        for j in range(k):
+            dense[int(idx[r, j])] += np.asarray(rows[r, j])
+    np.testing.assert_allclose(np.asarray(got)[0], dense, rtol=1e-5)
