@@ -18,9 +18,12 @@ Layout contract (chosen for TPU tiling):
   positions:[T] int32                   absolute position of each token
 Output:     [T, hq, hd]
 
-Grid: (T, hkv, max_pages) with pages innermost; online softmax in VMEM
-scratch (flash-2 style, as ops/pallas/flash_attention.py). Pages past a
-token's context are skipped compute-side via ``pl.when`` AND their index
+Grid: (T, max_pages) with pages innermost and ALL kv heads folded into
+each step — one [hkv, block, hd] page DMA per step (hkv x bigger than a
+per-head grid, which at block 16 moved 2 KB per step and was DMA-latency
+bound). Online softmax in VMEM scratch (flash-2 style, as
+ops/pallas/flash_attention.py) over [hkv*group, ...] row tiles. Pages past
+a token's context are skipped compute-side via ``pl.when`` AND their index
 map is clamped to the last visible page — Pallas elides the copy when the
 block index repeats, so dead pages cost no DMA either.
 """
@@ -43,9 +46,9 @@ def _kernel(tables_ref, pos_ref,          # scalar prefetch
             q_ref, k_ref, v_ref,          # blocks
             o_ref,                        # out
             m_scr, l_scr, acc_scr,
-            *, scale: float, block: int):
-    t, p = pl.program_id(0), pl.program_id(2)
-    np_pages = pl.num_programs(2)
+            *, scale: float, block: int, hkv: int, group: int):
+    t, p = pl.program_id(0), pl.program_id(1)
+    np_pages = pl.num_programs(1)
 
     @pl.when(p == 0)
     def _init():
@@ -58,31 +61,36 @@ def _kernel(tables_ref, pos_ref,          # scalar prefetch
 
     @pl.when(run)
     def _step():
-        q = q_ref[0, 0]                              # [group, hd] bf16
-        k = k_ref[0, 0]                              # [block, hd] bf16
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        q = q_ref[0]                                 # [hkv, group, hd] bf16
+        k = k_ref[0]                                 # [hkv, block, hd] bf16
+        # batched-over-heads MXU matmul: [hkv, group, block]
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
                                 preferred_element_type=jnp.float32) * scale
+        s = s.reshape(hkv * group, block)
         row_pos = p * block + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)                   # [group, block]
+            jnp.int32, s.shape, 1)                   # [hkv*group, block]
         s = jnp.where(row_pos <= pos, s, NEG_INF)
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        pr = jnp.exp(s - m_new)
+        pr = jnp.exp(s - m_new)                      # [hkv*group, block]
         corr = jnp.exp(m_prev - m_new)
         l_scr[:] = jnp.broadcast_to(l_scr[:, :1] * corr +
                                     jnp.sum(pr, axis=-1, keepdims=True),
                                     l_scr.shape)
-        v = v_ref[0, 0]                              # [block, hd] bf16
-        pv = jax.lax.dot_general(pr.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        acc_scr[:] = acc_scr[:] * corr + pv
+        v = v_ref[0]                                 # [hkv, block, hd] bf16
+        pv = jax.lax.dot_general(
+            pr.reshape(hkv, group, block).astype(v.dtype), v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)      # [hkv, group, hd]
+        acc_scr[:] = acc_scr[:] * corr + pv.reshape(hkv * group, -1)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
     @pl.when(p == np_pages - 1)
     def _final():
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)         # fully-masked lane guard
-        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] / l_safe).reshape(o_ref.shape[1:]) \
+            .astype(o_ref.dtype)
 
 
 def paged_attention(q, k_pool, v_pool, tables, positions, *,
@@ -101,32 +109,33 @@ def paged_attention(q, k_pool, v_pool, tables, positions, *,
     tables = tables.astype(jnp.int32)
     positions = positions.astype(jnp.int32)
 
-    def q_index(t, h, p, tbl, pos):
-        return (t, h, 0, 0)
+    def q_index(t, p, tbl, pos):
+        return (t, 0, 0, 0)
 
-    def kv_index(t, h, p, tbl, pos):
+    def kv_index(t, p, tbl, pos):
         # past-the-end pages re-use the last visible page's index: Pallas
         # skips the copy when the block index repeats, so they cost no DMA
         p_c = jnp.minimum(p, pos[t] // block)
-        return (tbl[t, p_c], h, 0, 0)
+        return (tbl[t, p_c], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(T, hkv, max_pages),
+        grid=(T, max_pages),
         in_specs=[
-            pl.BlockSpec((1, 1, group, hd), q_index),
-            pl.BlockSpec((1, 1, block, hd), kv_index),
-            pl.BlockSpec((1, 1, block, hd), kv_index),
+            pl.BlockSpec((1, hkv, group, hd), q_index),
+            pl.BlockSpec((1, hkv, block, hd), kv_index),
+            pl.BlockSpec((1, hkv, block, hd), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, group, hd), q_index),
+        out_specs=pl.BlockSpec((1, hkv, group, hd), q_index),
         scratch_shapes=[
-            pltpu.VMEM((group, LANES), jnp.float32),
-            pltpu.VMEM((group, LANES), jnp.float32),
-            pltpu.VMEM((group, hd), jnp.float32),
+            pltpu.VMEM((hkv * group, LANES), jnp.float32),
+            pltpu.VMEM((hkv * group, LANES), jnp.float32),
+            pltpu.VMEM((hkv * group, hd), jnp.float32),
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, block=block),
+        functools.partial(_kernel, scale=scale, block=block,
+                          hkv=hkv, group=group),
         out_shape=jax.ShapeDtypeStruct((T, hkv, group, hd), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
