@@ -55,10 +55,16 @@ def _child_main():
 
     on_tpu = jax.devices()[0].platform == "tpu"
     use_flash = os.environ.get("DST_BENCH_FLASH", "1" if on_tpu else "0") == "1"
+    # remat policy lever for the MFU pass: none | full | selective |
+    # dots_with_no_batch_dims (selective trades memory for ~25% fewer
+    # backward FLOPs by saving matmul outputs)
+    remat_env = os.environ.get("DST_BENCH_REMAT", "selective")
+    remat = remat_env != "none"
     # ~350M-param Llama sized for a single v5e chip with Adam fp32 state
     if on_tpu:
         model = Llama("tiny", d_model=1024, n_layers=24, n_heads=16, n_kv_heads=16,
-                      d_ff=2816, vocab_size=32000, max_seq_len=2048, remat=True,
+                      d_ff=2816, vocab_size=32000, max_seq_len=2048, remat=remat,
+                      remat_policy=remat_env if remat else "full",
                       use_flash=use_flash)
         batch_size, seq_len, steps, warmup = 8, 2048, 10, 2
     else:  # CPU smoke fallback
@@ -106,6 +112,7 @@ def _child_main():
             "params": model.config.param_count(),
             "platform": jax.devices()[0].device_kind,
             "flash_attention": use_flash,
+            "remat": remat_env,
             "step_ms": round(dt / steps * 1e3, 1),
         },
     }), flush=True)

@@ -55,6 +55,7 @@ class TransformerConfig:
     use_bias: bool = False
     norm_eps: float = 1e-6
     remat: bool = True                # activation checkpointing per block
+    remat_policy: str = "full"        # full | selective | dots_with_no_batch_dims
     use_flash: bool = True
     logits_softcap: float = 0.0
     z_loss: float = 0.0
@@ -281,7 +282,9 @@ class Transformer:
                 return self._block(x, lp, angles, positions, None, r, training)
 
             if c.remat:
-                block = jax.checkpoint(block)
+                from ..runtime.activation_checkpointing import checkpoint_wrapper
+
+                block = checkpoint_wrapper(block, policy=c.remat_policy)
 
             def scan_fn(carry, lp):
                 y, r = carry

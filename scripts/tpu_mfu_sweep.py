@@ -1,0 +1,54 @@
+"""MFU sweep: run the headline bench across the big single-chip levers
+(flash attention on/off x remat policy) and report the step-time
+breakdown. This is the profile-driven pass for the MFU target: comparing
+configs isolates where the step time goes (attention kernel, backward
+recompute) without needing a profiler trace through the axon relay.
+
+Writes MFU_SWEEP_r03.json (one entry per config) and prints it.
+
+Usage: python scripts/tpu_mfu_sweep.py   (TPU claimed per child, serially)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+CONFIGS = [
+    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective"},
+    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "full"},
+    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "none"},
+    {"DST_BENCH_FLASH": "0", "DST_BENCH_REMAT": "selective"},
+]
+
+
+def main():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = []
+    for cfg in CONFIGS:
+        env = dict(os.environ, **cfg)
+        proc = subprocess.run([sys.executable, os.path.join(here, "bench.py")],
+                              env=env, capture_output=True, text=True,
+                              timeout=2400, cwd=here)
+        line = None
+        for ln in (proc.stdout or "").splitlines():
+            if ln.strip().startswith("{") and '"metric"' in ln:
+                line = json.loads(ln)
+        results.append({"config": cfg, "result": line,
+                        "rc": proc.returncode})
+        print(json.dumps(results[-1]), flush=True)
+    out = os.path.join(here, "MFU_SWEEP_r03.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2)
+    best = max((r for r in results if r["result"]),
+               key=lambda r: r["result"]["extra"]["mfu"], default=None)
+    if best:
+        print(f"BEST: {best['config']} mfu={best['result']['extra']['mfu']}",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
