@@ -151,9 +151,56 @@ def hf_config(model_dir: str):
             use_bias=hc.get("enable_bias", True), norm_eps=1e-5)
         if hc["hidden_size"] != hc.get("word_embed_proj_dim", hc["hidden_size"]):
             raise NotImplementedError("OPT word_embed_proj_dim != hidden_size")
+    elif family == "bloom":
+        nh = hc["n_head"]
+        cfg = TransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
+            n_layers=hc["n_layer"], n_heads=nh,
+            d_ff=4 * hc["hidden_size"],
+            # ALiBi extrapolates — no position table exists and real Bloom
+            # configs carry no seq_length key; the bound only sizes KV
+            # asserts, so keep it generous
+            max_seq_len=hc.get("seq_length", 131072),
+            norm="layer", activation="gelu", position="alibi",
+            embed_norm=True, tie_embeddings=True, use_bias=True,
+            norm_eps=hc.get("layer_norm_epsilon", 1e-5))
+    elif family == "gptj":
+        hd = hc["n_embd"] // hc["n_head"]
+        cfg = TransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["n_embd"],
+            n_layers=hc["n_layer"], n_heads=hc["n_head"],
+            d_ff=hc.get("n_inner") or 4 * hc["n_embd"],
+            max_seq_len=hc.get("n_positions", 2048),
+            norm="layer", activation="gelu", position="rope",
+            rope_pct=hc.get("rotary_dim", hd) / hd, rope_interleaved=True,
+            parallel_residual=True, tie_embeddings=False, use_bias=True,
+            norm_eps=hc.get("layer_norm_epsilon", 1e-5))
+    elif family == "gpt_neox":
+        act = hc.get("hidden_act", "gelu")
+        act_map = {"gelu": "gelu_exact",  # HF NeoX "gelu" is the erf GELU
+                   "gelu_new": "gelu", "gelu_fast": "gelu",
+                   "gelu_pytorch_tanh": "gelu", "relu": "relu"}
+        if act not in act_map:
+            raise NotImplementedError(f"gpt_neox hidden_act '{act}' not supported")
+        cfg = TransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
+            n_layers=hc["num_hidden_layers"],
+            n_heads=hc["num_attention_heads"],
+            d_ff=hc.get("intermediate_size", 4 * hc["hidden_size"]),
+            max_seq_len=hc.get("max_position_embeddings", 2048),
+            norm="layer", activation=act_map[act], position="rope",
+            rope_pct=hc.get("rotary_pct", 1.0),
+            rope_theta=hc.get("rotary_emb_base", 10000.0),
+            parallel_residual=hc.get("use_parallel_residual", True),
+            tie_embeddings=hc.get("tie_word_embeddings", False),
+            use_bias=True, norm_eps=hc.get("layer_norm_eps", 1e-5))
+        if not cfg.parallel_residual:
+            raise NotImplementedError(
+                "gpt_neox with use_parallel_residual=false not supported")
     else:
         raise ValueError(f"unsupported HF model_type '{family}' "
-                         f"(supported: llama, mistral, gpt2, opt)")
+                         f"(supported: llama, mistral, gpt2, opt, bloom, "
+                         f"gptj, gpt_neox)")
     return family, cfg
 
 
@@ -269,9 +316,132 @@ def _map_opt(state, c) -> Dict[str, Any]:
     return params
 
 
+def _defuse_qkv(w, n_heads: int, hd: int):
+    """Bloom/NeoX fused query_key_value weight [3*d, d] with HEADS-MAJOR
+    row layout [n_heads, 3, hd, d] -> (wq, wk, wv) in native [in, out]."""
+    d_in = w.shape[1]
+    w4 = w.reshape(n_heads, 3, hd, d_in)
+    return tuple(np.ascontiguousarray(
+        w4[:, j].reshape(n_heads * hd, d_in).T) for j in range(3))
+
+
+def _defuse_qkv_bias(b, n_heads: int, hd: int):
+    b3 = b.reshape(n_heads, 3, hd)
+    return tuple(np.ascontiguousarray(b3[:, j].reshape(-1)) for j in range(3))
+
+
+def _defused_qkv_stacks(state, fmt: str, n: int, nh: int, hd: int):
+    """Pop n layers of fused query_key_value weight+bias and return the six
+    stacked native tensors {wq,wk,wv,bq,bk,bv} (Bloom and NeoX share the
+    heads-major fused layout)."""
+    qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+    for i in range(n):
+        wq, wk, wv = _defuse_qkv(state.pop((fmt + ".weight").format(i)), nh, hd)
+        bq, bk, bv = _defuse_qkv_bias(state.pop((fmt + ".bias").format(i)),
+                                      nh, hd)
+        qs.append(wq); ks.append(wk); vs.append(wv)
+        bqs.append(bq); bks.append(bk); bvs.append(bv)
+    return {"wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
+            "bq": np.stack(bqs), "bk": np.stack(bks), "bv": np.stack(bvs)}
+
+
+def _map_bloom(state, c) -> Dict[str, Any]:
+    n, nh, hd = c.n_layers, c.n_heads, c.d_model // c.n_heads
+    pre = "transformer." if "transformer.word_embeddings.weight" in state else ""
+    L = pre + "h.{}."
+    layers = {
+        "attn_norm_w": _stack(state, L + "input_layernorm.weight", n),
+        "attn_norm_b": _stack(state, L + "input_layernorm.bias", n),
+        **_defused_qkv_stacks(state, L + "self_attention.query_key_value",
+                              n, nh, hd),
+        "wo": _stack(state, L + "self_attention.dense.weight", n, transpose=True),
+        "bo": _stack(state, L + "self_attention.dense.bias", n),
+        "mlp_norm_w": _stack(state, L + "post_attention_layernorm.weight", n),
+        "mlp_norm_b": _stack(state, L + "post_attention_layernorm.bias", n),
+        "w_up": _stack(state, L + "mlp.dense_h_to_4h.weight", n, transpose=True),
+        "b_up": _stack(state, L + "mlp.dense_h_to_4h.bias", n),
+        "w_down": _stack(state, L + "mlp.dense_4h_to_h.weight", n, transpose=True),
+        "b_down": _stack(state, L + "mlp.dense_4h_to_h.bias", n),
+    }
+    return {
+        "tok_embed": state[pre + "word_embeddings.weight"],
+        "embed_norm_w": state[pre + "word_embeddings_layernorm.weight"],
+        "embed_norm_b": state[pre + "word_embeddings_layernorm.bias"],
+        "layers": layers,
+        "final_norm_w": state[pre + "ln_f.weight"],
+        "final_norm_b": state[pre + "ln_f.bias"],
+    }
+
+
+def _map_gptj(state, c) -> Dict[str, Any]:
+    n = c.n_layers
+    pre = "transformer." if "transformer.wte.weight" in state else ""
+    L = pre + "h.{}."
+    zeros_attn = np.zeros((n, c.d_model), np.float32)
+    ln_w = _stack(state, L + "ln_1.weight", n)
+    ln_b = _stack(state, L + "ln_1.bias", n)
+    layers = {
+        # single shared LN feeds both parallel branches: duplicate it
+        "attn_norm_w": ln_w, "attn_norm_b": ln_b,
+        "mlp_norm_w": ln_w.copy(), "mlp_norm_b": ln_b.copy(),
+        "wq": _stack(state, L + "attn.q_proj.weight", n, transpose=True),
+        "wk": _stack(state, L + "attn.k_proj.weight", n, transpose=True),
+        "wv": _stack(state, L + "attn.v_proj.weight", n, transpose=True),
+        "wo": _stack(state, L + "attn.out_proj.weight", n, transpose=True),
+        # GPT-J attention has no biases; the global use_bias flag expects
+        # them, so zeros (mathematically identical)
+        "bq": zeros_attn.copy(), "bk": zeros_attn.copy(),
+        "bv": zeros_attn.copy(), "bo": zeros_attn.copy(),
+        "w_up": _stack(state, L + "mlp.fc_in.weight", n, transpose=True),
+        "b_up": _stack(state, L + "mlp.fc_in.bias", n),
+        "w_down": _stack(state, L + "mlp.fc_out.weight", n, transpose=True),
+        "b_down": _stack(state, L + "mlp.fc_out.bias", n),
+    }
+    params = {
+        "tok_embed": state[pre + "wte.weight"],
+        "layers": layers,
+        "final_norm_w": state[pre + "ln_f.weight"],
+        "final_norm_b": state[pre + "ln_f.bias"],
+        "lm_head": state["lm_head.weight"].T,
+    }
+    if "lm_head.bias" in state:
+        params["lm_head_b"] = state["lm_head.bias"]
+    return params
+
+
+def _map_gpt_neox(state, c) -> Dict[str, Any]:
+    n, nh, hd = c.n_layers, c.n_heads, c.d_model // c.n_heads
+    pre = "gpt_neox." if "gpt_neox.embed_in.weight" in state else ""
+    L = pre + "layers.{}."
+    layers = {
+        "attn_norm_w": _stack(state, L + "input_layernorm.weight", n),
+        "attn_norm_b": _stack(state, L + "input_layernorm.bias", n),
+        "mlp_norm_w": _stack(state, L + "post_attention_layernorm.weight", n),
+        "mlp_norm_b": _stack(state, L + "post_attention_layernorm.bias", n),
+        **_defused_qkv_stacks(state, L + "attention.query_key_value",
+                              n, nh, hd),
+        "wo": _stack(state, L + "attention.dense.weight", n, transpose=True),
+        "bo": _stack(state, L + "attention.dense.bias", n),
+        "w_up": _stack(state, L + "mlp.dense_h_to_4h.weight", n, transpose=True),
+        "b_up": _stack(state, L + "mlp.dense_h_to_4h.bias", n),
+        "w_down": _stack(state, L + "mlp.dense_4h_to_h.weight", n, transpose=True),
+        "b_down": _stack(state, L + "mlp.dense_4h_to_h.bias", n),
+    }
+    params = {
+        "tok_embed": state[pre + "embed_in.weight"],
+        "layers": layers,
+        "final_norm_w": state[pre + "final_layer_norm.weight"],
+        "final_norm_b": state[pre + "final_layer_norm.bias"],
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = state["embed_out.weight"].T
+    return params
+
+
 _MAPPERS: Dict[str, Callable] = {
     "llama": _map_llama, "mistral": _map_llama,
     "gpt2": _map_gpt2, "opt": _map_opt,
+    "bloom": _map_bloom, "gptj": _map_gptj, "gpt_neox": _map_gpt_neox,
 }
 
 

@@ -128,6 +128,12 @@ class RaggedInferenceEngine:
             raise ValueError(
                 f"max_context {self.config.max_context} exceeds model "
                 f"max_seq_len {c.max_seq_len} (RoPE/position table bound)")
+        if c.position == "alibi" or getattr(c, "parallel_residual", False):
+            # the ragged step inlines the block math without ALiBi bias /
+            # parallel-residual wiring; loud failure beats wrong logits
+            raise NotImplementedError(
+                "RaggedInferenceEngine does not support ALiBi or parallel-"
+                "residual families yet; use InferenceEngine (dense KV cache)")
         if self.config.max_context % self.config.kv_block_size != 0:
             raise ValueError(
                 f"max_context {self.config.max_context} must be a multiple of "
@@ -330,7 +336,7 @@ class RaggedInferenceEngine:
             # tokens/slots/positions: [T]; embeddings via the model's path
             x = model._embed(params, tokens[None, :],
                              positions=positions[None, :])[0]  # [T, d]
-            angles = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta) \
+            angles = rope_frequencies(c.rotary_dim, c.max_seq_len, c.rope_theta) \
                 if c.position == "rope" else None
             active = slots >= 0                                   # [T]
             safe_slot = jnp.maximum(slots, 0)
@@ -352,8 +358,12 @@ class RaggedInferenceEngine:
                     kk = kk + lp["bk"].reshape(c.n_kv_heads, c.head_dim)
                     vv = vv + lp["bv"].reshape(c.n_kv_heads, c.head_dim)
                 if c.position == "rope":
-                    q = apply_rotary(q[:, None], angles, positions[:, None])[:, 0]
-                    kk = apply_rotary(kk[:, None], angles, positions[:, None])[:, 0]
+                    q = apply_rotary(q[:, None], angles, positions[:, None],
+                                     rotary_dim=c.rotary_dim,
+                                     interleaved=c.rope_interleaved)[:, 0]
+                    kk = apply_rotary(kk[:, None], angles, positions[:, None],
+                                      rotary_dim=c.rotary_dim,
+                                      interleaved=c.rope_interleaved)[:, 0]
                 # scatter new K/V into this layer's pages:
                 # page = table[pos // bs], row = pos % bs
                 page = jnp.take_along_axis(tables, (positions // bs)[:, None],

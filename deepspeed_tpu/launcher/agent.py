@@ -1,0 +1,99 @@
+"""Elastic agent: supervise a training process, restart on failure.
+
+Reference surface: ``deepspeed/elasticity/elastic_agent.py:28``
+(``DSElasticAgent._invoke_run`` :118 — monitor the worker group, restart
+within ``max_restarts`` on failure/membership change, torchrun rendezvous
+handling node join/leave).
+
+TPU-native redesign: there is no torch-elastic rendezvous to subclass —
+a TPU slice under one controller restarts as a unit. The agent is a
+process supervisor: it launches the training command, watches for
+failure, and restarts it up to ``max_restarts`` times with
+``DST_ELASTIC_RESTART=<n>`` exported so the trainee knows to resume from
+its latest checkpoint (resume-from-latest is the recovery mechanism —
+SURVEY §5.3; cross-mesh resume is already checkpoint-native). A restart
+honors an optional backoff and re-reads the world size from the
+environment, so a shrunk slice resumes with a recomputed elastic batch
+config (elasticity/elasticity.py compute_elastic_config).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+from ..utils.logging import logger
+
+
+@dataclass
+class AgentReport:
+    restarts: int
+    returncode: int
+    history: List[int] = field(default_factory=list)
+
+    @property
+    def succeeded(self) -> bool:
+        return self.returncode == 0
+
+
+class ElasticAgent:
+    """Supervise ``cmd`` with restart-on-failure semantics
+    (DSElasticAgent parity)."""
+
+    def __init__(self, cmd: Sequence[str], max_restarts: int = 3,
+                 backoff_s: float = 1.0,
+                 env: Optional[dict] = None,
+                 on_restart: Optional[Callable[[int], None]] = None):
+        self.cmd = list(cmd)
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.env = dict(env if env is not None else os.environ)
+        self.on_restart = on_restart
+
+    def run(self) -> AgentReport:
+        history: List[int] = []
+        for attempt in range(self.max_restarts + 1):
+            env = dict(self.env, DST_ELASTIC_RESTART=str(attempt))
+            proc = subprocess.run(self.cmd, env=env)
+            history.append(proc.returncode)
+            if proc.returncode == 0:
+                return AgentReport(restarts=attempt, returncode=0,
+                                   history=history)
+            logger.warning(
+                f"elastic agent: worker failed rc={proc.returncode} "
+                f"(attempt {attempt + 1}/{self.max_restarts + 1})")
+            if attempt < self.max_restarts:
+                if self.on_restart is not None:
+                    self.on_restart(attempt)
+                time.sleep(self.backoff_s)
+        return AgentReport(restarts=self.max_restarts,
+                           returncode=history[-1], history=history)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: ``python -m deepspeed_tpu.launcher.agent [--max-restarts N]
+    -- cmd args...``"""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="deepspeed_tpu.launcher.agent")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--backoff", type=float, default=1.0)
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="training command (prefix with --)")
+    args = p.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        p.error("no command given")
+    report = ElasticAgent(cmd, max_restarts=args.max_restarts,
+                          backoff_s=args.backoff).run()
+    logger.info(f"elastic agent: done restarts={report.restarts} "
+                f"rc={report.returncode}")
+    return report.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

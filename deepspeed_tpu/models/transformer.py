@@ -35,7 +35,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import dot_product_attention, flash_attention
 from ..ops.norms import layer_norm, rms_norm
-from ..ops.rotary import apply_rotary, rope_frequencies
+from ..ops.rotary import alibi_slopes, apply_rotary, rope_frequencies
 
 
 @dataclass
@@ -62,6 +62,11 @@ class TransformerConfig:
     # sequence-parallel attention when the mesh's seq axis > 1:
     # "auto" = ulysses when n_heads divides the seq axis, else ring
     sp_attention: str = "auto"        # auto | ulysses | ring
+    # family coverage knobs (Bloom / GPT-J / GPT-NeoX):
+    rope_pct: float = 1.0             # fraction of head_dim rotated (NeoX)
+    rope_interleaved: bool = False    # GPT-J pairing instead of half-split
+    parallel_residual: bool = False   # x + attn(ln1 x) + mlp(ln2 x)
+    embed_norm: bool = False          # LayerNorm after token embed (Bloom)
 
     def __post_init__(self):
         if self.n_kv_heads is None:
@@ -76,6 +81,11 @@ class TransformerConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        """Rotated dims per head (GPT-NeoX rope_pct), even-rounded."""
+        return int(self.head_dim * self.rope_pct) // 2 * 2
 
     def _shared_param_count(self) -> int:
         """Attention + norms + embeddings (everything but the FFN)."""
@@ -177,6 +187,9 @@ class Transformer:
             params["final_norm_b"] = jnp.zeros((c.d_model,), dtype)
         if c.position == "learned":
             params["pos_embed"] = dense(next(k), (c.max_seq_len, c.d_model), scale=0.02)
+        if c.embed_norm:
+            params["embed_norm_w"] = jnp.ones((c.d_model,), dtype)
+            params["embed_norm_b"] = jnp.zeros((c.d_model,), dtype)
         if not c.tie_embeddings:
             params["lm_head"] = dense(next(k), (c.d_model, c.vocab_size))
         return params
@@ -213,8 +226,19 @@ class Transformer:
         kk = kk.reshape(b, s, c.n_kv_heads, hd)
         vv = vv.reshape(b, s, c.n_kv_heads, hd)
         if c.position == "rope":
-            q = apply_rotary(q, angles, positions)
-            kk = apply_rotary(kk, angles, positions)
+            # apply_rotary no-ops the partial slice when rotary_dim == hd
+            q = apply_rotary(q, angles, positions, rotary_dim=c.rotary_dim,
+                             interleaved=c.rope_interleaved)
+            kk = apply_rotary(kk, angles, positions, rotary_dim=c.rotary_dim,
+                              interleaved=c.rope_interleaved)
+
+        def _alibi_bias(skv):
+            # ALiBi (Bloom): logits += slopes * (k_pos - q_pos); the per-row
+            # -slopes*q_pos shift is constant along the softmax axis and
+            # cancels, so slopes * k_pos alone is exact under row softmax
+            slopes = alibi_slopes(c.n_heads)
+            return (slopes[:, None, None]
+                    * jnp.arange(skv, dtype=jnp.float32)[None, None, :])
 
         new_kv = None
         if kv_cache is not None:
@@ -228,9 +252,18 @@ class Transformer:
             q_abs = cache_pos + jnp.arange(s)                   # [s]
             k_pos = jnp.arange(ck.shape[1])                     # [max_len]
             mask = (k_pos[None, :] <= q_abs[:, None])[None, None]  # [1,1,s,max_len]
-            attn = dot_product_attention(q, ck, cv, causal=False, mask=mask)
+            bias = _alibi_bias(ck.shape[1]) if c.position == "alibi" else None
+            attn = dot_product_attention(q, ck, cv, causal=False, mask=mask,
+                                         bias=bias)
         elif self._seq_size > 1:
+            if c.position == "alibi":
+                raise NotImplementedError(
+                    "ALiBi + sequence-parallel attention not supported yet")
             attn = self._sp_attention(q, kk, vv)
+        elif c.position == "alibi":
+            # flash kernel carries no additive bias — use the jnp path
+            attn = dot_product_attention(q, kk, vv, causal=True,
+                                         bias=_alibi_bias(s))
         elif c.use_flash:
             attn = flash_attention(q, kk, vv, causal=True)
         else:
@@ -239,8 +272,15 @@ class Transformer:
         attn = attn.reshape(b, s, c.n_heads * hd) @ lp["wo"]
         if c.use_bias:
             attn = attn + lp["bo"]
-        x = x + attn
 
+        if c.parallel_residual:
+            # GPT-J / GPT-NeoX: both branches read the SAME input x
+            # (GPT-J's single shared LN arrives as duplicated norm params)
+            h2 = self._norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
+            down, aux = self._mlp(h2, lp, rng, training)
+            return x + attn + down, new_kv, aux
+
+        x = x + attn
         h = self._norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
         down, aux = self._mlp(h, lp, rng, training)
         return x + down, new_kv, aux
@@ -254,7 +294,12 @@ class Transformer:
             up = h @ lp["w_up"]
             if c.use_bias:
                 up = up + lp["b_up"]
-            up = jax.nn.relu(up) if c.activation == "relu" else jax.nn.gelu(up)
+            if c.activation == "relu":
+                up = jax.nn.relu(up)
+            elif c.activation == "gelu_exact":   # erf GELU (GPT-NeoX/Pythia)
+                up = jax.nn.gelu(up, approximate=False)
+            else:
+                up = jax.nn.gelu(up)             # tanh approx (GPT-2 family)
         down = up @ lp["w_down"]
         if c.use_bias:
             down = down + lp["b_down"]
@@ -271,7 +316,7 @@ class Transformer:
         """
         c = self.config
         x = self._embed(params, tokens, positions)  # [b, s, d]
-        angles = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta) \
+        angles = rope_frequencies(c.rotary_dim, c.max_seq_len, c.rope_theta) \
             if c.position == "rope" else None
 
         aux_total = jnp.zeros((), jnp.float32)
@@ -394,6 +439,9 @@ class Transformer:
             s = tokens.shape[-1]
             pos_emb = params["pos_embed"][:s] if positions is None else params["pos_embed"][positions]
             x = x + pos_emb.astype(compute_dtype)
+        if c.embed_norm:
+            x = layer_norm(x, params["embed_norm_w"], params["embed_norm_b"],
+                           c.norm_eps)
         return x
 
     def _head(self, params, x):
@@ -402,6 +450,8 @@ class Transformer:
         x = self._norm(x, params["final_norm_w"], params.get("final_norm_b"))
         w_out = params["tok_embed"].T if c.tie_embeddings else params["lm_head"]
         logits = (x @ w_out.astype(x.dtype)).astype(jnp.float32)
+        if "lm_head_b" in params:  # GPT-J carries an LM-head bias
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
         if c.logits_softcap > 0:
             logits = jnp.tanh(logits / c.logits_softcap) * c.logits_softcap
         return logits
@@ -441,7 +491,7 @@ class Transformer:
         else:
             xs = jax.vmap(lambda t: self._embed(params, t))(mb["inputs"])
         # xs: [M, b/M, s, d]
-        angles = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta) \
+        angles = rope_frequencies(c.rotary_dim, c.max_seq_len, c.rope_theta) \
             if c.position == "rope" else jnp.zeros((1, 1), jnp.float32)
         stage_params = stack_stage_params(params["layers"], self._pipe_size)
 
@@ -532,6 +582,11 @@ class Transformer:
             specs["final_norm_b"] = P(None)
         if c.position == "learned":
             specs["pos_embed"] = P(None, None)
+        if c.embed_norm:
+            specs["embed_norm_w"] = P(None)
+            specs["embed_norm_b"] = P(None)
         if not c.tie_embeddings:
             specs["lm_head"] = P(None, "model")
+            if isinstance(params, dict) and "lm_head_b" in params:
+                specs["lm_head_b"] = P("model")  # GPT-J ingests carry one
         return specs

@@ -33,6 +33,7 @@ def _repeat_kv(k, n_rep: int):
 
 def dot_product_attention(q, k, v, *, causal: bool = True,
                           mask: Optional[jnp.ndarray] = None,
+                          bias: Optional[jnp.ndarray] = None,
                           scale: Optional[float] = None,
                           logits_dtype=jnp.float32):
     """Reference attention. q: [b, sq, hq, d]; k/v: [b, skv, hkv, d].
@@ -40,6 +41,8 @@ def dot_product_attention(q, k, v, *, causal: bool = True,
     Softmax in fp32 (the reference kernels do the same via float accumulators
     in attn_softmax_v2). Causal masking uses absolute positions aligned to
     the *end* of the KV sequence so decode (sq=1, skv=cache_len) works.
+    ``bias``: optional additive logit bias broadcastable to [b, h, sq, skv]
+    (ALiBi).
     """
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
@@ -48,6 +51,8 @@ def dot_product_attention(q, k, v, *, causal: bool = True,
     v = _repeat_kv(v, hq // hkv)
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(logits_dtype) * scale
+    if bias is not None:
+        logits = logits + bias.astype(logits_dtype)
     if causal:
         q_pos = jnp.arange(sq)[:, None] + (skv - sq)
         k_pos = jnp.arange(skv)[None, :]

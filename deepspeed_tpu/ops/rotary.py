@@ -18,17 +18,55 @@ def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0):
     return jnp.outer(t, inv_freq)  # [max_len, head_dim//2]
 
 
-def apply_rotary(x, angles, positions=None):
-    """Apply RoPE. x: [..., seq, n_heads, head_dim]; angles: [max_len, hd/2];
-    positions: optional [..., seq] int32 (for KV-cache decode offsets)."""
+def apply_rotary(x, angles, positions=None, rotary_dim=None,
+                 interleaved=False):
+    """Apply RoPE. x: [..., seq, n_heads, head_dim]; angles:
+    [max_len, rotary_dim/2]; positions: optional [..., seq] int32 (for
+    KV-cache decode offsets).
+
+    ``rotary_dim`` < head_dim rotates only the leading dims (GPT-NeoX
+    ``rotary_pct``); ``interleaved`` uses the GPT-J pairing — (x[2i],
+    x[2i+1]) rotate together — instead of the Llama/NeoX half-split."""
+    if rotary_dim is not None and rotary_dim < x.shape[-1]:
+        xr, xp = x[..., :rotary_dim], x[..., rotary_dim:]
+        xr = apply_rotary(xr, angles, positions, interleaved=interleaved)
+        return jnp.concatenate([xr, xp], axis=-1)
     if positions is None:
         seq = x.shape[-3]
-        ang = angles[:seq]  # [seq, hd/2]
+        ang = angles[:seq]  # [seq, rd/2]
         ang = ang[(None,) * (x.ndim - 3) + (slice(None), None, slice(None))]
     else:
-        ang = angles[positions]  # [..., seq, hd/2]
+        ang = angles[positions]  # [..., seq, rd/2]
         ang = ang[..., None, :]  # broadcast over heads
     sin, cos = jnp.sin(ang), jnp.cos(ang)
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    xf = x.astype(jnp.float32)
+    if interleaved:
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        r1, r2 = x1 * cos - x2 * sin, x1 * sin + x2 * cos
+        out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    else:
+        x1, x2 = jnp.split(xf, 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                              axis=-1)
     return out.astype(x.dtype)
+
+
+def alibi_slopes(n_heads: int) -> jnp.ndarray:
+    """ALiBi per-head slopes (Press et al. 2022; Bloom's position scheme —
+    reference module_inject/containers/bloom.py consumes torch's
+    build_alibi_tensor). Standard geometric construction incl. the
+    non-power-of-two fixup."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        slopes = pow2_slopes(n_heads)
+    else:
+        base = 2 ** math.floor(math.log2(n_heads))
+        slopes = pow2_slopes(base)
+        extra = pow2_slopes(2 * base)[0::2][: n_heads - base]
+        slopes += extra
+    return jnp.asarray(slopes, jnp.float32)

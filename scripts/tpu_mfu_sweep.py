@@ -26,22 +26,30 @@ CONFIGS = [
 
 def main():
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = os.path.join(here, "MFU_SWEEP_r03.json")
     results = []
     for cfg in CONFIGS:
         env = dict(os.environ, **cfg)
-        proc = subprocess.run([sys.executable, os.path.join(here, "bench.py")],
-                              env=env, capture_output=True, text=True,
-                              timeout=2400, cwd=here)
-        line = None
-        for ln in (proc.stdout or "").splitlines():
-            if ln.strip().startswith("{") and '"metric"' in ln:
-                line = json.loads(ln)
-        results.append({"config": cfg, "result": line,
-                        "rc": proc.returncode})
-        print(json.dumps(results[-1]), flush=True)
-    out = os.path.join(here, "MFU_SWEEP_r03.json")
-    with open(out, "w") as f:
-        json.dump(results, f, indent=2)
+        entry = {"config": cfg, "result": None, "rc": None}
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "bench.py")],
+                env=env, capture_output=True, text=True,
+                timeout=2400, cwd=here)
+            entry["rc"] = proc.returncode
+            for ln in (proc.stdout or "").splitlines():
+                ln = ln.strip()
+                if ln.startswith("{") and '"metric"' in ln:
+                    try:
+                        entry["result"] = json.loads(ln)
+                    except json.JSONDecodeError:
+                        pass
+        except subprocess.TimeoutExpired:
+            entry["rc"] = "timeout"
+        results.append(entry)
+        print(json.dumps(entry), flush=True)
+        with open(out, "w") as f:   # incremental: a late failure keeps
+            json.dump(results, f, indent=2)  # earlier configs' numbers
     best = max((r for r in results if r["result"]),
                key=lambda r: r["result"]["extra"]["mfu"], default=None)
     if best:

@@ -67,3 +67,39 @@ def test_elastic_scale_down_resume(tmp_path, monkeypatch):
     l = float(e4.train_batch(shard_batch(_batch(batch4, 9), e4.topo))["loss"])
     assert np.isfinite(l)
     assert l < losses[0], f"resumed training regressed: {l} vs {losses}"
+
+
+def test_elastic_agent_restarts_until_success(tmp_path):
+    """DSElasticAgent parity: worker crashes twice, then succeeds after
+    restarts; DST_ELASTIC_RESTART tells the trainee which attempt it is."""
+    import sys
+
+    from deepspeed_tpu.launcher.agent import ElasticAgent
+
+    marker = tmp_path / "attempts"
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        f"p = {str(marker)!r}\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        "assert os.environ['DST_ELASTIC_RESTART'] == str(n), 'attempt env wrong'\n"
+        "sys.exit(0 if n >= 2 else 1)\n")
+    agent = ElasticAgent([sys.executable, str(script)], max_restarts=3,
+                         backoff_s=0.0)
+    report = agent.run()
+    assert report.succeeded and report.restarts == 2
+    assert report.history == [1, 1, 0]
+
+
+def test_elastic_agent_gives_up(tmp_path):
+    import sys
+
+    from deepspeed_tpu.launcher.agent import ElasticAgent
+
+    script = tmp_path / "always_fail.py"
+    script.write_text("import sys; sys.exit(7)\n")
+    report = ElasticAgent([sys.executable, str(script)], max_restarts=2,
+                          backoff_s=0.0).run()
+    assert not report.succeeded
+    assert report.returncode == 7 and len(report.history) == 3

@@ -32,6 +32,23 @@ def _save_tiny(tmp_path, family: str, safe: bool):
         hf_cfg = transformers.GPT2Config(
             vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_positions=128)
         m = transformers.GPT2LMHeadModel(hf_cfg)
+    elif family == "bloom":
+        hf_cfg = transformers.BloomConfig(
+            vocab_size=256, hidden_size=64, n_layer=2, n_head=4,
+            layer_norm_epsilon=1e-5)
+        m = transformers.BloomForCausalLM(hf_cfg)
+    elif family == "gptj":
+        hf_cfg = transformers.GPTJConfig(
+            vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_positions=128,
+            rotary_dim=8, n_inner=256)
+        m = transformers.GPTJForCausalLM(hf_cfg)
+    elif family == "gpt_neox":
+        hf_cfg = transformers.GPTNeoXConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=256,
+            max_position_embeddings=128, rotary_pct=0.5,
+            use_parallel_residual=True)
+        m = transformers.GPTNeoXForCausalLM(hf_cfg)
     elif family == "opt":
         hf_cfg = transformers.OPTConfig(
             vocab_size=256, hidden_size=64, ffn_dim=256, num_hidden_layers=2,
@@ -48,7 +65,9 @@ def _save_tiny(tmp_path, family: str, safe: bool):
 
 
 @pytest.mark.parametrize("family,safe", [("llama", True), ("gpt2", True),
-                                         ("opt", True), ("llama", False)])
+                                         ("opt", True), ("llama", False),
+                                         ("bloom", True), ("gptj", True),
+                                         ("gpt_neox", True)])
 def test_hf_logits_parity(tmp_path, family, safe):
     """Native forward on ingested weights == torch forward (fp32)."""
     hf_model, d = _save_tiny(tmp_path, family, safe)
