@@ -197,10 +197,31 @@ def hf_config(model_dir: str):
         if not cfg.parallel_residual:
             raise NotImplementedError(
                 "gpt_neox with use_parallel_residual=false not supported")
+    elif family == "falcon":
+        if hc.get("new_decoder_architecture", False):
+            raise NotImplementedError(
+                "falcon new_decoder_architecture (40B+) not supported yet")
+        if hc.get("alibi", False):
+            raise NotImplementedError("falcon alibi variant not supported")
+        if not hc.get("parallel_attn", True):
+            raise NotImplementedError("falcon parallel_attn=false not supported")
+        nh = hc["num_attention_heads"]
+        cfg = TransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
+            n_layers=hc["num_hidden_layers"], n_heads=nh,
+            n_kv_heads=1 if hc.get("multi_query", True) else nh,
+            d_ff=4 * hc["hidden_size"],
+            max_seq_len=hc.get("max_position_embeddings", 2048),
+            norm="layer", activation="gelu", position="rope",
+            rope_theta=hc.get("rope_theta", 10000.0),
+            parallel_residual=True,
+            tie_embeddings=hc.get("tie_word_embeddings", True),
+            use_bias=bool(hc.get("bias", False)),
+            norm_eps=hc.get("layer_norm_epsilon", 1e-5))
     else:
         raise ValueError(f"unsupported HF model_type '{family}' "
                          f"(supported: llama, mistral, gpt2, opt, bloom, "
-                         f"gptj, gpt_neox)")
+                         f"gptj, gpt_neox, falcon)")
     return family, cfg
 
 
@@ -438,10 +459,49 @@ def _map_gpt_neox(state, c) -> Dict[str, Any]:
     return params
 
 
+def _map_falcon(state, c) -> Dict[str, Any]:
+    """Falcon-7B-style (old decoder architecture, multi-query, parallel
+    attention): fused qkv rows are [n_heads*hd | hd (k) | hd (v)]."""
+    n, nh, hd = c.n_layers, c.n_heads, c.d_model // c.n_heads
+    nkv = c.n_kv_heads
+    pre = "transformer." if "transformer.word_embeddings.weight" in state else ""
+    L = pre + "h.{}."
+    qs, ks, vs = [], [], []
+    for i in range(n):
+        w = state.pop((L + "self_attention.query_key_value.weight").format(i))
+        q_rows = nh * hd
+        qs.append(np.ascontiguousarray(w[:q_rows].T))
+        ks.append(np.ascontiguousarray(w[q_rows:q_rows + nkv * hd].T))
+        vs.append(np.ascontiguousarray(w[q_rows + nkv * hd:].T))
+    ln_w = _stack(state, L + "input_layernorm.weight", n)
+    ln_b = _stack(state, L + "input_layernorm.bias", n)
+    layers = {
+        # single shared LN feeds both parallel branches (like GPT-J)
+        "attn_norm_w": ln_w, "attn_norm_b": ln_b,
+        "mlp_norm_w": ln_w.copy(), "mlp_norm_b": ln_b.copy(),
+        "wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
+        "wo": _stack(state, L + "self_attention.dense.weight", n, transpose=True),
+        "w_up": _stack(state, L + "mlp.dense_h_to_4h.weight", n, transpose=True),
+        "w_down": _stack(state, L + "mlp.dense_4h_to_h.weight", n, transpose=True),
+    }
+    params = {
+        "tok_embed": state[pre + "word_embeddings.weight"],
+        "layers": layers,
+        "final_norm_w": state[pre + "ln_f.weight"],
+        "final_norm_b": state[pre + "ln_f.bias"],
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = (state["lm_head.weight"]
+                             if "lm_head.weight" in state
+                             else state[pre + "word_embeddings.weight"]).T
+    return params
+
+
 _MAPPERS: Dict[str, Callable] = {
     "llama": _map_llama, "mistral": _map_llama,
     "gpt2": _map_gpt2, "opt": _map_opt,
     "bloom": _map_bloom, "gptj": _map_gptj, "gpt_neox": _map_gpt_neox,
+    "falcon": _map_falcon,
 }
 
 

@@ -49,6 +49,13 @@ def _save_tiny(tmp_path, family: str, safe: bool):
             max_position_embeddings=128, rotary_pct=0.5,
             use_parallel_residual=True)
         m = transformers.GPTNeoXForCausalLM(hf_cfg)
+    elif family == "falcon":
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, multi_query=True, parallel_attn=True,
+            new_decoder_architecture=False, alibi=False, bias=False,
+            max_position_embeddings=128)
+        m = transformers.FalconForCausalLM(hf_cfg)
     elif family == "opt":
         hf_cfg = transformers.OPTConfig(
             vocab_size=256, hidden_size=64, ffn_dim=256, num_hidden_layers=2,
@@ -67,7 +74,8 @@ def _save_tiny(tmp_path, family: str, safe: bool):
 @pytest.mark.parametrize("family,safe", [("llama", True), ("gpt2", True),
                                          ("opt", True), ("llama", False),
                                          ("bloom", True), ("gptj", True),
-                                         ("gpt_neox", True)])
+                                         ("gpt_neox", True),
+                                         ("falcon", True)])
 def test_hf_logits_parity(tmp_path, family, safe):
     """Native forward on ingested weights == torch forward (fp32)."""
     hf_model, d = _save_tiny(tmp_path, family, safe)
@@ -166,6 +174,6 @@ def test_hf_train_finetune_step(tmp_path):
 
 
 def test_hf_config_errors(tmp_path):
-    (tmp_path / "config.json").write_text('{"model_type": "falcon"}')
+    (tmp_path / "config.json").write_text('{"model_type": "mamba"}')
     with pytest.raises(ValueError, match="unsupported HF model_type"):
         hf_config(str(tmp_path))
