@@ -113,6 +113,22 @@ class Compressor:
         self.masks: Dict[str, np.ndarray] = {}        # path -> mask
         self._mask_done: set = set()                  # activated groups
         self._active_quant: bool = False
+        self._active_groups: set = set()              # quant groups in window
+        self._quant_bits: Dict[str, int] = {}         # group name -> bits
+
+    @staticmethod
+    def _bits_at(g: Dict[str, Any], step: int) -> int:
+        """Progressive bit reduction (reference runtime/quantize.py +
+        compression start_bits/target_bits/quantization_period): bits halve
+        from start toward target every quantization_period steps past the
+        schedule offset."""
+        target = int(g.get("target_bits", g.get("start_bits", 8)))
+        start = int(g.get("start_bits", target))
+        period = int(g.get("quantization_period", 1))
+        if start <= target or period <= 0:
+            return target
+        halvings = max(0, (step - int(g["schedule_offset"])) // period)
+        return max(target, start >> min(halvings, start.bit_length()))
 
     # -- mask construction (reference helper.py sparse/row/head mask math)
     def _compute_masks(self, params: Any, kind: str,
@@ -199,14 +215,29 @@ class Compressor:
         if want_quant != self._active_quant:
             self._active_quant = want_quant
             changed = True
+        # per-group gating: a group quantizes only inside ITS window
+        active_names = {g["name"] for g in self.config.weight_quantization
+                        if self._in_window(g, global_step)}
+        if active_names != self._active_groups:
+            self._active_groups = active_names
+            changed = True
+        for g in self.config.weight_quantization:
+            if g["name"] not in active_names:
+                continue
+            bits = self._bits_at(g, global_step)
+            if self._quant_bits.get(g["name"]) != bits:
+                self._quant_bits[g["name"]] = bits
+                changed = True
         if changed and hasattr(engine, "register_param_transform"):
             engine.register_param_transform(self.transform)
 
     # -- the traced transform ------------------------------------------
     def transform(self, params: Any) -> Any:
         masks = dict(self.masks)
-        quant_groups = self.config.weight_quantization if self._active_quant \
-            else []
+        active = self._active_groups
+        quant_groups = ([g for g in self.config.weight_quantization
+                         if g["name"] in active]
+                        if self._active_quant else [])
 
         def leaf_fn(path, leaf):
             p = jax.tree_util.keystr(path)
@@ -215,7 +246,9 @@ class Compressor:
                 leaf = leaf * jnp.asarray(m, leaf.dtype)
             for g in quant_groups:
                 if _prunable(leaf) and _matches(p, g["modules"]):
-                    bits = int(g.get("target_bits", g.get("start_bits", 8)))
+                    bits = self._quant_bits.get(
+                        g["name"], int(g.get("target_bits",
+                                             g.get("start_bits", 8))))
                     block = next((b for b in (256, 128, 64, 32, 16)
                                   if leaf.size % b == 0), None)
                     if bits < 16 and block is not None:

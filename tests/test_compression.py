@@ -166,3 +166,19 @@ def test_redundancy_clean_bakes_masks():
             assert zeros > 0.4, (p, zeros)
             hit += 1
     assert hit >= 2
+
+
+def test_progressive_quantization_bit_schedule():
+    """start_bits -> target_bits halving every quantization_period steps
+    (reference runtime/quantize.py progressive QAT)."""
+    from deepspeed_tpu.compression.compress import Compressor
+
+    g = {"name": "g", "schedule_offset": 10, "start_bits": 16,
+         "target_bits": 4, "quantization_period": 5}
+    assert Compressor._bits_at(g, 10) == 16
+    assert Compressor._bits_at(g, 15) == 8
+    assert Compressor._bits_at(g, 20) == 4
+    assert Compressor._bits_at(g, 100) == 4
+    # no schedule: straight to target
+    assert Compressor._bits_at({"name": "x", "schedule_offset": 0,
+                                "target_bits": 8}, 0) == 8

@@ -1,0 +1,64 @@
+"""Unit tests for the positional-encoding primitives added for the
+Bloom/GPT-J/GPT-NeoX families (reference csrc rotary kernels +
+module_inject alibi consumption)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.rotary import (alibi_slopes, apply_rotary,
+                                      rope_frequencies)
+
+
+def test_alibi_slopes_power_of_two():
+    s = np.asarray(alibi_slopes(8))
+    # geometric sequence starting at 2^(-8/8)... standard: ratio constant
+    ratios = s[1:] / s[:-1]
+    np.testing.assert_allclose(ratios, ratios[0], rtol=1e-6)
+    assert s[0] < 1.0 and np.all(s > 0) and np.all(np.diff(s) < 0)
+
+
+def test_alibi_slopes_non_power_of_two():
+    s = np.asarray(alibi_slopes(6))
+    assert s.shape == (6,)
+    assert np.all(s > 0)
+    # first 4 match the power-of-two construction for 4 heads
+    np.testing.assert_allclose(s[:4], np.asarray(alibi_slopes(4)), rtol=1e-6)
+
+
+def test_partial_rotary_leaves_tail_untouched():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 8, 4, 16)),
+                    jnp.float32)
+    angles = rope_frequencies(8, 32)
+    out = apply_rotary(x, angles, rotary_dim=8)
+    # rotated head: differs; pass-through tail: bit-identical
+    assert not np.allclose(np.asarray(out[..., :8]), np.asarray(x[..., :8]))
+    np.testing.assert_array_equal(np.asarray(out[..., 8:]),
+                                  np.asarray(x[..., 8:]))
+
+
+def test_interleaved_equals_halfsplit_after_permutation():
+    """GPT-J pairing is the half-split rotation conjugated by the
+    even/odd-interleave permutation of the head dim."""
+    rng = np.random.default_rng(1)
+    hd = 16
+    x = jnp.asarray(rng.normal(size=(1, 4, 2, hd)), jnp.float32)
+    angles = rope_frequencies(hd, 16)
+    inter = np.asarray(apply_rotary(x, angles, interleaved=True))
+    # permute [0,2,4,...,1,3,5,...] -> half-split domain
+    perm = np.concatenate([np.arange(0, hd, 2), np.arange(1, hd, 2)])
+    half = np.asarray(apply_rotary(x[..., perm], angles))
+    inv = np.argsort(perm)
+    np.testing.assert_allclose(inter, half[..., inv], rtol=1e-6, atol=1e-6)
+
+
+def test_rotary_preserves_norm():
+    """Rotations are norm-preserving per pair — both conventions."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(1, 8, 2, 12)), jnp.float32)
+    angles = rope_frequencies(12, 8)
+    for inter in (False, True):
+        out = np.asarray(apply_rotary(x, angles, interleaved=inter))
+        np.testing.assert_allclose(np.linalg.norm(out, axis=-1),
+                                   np.linalg.norm(np.asarray(x), axis=-1),
+                                   rtol=1e-5)
