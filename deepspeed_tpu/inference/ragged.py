@@ -118,12 +118,6 @@ class RaggedInferenceEngine:
         self.config = config or RaggedConfig()
         self.model = model
         c = model.config
-        # the ragged step inlines the dense block math; models overriding
-        # _mlp (MoE) need the expert-aware path which is not wired here yet
-        if hasattr(model, "moe"):
-            raise NotImplementedError(
-                "RaggedInferenceEngine does not support MoE models yet; "
-                "use InferenceEngine (dense KV cache) for MoE")
         if self.config.max_context > c.max_seq_len:
             raise ValueError(
                 f"max_context {self.config.max_context} exceeds model "
@@ -391,17 +385,10 @@ class RaggedInferenceEngine:
                     attn = attn + lp["bo"]
                 x = x + attn
                 h = norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
-                if c.activation == "silu_glu":
-                    up = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
-                else:
-                    up = h @ lp["w_up"]
-                    if c.use_bias:
-                        up = up + lp["b_up"]
-                    up = jax.nn.gelu(up)
-                down = up @ lp["w_down"]
-                if c.use_bias:
-                    down = down + lp["b_down"]
-                return (x + down, kp, vp), None
+                # the model's own MLP: honors relu/gelu/gelu_exact/silu_glu
+                # and the MoE override (top-k routed experts) uniformly
+                down, _ = model._mlp(h[None], lp, None, False)
+                return (x + down[0], kp, vp), None
 
             n_layers = c.n_layers
             (x, k_pool, v_pool), _ = jax.lax.scan(

@@ -112,12 +112,49 @@ def top_k_gating(logits: jnp.ndarray, cfg: GateConfig, cap: int,
     return combine.astype(jnp.float32), dispatch, aux
 
 
+def no_drop_moe(x_flat: jnp.ndarray, probs: jnp.ndarray, idx: jnp.ndarray,
+                params: Dict[str, Any], activation: str) -> jnp.ndarray:
+    """Sort-based NO-DROP expert dispatch on grouped GEMMs.
+
+    The TPU analog of FastGen's ``moe_gather``/``moe_scatter`` +
+    CUTLASS grouped GEMM (reference
+    ``inference/v2/kernels/ragged_ops/{moe_gather,moe_scatter}`` and
+    ``kernels/cutlass_ops/moe_gemm``): (token, k) pairs are sorted by
+    expert id, each expert's contiguous segment runs through
+    ``jax.lax.ragged_dot`` (the MXU grouped GEMM), and outputs
+    scatter-add back weighted by the gate. No capacity buffers — no token
+    is ever dropped and no [S, E, C] combine tensor exists, so serving
+    output is independent of co-scheduled traffic.
+
+    x_flat: [S, d]; probs/idx: [S, k] top-k gate weights / expert ids.
+    """
+    S, k = idx.shape
+    E = params["w_up"].shape[0]
+    flat_e = idx.reshape(-1)                          # [S*k]
+    order = jnp.argsort(flat_e)                       # stable: tokens in order
+    tok = jnp.repeat(jnp.arange(S), k)[order]         # source token per pair
+    xs = x_flat[tok]                                  # moe_gather
+    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+
+    if activation == "silu_glu":
+        h = jax.nn.silu(jax.lax.ragged_dot(xs, params["w_gate"], group_sizes)) \
+            * jax.lax.ragged_dot(xs, params["w_up"], group_sizes)
+    else:
+        h = jax.nn.gelu(jax.lax.ragged_dot(xs, params["w_up"], group_sizes))
+    ys = jax.lax.ragged_dot(h, params["w_down"], group_sizes)  # [S*k, d]
+    w = probs.reshape(-1)[order][:, None].astype(ys.dtype)
+    return jnp.zeros_like(x_flat).at[tok].add((ys * w).astype(x_flat.dtype))
+
+
 class MoELayer:
     """Expert-parallel gated FFN bank.
 
     Params: {"wg": [d, E], "w_up": [E, d, f], "w_gate": [E, d, f] (glu),
     "w_down": [E, f, d]}. Expert weights shard over ('expert', 'model')
-    axes; dispatch einsums produce the all-to-alls under GSPMD.
+    axes; dispatch einsums produce the all-to-alls under GSPMD. Eval /
+    serving routes through :func:`no_drop_moe` — capacity-dropping is a
+    training-throughput tradeoff and has no place in inference, where it
+    would make a sequence's logits depend on co-scheduled traffic.
     """
 
     def __init__(self, d_model: int, d_ff: int, gate: GateConfig,
@@ -145,9 +182,22 @@ class MoELayer:
               rng: Optional[jax.Array] = None, training: bool = True
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """x: [b, s, d] -> (out [b, s, d], aux_loss). Token groups = batch
-        rows (group-limited routing like the reference's per-group capacity)."""
+        rows (group-limited routing like the reference's per-group capacity).
+        Eval / no-drop uses the sort-based grouped-GEMM path."""
         b, s, d = x.shape
         cfg = self.gate
+        if not training or not cfg.drop_tokens:
+            logits = x.astype(jnp.float32) @ params["wg"].astype(jnp.float32)
+            probs = jax.nn.softmax(logits.reshape(b * s, -1), axis=-1)
+            topw, topi = jax.lax.top_k(probs, cfg.top_k)
+            topw = topw / jnp.maximum(jnp.sum(topw, axis=-1, keepdims=True),
+                                      1e-9)
+            out = no_drop_moe(x.reshape(b * s, d), topw, topi, params,
+                              self.activation)
+            # same load-balance diagnostic as the drop path
+            assign = jnp.mean(jax.nn.one_hot(topi[:, 0], cfg.n_experts), axis=0)
+            aux = cfg.n_experts * jnp.sum(jnp.mean(probs, axis=0) * assign)
+            return out.reshape(b, s, d), aux
         cap = capacity(s, cfg, training)
         if cfg.noisy_gate_policy == "Jitter" and training and rng is not None:
             # multiplicative input jitter (reference multiplicative_jitter,
