@@ -160,3 +160,25 @@ def test_zeropp_full_stack_trains():
 def test_zero_inner_must_divide_dp():
     with pytest.raises(Exception):
         Topology.build_virtual({"data": 8, "zshard": 3})
+
+
+def test_zeropp_with_gradient_accumulation():
+    """qgZ shard_map + hpZ secondary copy inside the GAS scan."""
+    cfg = {
+        "train_batch_size": 32,
+        "gradient_accumulation_steps": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3,
+                              "stage3_param_persistence_threshold": 0,
+                              "zero_hpz_partition_size": 2,
+                              "zero_quantized_weights": True,
+                              "zero_quantized_gradients": True},
+        "steps_per_print": 1000,
+    }
+    params = big_mlp_params(jax.random.PRNGKey(0))
+    engine, _, _, _ = dst.initialize(loss_fn=mlp_loss, params=params,
+                                     config=cfg)
+    assert engine.gradient_accumulation_steps == 4
+    losses = _losses(engine, steps=4)
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
