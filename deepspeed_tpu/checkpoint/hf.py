@@ -12,7 +12,11 @@ with ``jax.device_put`` under the model's PartitionSpecs — GSPMD handles
 TP/ZeRO sharding from there; no injection machinery.
 
 Supported families: Llama/Mistral (RMSNorm+RoPE+SwiGLU+GQA), GPT-2
-(Conv1D fused qkv), OPT (learned positions with the +2 offset, ReLU).
+(Conv1D fused qkv), OPT (learned positions with the +2 offset, ReLU),
+Bloom (ALiBi + embed-norm), GPT-J (interleaved partial rotary, parallel
+residual), GPT-NeoX/Pythia (rotary_pct, dual-norm parallel residual),
+Falcon-7B-style (multi-query, parallel attention), and Mixtral (routed
+experts over the MoE transformer).
 
 Formats: ``*.safetensors`` (single or index-sharded) and
 ``pytorch_model.bin`` (torch pickle, single or index-sharded).
@@ -151,6 +155,26 @@ def hf_config(model_dir: str):
             use_bias=hc.get("enable_bias", True), norm_eps=1e-5)
         if hc["hidden_size"] != hc.get("word_embed_proj_dim", hc["hidden_size"]):
             raise NotImplementedError("OPT word_embed_proj_dim != hidden_size")
+    elif family == "mixtral":
+        from ..models.moe import MoETransformerConfig
+
+        if hc.get("rope_scaling"):
+            raise NotImplementedError("mixtral rope_scaling not supported")
+        max_seq = hc.get("max_position_embeddings", 4096)
+        window = hc.get("sliding_window")
+        if window is not None and window < max_seq:
+            max_seq = window
+        cfg = MoETransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
+            n_layers=hc["num_hidden_layers"], n_heads=hc["num_attention_heads"],
+            n_kv_heads=hc.get("num_key_value_heads", hc["num_attention_heads"]),
+            d_ff=hc["intermediate_size"], max_seq_len=max_seq,
+            norm="rms", activation="silu_glu", position="rope",
+            rope_theta=hc.get("rope_theta", 1e6),
+            tie_embeddings=hc.get("tie_word_embeddings", False),
+            use_bias=False, norm_eps=hc.get("rms_norm_eps", 1e-5),
+            n_experts=hc["num_local_experts"],
+            top_k=hc["num_experts_per_tok"])
     elif family == "bloom":
         nh = hc["n_head"]
         cfg = TransformerConfig(
@@ -221,7 +245,7 @@ def hf_config(model_dir: str):
     else:
         raise ValueError(f"unsupported HF model_type '{family}' "
                          f"(supported: llama, mistral, gpt2, opt, bloom, "
-                         f"gptj, gpt_neox, falcon)")
+                         f"gptj, gpt_neox, falcon, mixtral)")
     return family, cfg
 
 
@@ -366,6 +390,46 @@ def _defused_qkv_stacks(state, fmt: str, n: int, nh: int, hd: int):
             "bq": np.stack(bqs), "bk": np.stack(bks), "bv": np.stack(bvs)}
 
 
+def _map_mixtral(state, c) -> Dict[str, Any]:
+    """Mixtral: Llama-style attention + routed expert FFNs
+    (block_sparse_moe: gate + experts.{e}.w1/w3 up-projections, w2 down)."""
+    n, E = c.n_layers, c.n_experts
+    pre = "model." if "model.embed_tokens.weight" in state else ""
+    L = pre + "layers.{}."
+    layers = {
+        "attn_norm_w": _stack(state, L + "input_layernorm.weight", n),
+        "wq": _stack(state, L + "self_attn.q_proj.weight", n, transpose=True),
+        "wk": _stack(state, L + "self_attn.k_proj.weight", n, transpose=True),
+        "wv": _stack(state, L + "self_attn.v_proj.weight", n, transpose=True),
+        "wo": _stack(state, L + "self_attn.o_proj.weight", n, transpose=True),
+        "mlp_norm_w": _stack(state, L + "post_attention_layernorm.weight", n),
+        # router: HF [E, d] -> native wg [d, E]
+        "wg": _stack(state, L + "block_sparse_moe.gate.weight", n,
+                     transpose=True),
+        # experts: HF w1 (gate) / w3 (up) [f, d], w2 (down) [d, f] ->
+        # native [n, E, d, f] / [n, E, f, d]
+        "w_gate": np.stack([np.stack(
+            [state.pop((L + "block_sparse_moe.experts.{}.w1.weight")
+                       .format(i, e)).T for e in range(E)]) for i in range(n)]),
+        "w_up": np.stack([np.stack(
+            [state.pop((L + "block_sparse_moe.experts.{}.w3.weight")
+                       .format(i, e)).T for e in range(E)]) for i in range(n)]),
+        "w_down": np.stack([np.stack(
+            [state.pop((L + "block_sparse_moe.experts.{}.w2.weight")
+                       .format(i, e)).T for e in range(E)]) for i in range(n)]),
+    }
+    params = {
+        "tok_embed": state[pre + "embed_tokens.weight"],
+        "layers": layers,
+        "final_norm_w": state[pre + "norm.weight"],
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = (state["lm_head.weight"]
+                             if "lm_head.weight" in state
+                             else state[pre + "embed_tokens.weight"]).T
+    return params
+
+
 def _map_bloom(state, c) -> Dict[str, Any]:
     n, nh, hd = c.n_layers, c.n_heads, c.d_model // c.n_heads
     pre = "transformer." if "transformer.word_embeddings.weight" in state else ""
@@ -501,7 +565,7 @@ _MAPPERS: Dict[str, Callable] = {
     "llama": _map_llama, "mistral": _map_llama,
     "gpt2": _map_gpt2, "opt": _map_opt,
     "bloom": _map_bloom, "gptj": _map_gptj, "gpt_neox": _map_gpt_neox,
-    "falcon": _map_falcon,
+    "falcon": _map_falcon, "mixtral": _map_mixtral,
 }
 
 
@@ -539,7 +603,12 @@ def from_pretrained(model_dir: str, dtype=None, topology=None,
     state = read_hf_state(model_dir)
     host_params = map_hf_params(state, family, cfg)
     del state  # mappers pop what they stack; drop the embeds' extra refs too
-    model = Transformer(cfg)
+    if family == "mixtral":
+        from ..models.moe import MoETransformer
+
+        model = MoETransformer(cfg)
+    else:
+        model = Transformer(cfg)
     # cast on host (ml_dtypes covers bf16 numpy) so each leaf ships to the
     # devices already-sharded — never materializing a full unsharded param
     # in one chip's HBM; copy=False keeps bf16 checkpoints zero-copy here

@@ -56,6 +56,14 @@ def _save_tiny(tmp_path, family: str, safe: bool):
             new_decoder_architecture=False, alibi=False, bias=False,
             max_position_embeddings=128)
         m = transformers.FalconForCausalLM(hf_cfg)
+    elif family == "mixtral":
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_local_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=128, rms_norm_eps=1e-6,
+            tie_word_embeddings=False)
+        m = transformers.MixtralForCausalLM(hf_cfg)
     elif family == "opt":
         hf_cfg = transformers.OPTConfig(
             vocab_size=256, hidden_size=64, ffn_dim=256, num_hidden_layers=2,
@@ -75,7 +83,8 @@ def _save_tiny(tmp_path, family: str, safe: bool):
                                          ("opt", True), ("llama", False),
                                          ("bloom", True), ("gptj", True),
                                          ("gpt_neox", True),
-                                         ("falcon", True)])
+                                         ("falcon", True),
+                                         ("mixtral", True)])
 def test_hf_logits_parity(tmp_path, family, safe):
     """Native forward on ingested weights == torch forward (fp32)."""
     hf_model, d = _save_tiny(tmp_path, family, safe)
