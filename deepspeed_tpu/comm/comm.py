@@ -59,9 +59,10 @@ def _get_bw(comm_op: str, size_bytes: int, duration_s: float, n: int) -> tuple:
         return 0.0, 0.0
     size_gb = size_bytes / 1e9
     algbw = size_gb / duration_s
-    if comm_op in ("all_reduce",):
+    if comm_op in ("all_reduce", "reduce"):
         busbw = algbw * (2 * (n - 1) / n) if n > 0 else algbw
-    elif comm_op in ("all_gather", "reduce_scatter", "all_to_all"):
+    elif comm_op in ("all_gather", "reduce_scatter", "all_to_all", "gather",
+                     "sparse_allreduce"):
         busbw = algbw * ((n - 1) / n) if n > 0 else algbw
     else:
         busbw = algbw
@@ -176,9 +177,9 @@ def measure_comm_latencies(mesh=None, iters: int = 10) -> str:
     log = _COMMS_LOGGER
 
     def collective(op, axis):
-        if op == "all_reduce":
+        if op in ("all_reduce", "reduce"):
             return lambda x: jax.lax.psum(x, axis)
-        if op in ("all_gather", "sparse_allreduce"):
+        if op in ("all_gather", "gather", "sparse_allreduce"):
             # sparse_allreduce's wire cost IS its all_gathers (rows+indices,
             # recorded as one combined payload); the scatter-add is local
             return lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=True)
@@ -186,7 +187,8 @@ def measure_comm_latencies(mesh=None, iters: int = 10) -> str:
             return lambda x: jax.lax.psum_scatter(x, axis, tiled=True)
         if op == "all_to_all":
             return lambda x: jax.lax.all_to_all(x, axis, 0, 0, tiled=True)
-        if op == "broadcast":
+        if op in ("broadcast", "scatter"):
+            # scatter's wire IS a broadcast (see scatter()); replay as one
             return lambda x: jax.lax.psum(
                 jnp.where(jax.lax.axis_index(axis) == 0, x, jnp.zeros_like(x)),
                 axis)
@@ -338,6 +340,57 @@ def broadcast(x, axis_name: str, src_index: int = 0):
     idx = jax.lax.axis_index(axis_name)
     masked = jnp.where(idx == src_index, x, jnp.zeros_like(x))
     return jax.lax.psum(masked, axis_name)
+
+
+def reduce(x, axis_name: str, dst_index: int = 0,
+           op: ReduceOp = ReduceOp.SUM):
+    """Reduce-to-one (reference comm.py reduce): every member computes the
+    reduction, non-dst members get zeros — under SPMD a true single-owner
+    reduce is a psum plus a mask, same wire cost."""
+    _record("reduce", x, axis_name)
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        y = jax.lax.psum(x, axis_name)
+        if op == ReduceOp.AVG:
+            y = y / jax.lax.psum(1, axis_name)
+    elif op == ReduceOp.MAX:
+        y = jax.lax.pmax(x, axis_name)
+    elif op == ReduceOp.MIN:
+        y = jax.lax.pmin(x, axis_name)
+    else:
+        raise NotImplementedError(f"reduce op {op}")
+    idx = jax.lax.axis_index(axis_name)
+    return jnp.where(idx == dst_index, y, jnp.zeros_like(y))
+
+
+def gather(x, axis_name: str, dst_index: int = 0, axis: int = 0):
+    """Gather-to-one (reference comm.py gather): all_gather, masked off on
+    non-dst members."""
+    _record("gather", x, axis_name)
+    y = jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+    idx = jax.lax.axis_index(axis_name)
+    return jnp.where(idx == dst_index, y, jnp.zeros_like(y))
+
+
+def scatter(x, axis_name: str, src_index: int = 0, axis: int = 0):
+    """Scatter-from-one (reference comm.py scatter): each member ends up
+    with its chunk of the src member's tensor along ``axis``.
+
+    NB: pure-SPMD collectives cannot express an asymmetric one-to-many
+    send, so the wire carries a broadcast; the recorded payload is the
+    algorithmic per-member chunk (what a point-to-point scatter would
+    move)."""
+    world = jax.lax.axis_size(axis_name)  # static inside shard_map
+    if x.shape[axis] % world:
+        raise ValueError(
+            f"scatter: dim {axis} size {x.shape[axis]} not divisible by "
+            f"axis size {world} (torch scatter errors on unequal chunks too)")
+    _COMMS_LOGGER.append("scatter", max(_nbytes(x) // world, 1), 0.0, 0,
+                         axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    masked = jnp.where(idx == src_index, x, jnp.zeros_like(x))
+    full = jax.lax.psum(masked, axis_name)
+    chunk = x.shape[axis] // world
+    return jax.lax.dynamic_slice_in_dim(full, idx * chunk, chunk, axis=axis)
 
 
 def sparse_allreduce(rows, indices, axis_name: str, dense_dim: int):

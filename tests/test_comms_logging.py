@@ -91,3 +91,31 @@ def test_sparse_allreduce_matches_dense(logger_on):
         for j in range(k):
             dense[int(idx[r, j])] += np.asarray(rows[r, j])
     np.testing.assert_allclose(np.asarray(got)[0], dense, rtol=1e-5)
+
+
+def test_reduce_gather_scatter(logger_on):
+    topo = Topology.build_virtual({"data": 4})
+    set_topology(topo)
+    world, n = 4, 8
+    x = jnp.arange(world * n, dtype=jnp.float32).reshape(world, n)
+
+    def spmd(x):
+        r = comm.reduce(x[0], "data", dst_index=1)
+        g = comm.gather(x[0], "data", dst_index=0)
+        s = comm.scatter(x[0], "data", src_index=2)
+        return r[None], g[None], s[None]
+
+    r, g, s = jax.jit(jax.shard_map(
+        spmd, mesh=topo.mesh, axis_names={"data"},
+        in_specs=P("data"), out_specs=(P("data"), P("data"), P("data")),
+        check_vma=False))(x)
+    r, g, s = np.asarray(r), np.asarray(g), np.asarray(s)
+    # reduce: only dst row 1 holds the sum
+    np.testing.assert_allclose(r[1], np.asarray(x).sum(0))
+    assert (r[0] == 0).all() and (r[2] == 0).all()
+    # gather: dst row 0 holds the concatenation
+    np.testing.assert_allclose(g[0], np.asarray(x).reshape(-1))
+    assert (g[1] == 0).all()
+    # scatter: member i holds chunk i of src rank 2's tensor
+    for i in range(world):
+        np.testing.assert_allclose(s[i], np.asarray(x[2, i * 2:(i + 1) * 2]))
