@@ -208,7 +208,12 @@ class Transformer:
             return ring_attention_sharded(q, k, v, self._mesh, causal=True)
         from ..parallel.ulysses import DistributedAttention
 
-        return DistributedAttention(dot_product_attention, self._mesh)(q, k, v, causal=True)
+        # after the a2a each device holds FULL sequences for a head subset —
+        # exactly the flash kernel's shape; the dispatcher falls back to the
+        # jnp path off-TPU / on odd shapes
+        local_attn = (flash_attention if self.config.use_flash
+                      else dot_product_attention)
+        return DistributedAttention(local_attn, self._mesh)(q, k, v, causal=True)
 
     def _block(self, x, lp, angles, positions, kv_cache=None, rng=None, training=False):
         """One transformer block. x: [b, s, d]. Returns (x, new_kv, aux)."""
