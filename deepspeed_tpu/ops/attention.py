@@ -67,11 +67,17 @@ def dot_product_attention(q, k, v, *, causal: bool = True,
 def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None,
                     block_q: int = 1024, block_k: int = 1024):
     """Blocked flash attention. Dispatches to the Pallas TPU kernel when
-    running on TPU with compatible shapes; jnp reference otherwise."""
+    running on TPU with compatible shapes (padding odd causal self-attention
+    lengths up to a lane multiple); jnp reference otherwise."""
     if _use_pallas(q, k, block_q, block_k):
         from .pallas.flash_attention import flash_attention as _pallas_flash
 
         return _pallas_flash(q, k, v, causal, scale, block_q, block_k)
+    if _use_pallas_padded(q, k, causal):
+        from .pallas.flash_attention import flash_attention_padded
+
+        return flash_attention_padded(q, k, v, causal, scale,
+                                      block_q, block_k)
     return dot_product_attention(q, k, v, causal=causal, scale=scale)
 
 
@@ -93,3 +99,14 @@ def _use_pallas(q, k, block_q: int, block_k: int) -> bool:
     # like 264 would otherwise clamp to an untested non-multiple-of-128 block
     return (sq % bq == 0 and skv % bk == 0 and bq % 128 == 0 and bk % 128 == 0
             and d in (64, 128, 256) and hq % hkv == 0 and skv >= sq)
+
+
+def _use_pallas_padded(q, k, causal: bool) -> bool:
+    """Odd causal self-attention lengths go through the pad-to-lane wrapper
+    (kernel coverage for s not divisible by 128, e.g. 1000)."""
+    if not (_on_tpu() and causal):
+        return False
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    return (sq == skv and sq > 128 and d in (64, 128, 256)
+            and hq % hkv == 0)

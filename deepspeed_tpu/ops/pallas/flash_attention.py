@@ -370,3 +370,26 @@ def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention_padded(q, k, v, causal: bool = True,
+                           scale: Optional[float] = None,
+                           block_q: int = 1024, block_k: int = 1024,
+                           interpret: bool = False):
+    """Arbitrary-length causal SELF-attention via symmetric zero-padding to
+    a lane multiple. Exact: with sq == skv and causal masking, a real query
+    i attends keys <= i, so padded keys (> real length) are always masked
+    out; padded query rows produce garbage that the final slice drops, and
+    their cotangent is zero so dk/dv stay exact through the backward."""
+    assert causal and q.shape[1] == k.shape[1], \
+        "padding trick requires causal self-attention (sq == skv)"
+    s = q.shape[1]
+    pad = (-s) % LANES
+    if pad == 0:
+        return flash_attention(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+    widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+    out = flash_attention(jnp.pad(q, widths), jnp.pad(k, widths),
+                          jnp.pad(v, widths), causal, scale,
+                          block_q, block_k, interpret)
+    return out[:, :s]

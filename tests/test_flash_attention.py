@@ -102,3 +102,34 @@ def test_dispatcher_gate():
     q, k, _ = _make_qkv(1, 128, 128, 2, 2, 64)
     # off-TPU always falls back
     assert _use_pallas(q, k, 128, 128) is False
+
+
+def test_padded_flash_matches_reference_odd_length():
+    """Arbitrary (non-lane-multiple) causal self-attention through the
+    padding wrapper: fwd and grads exact vs the jnp oracle."""
+    from deepspeed_tpu.ops.attention import dot_product_attention
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_padded
+
+    rng = np.random.default_rng(7)
+    b, s, h, d = 1, 200, 4, 64  # 200 % 128 != 0
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+
+    out = flash_attention_padded(q, k, v, True, None, 128, 128, True)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss_p(q, k, v):
+        return jnp.sum(flash_attention_padded(q, k, v, True, None,
+                                              128, 128, True) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-3)
