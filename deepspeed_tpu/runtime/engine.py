@@ -265,7 +265,7 @@ class TrainEngine:
         self.compute_dtype = config.compute_dtype
         self.global_steps = 0
         self.micro_steps = 0
-        self.skipped_steps = 0
+        self.skipped_steps = 0  # via the lazy property below
         self.rng = jax.random.PRNGKey(config.train_seed)
 
         # -- bookkeeping / observability
@@ -295,6 +295,26 @@ class TrainEngine:
 
     # ==================================================================
     # properties (parity with engine.py:468-:869 accessors)
+    @property
+    def skipped_steps(self) -> int:
+        """Steps dropped by the loss scaler. Resolved lazily: the per-step
+        overflow flag stays a device scalar accumulated with an async add —
+        fetching it eagerly would block the host on every step (through the
+        axon relay, a full round trip) and serialize dispatch."""
+        if self._skipped_dev is not None:
+            self._skipped_base += int(jax.device_get(self._skipped_dev))
+            self._skipped_dev = None
+        return self._skipped_base
+
+    @skipped_steps.setter
+    def skipped_steps(self, value: int) -> None:
+        self._skipped_base = int(value)
+        self._skipped_dev = None
+
+    def _note_skipped(self, skipped) -> None:
+        s = jnp.asarray(skipped).astype(jnp.int32)
+        self._skipped_dev = s if self._skipped_dev is None else self._skipped_dev + s
+
     @property
     def train_batch_size(self) -> int:
         return self.config.train_batch_size
@@ -567,10 +587,18 @@ class TrainEngine:
             self.opt_state = jax.device_put(self.opt_state, self._opt_host_shardings)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
-        self.tput.stop(sync_obj=metrics["loss"], report_speed=True)
-        self._write_monitor(metrics)
-        if bool(metrics["skipped"]):
-            self.skipped_steps += 1
+        # sync_obj blocks the host until the step completes — honest per-step
+        # timing, but it forbids dispatch-ahead pipelining. Only pay for it
+        # when the user asked for timing (wall_clock_breakdown), when a
+        # monitor will fetch the metrics anyway (so the fetch lands inside
+        # the timed region, not the untimed gap), or at the report boundary.
+        report_boundary = self.tput.will_report_next()
+        sync = metrics["loss"] if (
+            self.config.wall_clock_breakdown or self.monitor is not None
+            or report_boundary) else None
+        self.tput.stop(sync_obj=sync, report_speed=True)
+        self._write_monitor(metrics, log_step=report_boundary)
+        self._note_skipped(metrics["skipped"])
         self._last_loss = metrics["loss"]
         return metrics
 
@@ -673,8 +701,7 @@ class TrainEngine:
         self._acc_grads = None
         self._params_to_offload()
         self.global_steps += 1
-        if bool(skipped):
-            self.skipped_steps += 1
+        self._note_skipped(skipped)
         self._write_monitor({"loss": self._last_loss, "grad_norm": gnorm,
                              "loss_scale": self.scaler_state.scale, "skipped": skipped})
 
@@ -696,8 +723,15 @@ class TrainEngine:
         self.rng, sub = jax.random.split(self.rng)
         return sub
 
-    def _write_monitor(self, metrics: Dict[str, Any]) -> None:
-        if self.global_steps % self.config.steps_per_print == 0:
+    def _write_monitor(self, metrics: Dict[str, Any],
+                       log_step: Optional[bool] = None) -> None:
+        # keyed off the throughput timer's boundary when the caller knows it
+        # (train_batch) so the blocking float() fetches below never land
+        # mid-window on an unsynced step; global_steps fallback for the
+        # compat step() path
+        if log_step is None:
+            log_step = self.global_steps % self.config.steps_per_print == 0
+        if log_step:
             log_dist(
                 f"step={self.global_steps} loss={float(metrics['loss']):.4f} "
                 f"lr={self.get_lr():.3e} grad_norm={float(metrics['grad_norm']):.3f}"

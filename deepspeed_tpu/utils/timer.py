@@ -15,6 +15,15 @@ from typing import Any, Dict, List, Optional
 from .logging import log_dist
 
 
+def _fence(obj: Any) -> None:
+    """Host-side completion fence. ``jax.block_until_ready`` is NOT a fence
+    through remote-dispatch relays (e.g. the axon TPU tunnel) — only a host
+    fetch reliably waits for the device, so fetch the (scalar) sync object."""
+    import jax
+
+    jax.device_get(obj)
+
+
 class _Timer:
     def __init__(self, name: str):
         self.name = name
@@ -27,9 +36,7 @@ class _Timer:
 
     def stop(self, sync_obj: Any = None) -> float:
         if sync_obj is not None:
-            import jax
-
-            jax.block_until_ready(sync_obj)
+            _fence(sync_obj)
         assert self._start is not None, f"timer {self.name} stopped before start"
         dt = time.perf_counter() - self._start
         self.elapsed_total += dt
@@ -80,27 +87,42 @@ class ThroughputTimer:
         self.total_time = 0.0
         self._start = None
         self.step_count = 0
+        self._window_time = 0.0
+        self._window_steps = 0
 
     def start(self) -> None:
         self._start = time.perf_counter()
+
+    def will_report_next(self) -> bool:
+        """True if the NEXT stop() will emit the throughput line — the
+        engine uses this to decide whether to pass a sync object, so the
+        report-boundary predicate lives in exactly one place."""
+        return (self.step_count + 1) % self.steps_per_output == 0
 
     def stop(self, sync_obj: Any = None, report_speed: bool = True) -> None:
         if self._start is None:
             return
         if sync_obj is not None:
-            import jax
-
-            jax.block_until_ready(sync_obj)
+            _fence(sync_obj)
         dt = time.perf_counter() - self._start
         self._start = None
         self.step_count += 1
         self.total_samples += self.batch_size
         self.total_time += dt
+        self._window_time += dt
+        self._window_steps += 1
         if report_speed and self.step_count % self.steps_per_output == 0:
+            # window-averaged ms/step: under async dispatch the engine only
+            # syncs at the report boundary, so the boundary step's own dt
+            # covers the whole drained window — dt alone would read ~window x
+            # the true step time (and ~0 on unsynced steps)
+            ms = self._window_time / self._window_steps * 1e3
             log_dist(
                 f"step {self.step_count}: {self.avg_samples_per_sec():.2f} samples/s, "
-                f"{dt * 1e3:.1f} ms/step"
+                f"{ms:.1f} ms/step (avg over {self._window_steps})"
             )
+            self._window_time = 0.0
+            self._window_steps = 0
 
     def avg_samples_per_sec(self) -> float:
         return self.total_samples / self.total_time if self.total_time else 0.0
