@@ -61,11 +61,13 @@ def _child_main():
     remat_env = os.environ.get("DST_BENCH_REMAT", "selective")
     remat = remat_env != "none"
     # ~350M-param Llama sized for a single v5e chip with Adam fp32 state
+    # chunked CE bounds the fp32 logits transient to [chunk, vocab]
+    ce_chunk = int(os.environ.get("DST_BENCH_CE_CHUNK", "4096"))
     if on_tpu:
         model = Llama("tiny", d_model=1024, n_layers=24, n_heads=16, n_kv_heads=16,
                       d_ff=2816, vocab_size=32000, max_seq_len=2048, remat=remat,
                       remat_policy=remat_env if remat else "full",
-                      use_flash=use_flash)
+                      use_flash=use_flash, loss_chunk_size=ce_chunk)
         batch_size, seq_len, steps, warmup = 8, 2048, 10, 2
     else:  # CPU smoke fallback
         model = Llama("tiny", n_layers=2, d_model=128, n_heads=4, n_kv_heads=4,
@@ -113,6 +115,7 @@ def _child_main():
             "platform": jax.devices()[0].device_kind,
             "flash_attention": use_flash,
             "remat": remat_env,
+            "ce_chunk": ce_chunk if on_tpu else 0,
             "step_ms": round(dt / steps * 1e3, 1),
         },
     }), flush=True)
@@ -173,16 +176,21 @@ def main():
 
     child = [sys.executable, os.path.abspath(__file__)]
     if _probe_tpu():
-        rc, line = _run(child, dict(_tpu_env(), DST_BENCH_FLASH="1"), TPU_BENCH_TIMEOUT_S)
+        # respect a caller-set DST_BENCH_FLASH (the MFU sweep A/Bs it);
+        # default to flash on
+        flash = os.environ.get("DST_BENCH_FLASH", "1")
+        rc, line = _run(child, dict(_tpu_env(), DST_BENCH_FLASH=flash), TPU_BENCH_TIMEOUT_S)
         if line:
             print(line, flush=True)
             return 0
-        print("[bench] TPU bench with flash failed; retrying without flash",
-              file=sys.stderr)
-        rc, line = _run(child, dict(_tpu_env(), DST_BENCH_FLASH="0"), TPU_BENCH_TIMEOUT_S)
-        if line:
-            print(line, flush=True)
-            return 0
+        if flash == "1":
+            print("[bench] TPU bench with flash failed; retrying without flash",
+                  file=sys.stderr)
+            rc, line = _run(child, dict(_tpu_env(), DST_BENCH_FLASH="0"),
+                            TPU_BENCH_TIMEOUT_S)
+            if line:
+                print(line, flush=True)
+                return 0
         print("[bench] TPU bench failed outright; falling back to CPU", file=sys.stderr)
 
     rc, line = _run(child, _cpu_env(), CPU_BENCH_TIMEOUT_S)

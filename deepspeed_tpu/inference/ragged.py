@@ -38,11 +38,16 @@ from ..ops.rotary import apply_rotary, rope_frequencies
 from ..utils.logging import log_dist
 
 
-def _use_pallas_paged(head_dim: int, block: int, dtype) -> bool:
-    """Pallas paged kernel eligibility: real TPU + tileable page shape."""
+def _use_pallas_paged(head_dim: int, block: int, dtype,
+                      scalar_ints: int = 0) -> bool:
+    """Pallas paged kernel eligibility: real TPU + tileable page shape +
+    prefetched scalars (per-seq tables, slots, positions) fitting in SMEM
+    (1 MB/core; keep them under half)."""
     from ..ops.attention import _on_tpu
 
     if not _on_tpu():
+        return False
+    if scalar_ints * 4 > 512 * 1024:
         return False
     sublane = 32 // jnp.dtype(dtype).itemsize  # 8 fp32 / 16 any 16-bit dtype
     return head_dim in (64, 128, 256) and block % sublane == 0
@@ -143,16 +148,30 @@ class RaggedInferenceEngine:
         self.seqs: Dict[int, SequenceDescriptor] = {}
         self._free_slots = list(range(cfg.max_seqs))
         self.max_pages = cfg.max_context // cfg.kv_block_size
-        # paged KV pool [n_layers, n_blocks + 1, hkv, block, hd] — (block, hd)
-        # minor-most so each page is a native VMEM tile for the Pallas paged
-        # kernel. The last page is a scratch sink for masked-out batch lanes
-        # (duplicate scatters with mixed old/new values are undefined —
-        # inactive lanes must never alias a live page)
-        pool_shape = (c.n_layers, cfg.n_kv_blocks + 1, c.n_kv_heads,
+        # paged KV pool: per-layer tuples of [n_blocks + 1, hkv, block, hd]
+        # (last page = scratch sink for masked-out batch lanes; duplicate
+        # scatters with mixed old/new values are undefined — inactive lanes
+        # must never alias a live page). One array PER LAYER, not a stacked
+        # [L, pages, ...] tensor: stacked, every layer's update is a
+        # pool-sized dynamic-slice copy-out/copy-in (the whole KV pool
+        # re-written L times per step — measured 100 ms/decode-step); flat
+        # [(L)*(P+1), ...] with offset tables avoids the slices but XLA then
+        # materializes pool-sized scatter copies (measured 16-18 GB compile
+        # OOM on a 4.3 GB pool). Per-layer leaves keep every scatter's
+        # worst-case transient to one leaf. (block, hd) stay minor-most so
+        # each page is a native VMEM tile for the Pallas kernel
+        leaf_shape = (cfg.n_kv_blocks + 1, c.n_kv_heads,
                       cfg.kv_block_size, c.head_dim)
-        self.kv_pool = (jnp.zeros(pool_shape, cfg.dtype),
-                        jnp.zeros(pool_shape, cfg.dtype))
+        self.kv_pool = (
+            tuple(jnp.zeros(leaf_shape, cfg.dtype) for _ in range(c.n_layers)),
+            tuple(jnp.zeros(leaf_shape, cfg.dtype) for _ in range(c.n_layers)))
         self._step_fn = None
+        self._core_fn = None
+        self._decode_fn = None
+        # ragged-step token buckets (ascending, capped by the budget): a
+        # decode-heavy step compiles + runs at the smallest fitting width
+        self._buckets = [b for b in (64, 256, 1024) if b < cfg.token_budget] \
+            + [cfg.token_budget]
         log_dist(f"RaggedInferenceEngine: budget={cfg.token_budget} "
                  f"blocks={cfg.n_kv_blocks}x{cfg.kv_block_size}")
 
@@ -242,8 +261,12 @@ class RaggedInferenceEngine:
                 f"blocks, have {self.allocator.free_blocks}; flush() finished "
                 "sequences first")
 
-        # ---- build the flat step batch (reference: C++ fast_host_buffer)
-        T = cfg.token_budget
+        # ---- build the flat step batch (reference: C++ fast_host_buffer).
+        # T rounds the scheduled token count up to a bucket, not the full
+        # budget: a pure-decode step with 32 live seqs must not pay a
+        # 4096-lane forward (one compile per bucket, cached by jit)
+        scheduled = sum(take for _, take in sched)
+        T = next(b for b in self._buckets if b >= scheduled)
         flat_tokens = np.zeros((T,), np.int32)
         flat_slot = np.full((T,), -1, np.int32)
         flat_pos = np.zeros((T,), np.int32)
@@ -261,57 +284,158 @@ class RaggedInferenceEngine:
             last_index[seq.uid] = cursor + take - 1
             cursor += take
 
-        block_tables = np.zeros((cfg.max_seqs, self.max_pages), np.int32)
-        context_lens = np.zeros((cfg.max_seqs,), np.int32)
-        for seq in self.seqs.values():
-            block_tables[seq.slot, :len(seq.blocks)] = seq.blocks
-            context_lens[seq.slot] = seq.seen
+        block_tables = self._host_tables()
+
+        # per-slot index of the row whose logits we need (sequences not in
+        # this schedule keep a harmless 0 — their rows are never read)
+        sel_idx = np.zeros((cfg.max_seqs,), np.int32)
+        for uid, idx in last_index.items():
+            sel_idx[self.seqs[uid].slot] = idx
 
         if self._step_fn is None:
             self._step_fn = self._build_step()
         logits, self.kv_pool = self._step_fn(
             self.params, self.kv_pool, jnp.asarray(flat_tokens),
             jnp.asarray(flat_slot), jnp.asarray(flat_pos),
-            jnp.asarray(block_tables), jnp.asarray(context_lens))
-        logits = np.asarray(logits)
+            jnp.asarray(block_tables), jnp.asarray(sel_idx),
+            self._live_pages_bucket())
+        logits = np.asarray(logits)                    # [max_seqs, vocab]
 
         out = np.full((len(uids), logits.shape[-1]), np.nan, np.float32)
         for i, uid in enumerate(uids):
             seq = self.seqs[uid]
             if seq.pending == 0 and uid in last_index:
-                out[i] = logits[last_index[uid]]
+                out[i] = logits[seq.slot]
+        return out
+
+    def _host_tables(self) -> np.ndarray:
+        tables = np.zeros((self.config.max_seqs, self.max_pages), np.int32)
+        for seq in self.seqs.values():
+            tables[seq.slot, :len(seq.blocks)] = seq.blocks
+        return tables
+
+    def _live_pages_bucket(self) -> int:
+        """Static page-walk bound for this step: smallest power of two >=
+        the longest live sequence's page count (pow2-bucketed so the jit
+        cache holds O(log max_pages) variants, not one per context len)."""
+        most = max((len(s.blocks) for s in self.seqs.values()), default=1)
+        b = 1
+        while b < most:
+            b *= 2
+        return min(b, self.max_pages)
+
+    def decode_steps(self, first_tokens: Dict[int, int], k: int) -> Dict[int, List[int]]:
+        """Greedy-decode ``k`` tokens for every uid in ``first_tokens`` in
+        ONE device call (see _build_decode).
+
+        ``first_tokens[uid]`` is the next input token (produced by the
+        previous step's logits, not yet admitted). Returns uid -> the k
+        tokens generated after it; the last one is returned un-processed —
+        feed it as the next call's first token (exactly like the
+        one-token-at-a-time put() contract). Every uid must be fully
+        prefilled (pending == 0)."""
+        cfg = self.config
+        if k < 1:
+            raise ValueError(f"decode_steps needs k >= 1, got {k}")
+        for uid in first_tokens:
+            seq = self.seqs[uid]
+            if seq.pending:
+                raise ValueError(f"uid {uid} still has pending prefill")
+            total = seq.seen + k
+            if total > cfg.max_context:
+                raise ValueError(
+                    f"uid {uid}: decode chunk to {total} exceeds "
+                    f"max_context {cfg.max_context}")
+            need = -(-total // cfg.kv_block_size) - len(seq.blocks)
+            if need > 0:
+                seq.blocks.extend(self.allocator.allocate(need))
+
+        S = cfg.max_seqs
+        toks = np.zeros((S,), np.int32)
+        pos = np.zeros((S,), np.int32)
+        slots = np.full((S,), -1, np.int32)
+        for uid, first in first_tokens.items():
+            seq = self.seqs[uid]
+            toks[seq.slot] = first
+            pos[seq.slot] = seq.seen
+            slots[seq.slot] = seq.slot
+
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        gen, self.kv_pool = self._decode_fn(
+            self.params, self.kv_pool, jnp.asarray(toks), jnp.asarray(pos),
+            jnp.asarray(slots), jnp.asarray(self._host_tables()),
+            jnp.zeros((k,), jnp.int32), self._live_pages_bucket())
+        gen = np.asarray(gen)                                   # [S, k]
+
+        out = {}
+        for uid, first in first_tokens.items():
+            seq = self.seqs[uid]
+            chain = gen[seq.slot].tolist()
+            # positions seen..seen+k-1 now hold first + chain[:-1]
+            seq.tokens.extend([first] + chain[:-1])
+            seq.seen += k
+            out[uid] = chain
         return out
 
     # -- generation convenience -----------------------------------------
     def generate(self, prompts: Dict[int, Sequence[int]], max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None) -> Dict[int, List[int]]:
-        """Drive put() with SplitFuse scheduling until every uid has
-        ``max_new_tokens`` (or EOS). Returns uid -> generated tokens."""
+                 eos_token_id: Optional[int] = None,
+                 decode_chunk: int = 16) -> Dict[int, List[int]]:
+        """Greedy generation: SplitFuse put() steps until every prompt is
+        prefilled, then ``decode_steps`` chunks of up to ``decode_chunk``
+        tokens per device call. Returns uid -> generated tokens."""
         done: Dict[int, List[int]] = {u: [] for u in prompts}
         uids = list(prompts)
         logits = self.put(uids, [list(p) for p in prompts.values()])
-        while uids:
-            step_uids, step_toks = [], []
-            for uid, row in zip(uids, logits):
-                if np.isnan(row).any():          # prompt still prefilling
-                    step_uids.append(uid)
-                    step_toks.append([])
-                    continue
-                tok = int(np.argmax(row))
-                done[uid].append(tok)
-                if (len(done[uid]) < max_new_tokens
-                        and not (eos_token_id is not None and tok == eos_token_id)):
-                    step_uids.append(uid)
-                    step_toks.append([tok])
-            if not step_uids:
+        # run prefill to completion, collecting each uid's first decode
+        # token as its row resolves (long prompts span multiple steps)
+        first: Dict[int, int] = {}
+        while True:
+            pending = []
+            for u, row in zip(uids, logits):
+                if np.isnan(row).any():
+                    pending.append(u)
+                else:
+                    first[u] = int(np.argmax(row))
+            if not pending:
                 break
-            logits = self.put(step_uids, step_toks)
-            uids = step_uids
+            uids = pending
+            logits = self.put(pending, [[] for _ in pending])
+        for u, t in first.items():
+            done[u].append(t)
+
+        live = {u: t for u, t in first.items()
+                if len(done[u]) < max_new_tokens
+                and not (eos_token_id is not None and t == eos_token_id)}
+        while live:
+            budget = min(max_new_tokens - len(done[u]) for u in live)
+            room = min(self.config.max_context - self.seqs[u].seen
+                       for u in live)
+            k = max(1, min(decode_chunk, budget, room))
+            gens = self.decode_steps(live, k)
+            nxt = {}
+            for u, chain in gens.items():
+                stop = False
+                for t in chain:
+                    done[u].append(t)
+                    if eos_token_id is not None and t == eos_token_id:
+                        stop = True
+                        break
+                if (not stop and len(done[u]) < max_new_tokens
+                        and self.seqs[u].seen < self.config.max_context):
+                    nxt[u] = chain[-1]
+            live = nxt
+        for u in done:
+            done[u] = done[u][:max_new_tokens]
         self.flush(list(prompts))
         return done
 
     # -- the compiled ragged step ----------------------------------------
-    def _build_step(self):
+    def _build_core(self):
+        """The shared ragged forward: (params, pools, tokens, slots,
+        positions, block_tables) -> (hidden [T, d], pools). Traced inside
+        both the SplitFuse ``put`` step and the multi-step decode loop."""
         from ..ops.pallas.paged_attention import (paged_attention,
                                                   paged_attention_reference)
 
@@ -319,14 +443,17 @@ class RaggedInferenceEngine:
         c = model.config
         cfg = self.config
         bs = cfg.kv_block_size
-        use_pallas = _use_pallas_paged(c.head_dim, bs, self.config.dtype)
+        use_pallas = _use_pallas_paged(
+            c.head_dim, bs, self.config.dtype,
+            scalar_ints=cfg.max_seqs * self.max_pages + 2 * cfg.token_budget)
 
         def norm(x, w, b=None):
             return rms_norm(x, w, c.norm_eps) if c.norm == "rms" \
                 else layer_norm(x, w, b, c.norm_eps)
 
-        def step(params, pools, tokens, slots, positions, block_tables,
-                 context_lens):
+        def core(params, pools, tokens, slots, positions, block_tables,
+                 live_pages):
+            # live_pages: static python int — bounds the kernel's page walk
             # tokens/slots/positions: [T]; embeddings via the model's path
             x = model._embed(params, tokens[None, :],
                              positions=positions[None, :])[0]  # [T, d]
@@ -334,15 +461,15 @@ class RaggedInferenceEngine:
                 if c.position == "rope" else None
             active = slots >= 0                                   # [T]
             safe_slot = jnp.maximum(slots, 0)
-            # per-token flat page list and context mask
-            tables = block_tables[safe_slot]                      # [T, max_pages]
-            ctx = context_lens[safe_slot]                         # [T]
+            # the Pallas kernel takes the per-seq tables + slot indirection
+            # directly (scalar prefetch stays O(seqs * pages), SMEM-sized);
+            # only the gather fallback expands to per-token [T, max_pages]
+            tables = None if use_pallas else block_tables[safe_slot]
 
-            k_pool, v_pool = pools
+            k_list, v_list = list(pools[0]), list(pools[1])
 
-            def block(carry, layer_in):
-                x, kp, vp = carry
-                li, lp = layer_in
+            def block(x, li, lp):
+                kp, vp = k_list[li], v_list[li]
                 h = norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"))
                 q = (h @ lp["wq"]).reshape(-1, c.n_heads, c.head_dim)
                 kk = (h @ lp["wk"]).reshape(-1, c.n_kv_heads, c.head_dim)
@@ -358,26 +485,30 @@ class RaggedInferenceEngine:
                     kk = apply_rotary(kk[:, None], angles, positions[:, None],
                                       rotary_dim=c.rotary_dim,
                                       interleaved=c.rope_interleaved)[:, 0]
-                # scatter new K/V into this layer's pages:
+                # scatter new K/V into this layer's pages — one in-place
+                # scatter of the touched pages into this layer's leaf:
                 # page = table[pos // bs], row = pos % bs
-                page = jnp.take_along_axis(tables, (positions // bs)[:, None],
-                                           axis=1)[:, 0]          # [T]
+                page = block_tables[safe_slot, positions // bs]   # [T]
                 row = positions % bs
-                # inactive lanes scatter into the scratch sink page
-                page = jnp.where(active, page, cfg.n_kv_blocks)
+                # inactive lanes — and any lane past the context window
+                # (possible in the tail of a multi-step decode) — scatter
+                # into the scratch sink page, never a live one
+                page = jnp.where(active & (positions < cfg.max_context),
+                                 page, cfg.n_kv_blocks)
                 # pool layout [pages, hkv, block, hd]; kk [T, hkv, hd]
-                kp_l = kp[li].at[page, :, row].set(kk.astype(kp.dtype))
-                vp_l = vp[li].at[page, :, row].set(vv.astype(vp.dtype))
-                kp = kp.at[li].set(kp_l)
-                vp = vp.at[li].set(vp_l)
+                kp = kp.at[page, :, row].set(kk.astype(kp.dtype))
+                vp = vp.at[page, :, row].set(vv.astype(vp.dtype))
+                k_list[li], v_list[li] = kp, vp
                 # paged attention: Pallas kernel on TPU (scalar-prefetched
                 # block tables, zero gather); jnp gather path elsewhere.
                 # (positions <= ctx-1 always, so the causal mask subsumes the
                 # context-length mask; inactive lanes produce ignored junk)
                 if use_pallas:
-                    attn = paged_attention(q, kp_l, vp_l, tables, positions)
+                    attn = paged_attention(q, kp, vp, block_tables,
+                                           positions, seq_slots=safe_slot,
+                                           live_pages=live_pages)
                 else:
-                    attn = paged_attention_reference(q, kp_l, vp_l, tables,
+                    attn = paged_attention_reference(q, kp, vp, tables,
                                                      positions)
                 attn = attn.astype(x.dtype)
                 attn = attn.reshape(-1, c.n_heads * c.head_dim) @ lp["wo"]
@@ -388,13 +519,66 @@ class RaggedInferenceEngine:
                 # the model's own MLP: honors relu/gelu/gelu_exact/silu_glu
                 # and the MoE override (top-k routed experts) uniformly
                 down, _ = model._mlp(h[None], lp, None, False)
-                return (x + down[0], kp, vp), None
+                return x + down[0]
 
-            n_layers = c.n_layers
-            (x, k_pool, v_pool), _ = jax.lax.scan(
-                block, (x, k_pool, v_pool),
-                (jnp.arange(n_layers), params["layers"]))
-            logits = model._head(params, x[None, :])[0]            # [T, vocab]
-            return logits, (k_pool, v_pool)
+            # python-unrolled layer loop, NOT lax.scan: a scan would carry
+            # the whole pool and either re-slice it per layer (stacked
+            # layout) or double-buffer it (flat layout) — see the pool_shape
+            # comment in __init__
+            for li in range(c.n_layers):
+                lp = jax.tree_util.tree_map(lambda a: a[li], params["layers"])
+                x = block(x, li, lp)
+            return x, (tuple(k_list), tuple(v_list))
 
-        return jax.jit(step, donate_argnums=(1,))
+        return core
+
+    @property
+    def _core(self):
+        if self._core_fn is None:
+            self._core_fn = self._build_core()
+        return self._core_fn
+
+    def _build_step(self):
+        core = self._core
+        model = self.model
+
+        def step(params, pools, tokens, slots, positions, block_tables,
+                 sel_idx, live_pages):
+            x, pools = core(params, pools, tokens, slots, positions,
+                            block_tables, live_pages)
+            # head only on each sequence's selected (last) token: the full
+            # [token_budget, vocab] fp32 logits are 512 MB at T=4096 v=32k
+            # and were previously fetched to host every step — select the
+            # [max_seqs] rows on-device before the (remote) host transfer
+            x_sel = x[sel_idx]                                     # [S, d]
+            logits = model._head(params, x_sel[None, :])[0]        # [S, vocab]
+            return logits, pools
+
+        return jax.jit(step, donate_argnums=(1,), static_argnums=(7,))
+
+    def _build_decode(self):
+        """Multi-step greedy decode entirely on device: one token per live
+        slot per step, argmax fed straight into the next step, KV scattered
+        into pre-allocated pages. The host round trip (the dominant cost of
+        one-token-at-a-time serving through a remote runtime) amortizes over
+        the whole chunk. Reference analog: FastGen schedules one engine call
+        per forward (inference/v2/ragged/ragged_manager.py) — on TPU the
+        chunked loop is the idiomatic shape."""
+        core = self._core
+        model = self.model
+
+        def decode(params, pools, tokens0, positions0, slots, block_tables,
+                   steps_xs, live_pages):
+            def one(carry, _):
+                pools, toks, pos = carry
+                x, pools = core(params, pools, toks, slots, pos, block_tables,
+                                live_pages)
+                logits = model._head(params, x[None, :])[0]    # [S, vocab]
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (pools, nxt, pos + 1), nxt
+
+            (pools, _, _), gen = jax.lax.scan(
+                one, (pools, tokens0, positions0), steps_xs)
+            return gen.T, pools                                 # [S, k]
+
+        return jax.jit(decode, donate_argnums=(1,), static_argnums=(7,))

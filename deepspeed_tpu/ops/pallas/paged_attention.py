@@ -51,9 +51,12 @@ NEG_INF = -1e30
 LANES = 128
 
 
-def _kernel(tables_ref, pos_ref,          # scalar prefetch
-            q_ref, *rest,
-            scale: float, block: int, hkv: int, group: int, ppc: int):
+def _kernel(*refs,
+            scale: float, block: int, hkv: int, group: int, ppc: int,
+            num_scalars: int):
+    # scalar-prefetch refs lead; positions is always the last of them
+    pos_ref = refs[num_scalars - 1]
+    q_ref, *rest = refs[num_scalars:]
     krefs, vrefs = rest[:ppc], rest[ppc:2 * ppc]
     o_ref = rest[2 * ppc]
     m_scr, l_scr, acc_scr = rest[2 * ppc + 1:]
@@ -104,41 +107,67 @@ def _kernel(tables_ref, pos_ref,          # scalar prefetch
 
 
 def paged_attention(q, k_pool, v_pool, tables, positions, *,
-                    scale=None, pages_per_chunk: int | None = None,
+                    seq_slots=None, scale=None,
+                    pages_per_chunk: int | None = None,
+                    live_pages: int | None = None,
                     interpret: bool = False):
     """Decode attention over a paged KV pool. See module docstring for the
     layout contract. Causal by construction: token t sees pool rows with
-    position <= positions[t] along its own page list."""
+    position <= positions[t] along its own page list.
+
+    ``tables`` is per-token [T, max_pages] by default. For ragged batches
+    where many tokens share a sequence (SplitFuse prefill chunks), pass
+    per-sequence tables [n_seqs, max_pages] plus ``seq_slots`` [T] mapping
+    each token to its table row — the prefetched scalars then stay
+    O(n_seqs * max_pages) instead of O(T * max_pages), which must fit SMEM
+    (a [4096, 128] per-token table is 2 MB and does not).
+
+    ``live_pages`` (static) bounds the page walk: the grid only visits
+    ceil(live_pages / ppc) chunks per token. Dead chunks are pl.when-skipped
+    anyway, but their ~us of grid overhead dominates short-context decode
+    over a long max_context table (caller guarantees every
+    positions[t] < live_pages * block; rows beyond are silently ignored)."""
     T, hq, hd = q.shape
     n_pages, hkv, block, _ = k_pool.shape
     max_pages = tables.shape[1]
     group = hq // hkv
     assert hq % hkv == 0
     scale = scale if scale is not None else 1.0 / np.sqrt(hd)
+    walk_pages = max_pages if live_pages is None \
+        else max(1, min(live_pages, max_pages))
     if pages_per_chunk is None:
-        pages_per_chunk = max(1, min(max_pages, 256 // block))
-    ppc = min(pages_per_chunk, max_pages)
-    nchunks = -(-max_pages // ppc)
+        pages_per_chunk = max(1, min(walk_pages, 256 // block))
+    ppc = min(pages_per_chunk, walk_pages)
+    nchunks = -(-walk_pages // ppc)
 
     qg = q.reshape(T, hkv, group, hd)
     tables = tables.astype(jnp.int32)
     positions = positions.astype(jnp.int32)
+    if seq_slots is None:
+        scalars = (tables, positions)
+    else:
+        scalars = (tables, seq_slots.astype(jnp.int32), positions)
 
-    def q_index(t, c, tbl, pos):
+    def row_of(t, s):
+        return t if seq_slots is None else s[1][t]
+
+    def q_index(t, c, *s):
         return (t, 0, 0, 0)
 
     def page_index(i):
-        def index(t, c, tbl, pos):
+        def index(t, c, *s):
             # past-the-end slots re-use the last live page's index: Pallas
             # skips the copy when the block index repeats, so dead chunks
             # cost no DMA — and the table read never strays off the row
+            tbl, pos = s[0], s[-1]
             j = jnp.minimum(c * ppc + i, max_pages - 1)
-            return (tbl[t, jnp.minimum(j, pos[t] // block)], 0, 0, 0)
+            return (tbl[row_of(t, s), jnp.minimum(j, pos[t] // block)],
+                    0, 0, 0)
         return index
 
     page_spec = lambda i: pl.BlockSpec((1, hkv, block, hd), page_index(i))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(scalars),
         grid=(T, nchunks),
         in_specs=[pl.BlockSpec((1, hkv, group, hd), q_index)]
         + [page_spec(i) for i in range(ppc)] * 2,
@@ -150,12 +179,12 @@ def paged_attention(q, k_pool, v_pool, tables, positions, *,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, block=block,
-                          hkv=hkv, group=group, ppc=ppc),
+        functools.partial(_kernel, scale=scale, block=block, hkv=hkv,
+                          group=group, ppc=ppc, num_scalars=len(scalars)),
         out_shape=jax.ShapeDtypeStruct((T, hkv, group, hd), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(tables, positions, qg, *([k_pool] * ppc), *([v_pool] * ppc))
+    )(*scalars, qg, *([k_pool] * ppc), *([v_pool] * ppc))
     return out.reshape(T, hq, hd)
 
 

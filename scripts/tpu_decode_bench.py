@@ -45,25 +45,14 @@ def _paged_ab(report):
     err = float(jnp.max(jnp.abs(o_k.astype(jnp.float32) -
                                 o_r.astype(jnp.float32))))
 
-    def bench(f, iters=20):
-        @jax.jit
-        def many(qd, kpool, vpool, tbl, pos):
-            def body(_, q):
-                o = f(q, kpool, vpool, tbl, pos)
-                return q + 1e-6 * o.astype(q.dtype)
-            return jnp.sum(jax.lax.fori_loop(0, iters, body, qd)
-                           .astype(jnp.float32))
+    # floor-corrected chained timing (shared with tpu_flash_check.py)
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tpu_flash_check import _paged_ab_ms
 
-        float(many(qd, kpool, vpool, tbl, pos))
-        best = float("inf")
-        for _ in range(2):
-            t0 = time.perf_counter()
-            float(many(qd, kpool, vpool, tbl, pos))
-            best = min(best, time.perf_counter() - t0)
-        return best / iters * 1e3
-
-    k_ms = bench(paged_attention)
-    g_ms = bench(paged_attention_reference)
+    rest = (kpool, vpool, tbl, pos)
+    k_ms = _paged_ab_ms(paged_attention, qd, rest)
+    g_ms = _paged_ab_ms(paged_attention_reference, qd, rest)
     report["paged_ab"] = {"max_err": err, "kernel_ms": round(k_ms, 3),
                           "gather_ms": round(g_ms, 3),
                           "speedup": round(g_ms / k_ms, 3),
@@ -91,10 +80,12 @@ def _engine_decode(report):
     prompts = {i: rng.integers(1, 32000, (prompt_len,)).tolist()
                for i in range(n_seqs)}
 
-    # warmup: compile the ragged step shapes outside the timed window
+    # warmup: compile the ragged step shapes (prefill bucket, decode chunk,
+    # tail chunk) outside the timed window — same max_new_tokens so the
+    # chunking pattern matches the measured run exactly
     warm = {1000 + i: rng.integers(1, 32000, (prompt_len,)).tolist()
             for i in range(n_seqs)}
-    eng.generate(warm, max_new_tokens=2)
+    eng.generate(warm, max_new_tokens=new_tokens)
 
     t0 = time.perf_counter()
     out = eng.generate(prompts, max_new_tokens=new_tokens)

@@ -64,6 +64,20 @@ def _floored_ms(step, null, q, args, iters):
     return real - floor
 
 
+def _paged_ab_ms(attn_fn, q, rest, iters=100):
+    """Floor-corrected per-iteration ms of a paged-attention-shaped fn
+    (q, k_pool, v_pool, tables, positions) — shared by this script's paged
+    A/B and scripts/tpu_decode_bench.py."""
+
+    def step(q, kpool, vpool, tbl, pos):
+        return q + 1e-6 * attn_fn(q, kpool, vpool, tbl, pos).astype(q.dtype)
+
+    def null(q, kpool, vpool, tbl, pos):
+        return q * (1.0 + 1e-6)
+
+    return _floored_ms(step, null, q, rest, iters)
+
+
 def _bench_grad(fn, q, k, v, iters=20):
     """Floor-corrected per-iteration ms of fwd+bwd of fn."""
     import jax
@@ -162,17 +176,8 @@ def main():
         # full-context positions = worst-case DMA volume for the A/B
         full = jnp.full((T,), mp * blk - 1, jnp.int32)
         rest = (kpool, vpool, tbl, full)
-
-        def step_of(f):
-            def step(q, kpool, vpool, tbl, pos):
-                return q + 1e-6 * f(q, kpool, vpool, tbl, pos).astype(q.dtype)
-            return step
-
-        def null(q, kpool, vpool, tbl, pos):
-            return q * (1.0 + 1e-6)
-
-        km = _floored_ms(step_of(paged_attention), null, qd, rest, 100)
-        gm = _floored_ms(step_of(paged_attention_reference), null, qd, rest, 100)
+        km = _paged_ab_ms(paged_attention, qd, rest)
+        gm = _paged_ab_ms(paged_attention_reference, qd, rest)
         report["paged"][f"block{blk}"] = {
             "max_err": paged_err,
             "kernel_ms": round(km, 3),

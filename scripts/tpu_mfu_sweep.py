@@ -18,6 +18,8 @@ import sys
 
 CONFIGS = [
     {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective"},
+    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective",
+     "DST_BENCH_CE_CHUNK": "0"},
     {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "full"},
     {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "none"},
     {"DST_BENCH_FLASH": "0", "DST_BENCH_REMAT": "selective"},
@@ -44,6 +46,13 @@ def main():
                         entry["result"] = json.loads(ln)
                     except json.JSONDecodeError:
                         pass
+            # bench.py falls back to a CPU smoke child when the TPU config
+            # fails (e.g. remat=none OOM) — that row is NOT a TPU datapoint
+            # and must not sit silently next to real ones
+            plat = ((entry["result"] or {}).get("extra") or {}).get("platform", "")
+            if entry["result"] is not None and "TPU" not in plat:
+                entry["tpu_config_failed"] = True
+                entry["result"] = None
         except subprocess.TimeoutExpired:
             entry["rc"] = "timeout"
         results.append(entry)

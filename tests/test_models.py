@@ -142,3 +142,31 @@ def test_tp_matches_single_device_math():
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out_oh),
                                rtol=2e-4, atol=2e-4)
     model._tp_size = 1  # unbind for other tests sharing the fixture
+
+
+def test_chunked_ce_matches_dense():
+    """loss_chunk_size must not change the loss or the grads — only the
+    logits materialization (chunked head+CE under a remat scan)."""
+    import dataclasses
+
+    from deepspeed_tpu.models import Llama
+
+    m = Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=2,
+              vocab_size=96, max_seq_len=32, use_flash=False, remat=False)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 96, (2, 32)), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0))
+    batch = {"input_ids": tokens}
+
+    dense = m.loss(params, batch, rng=jax.random.PRNGKey(1))
+    m.config = dataclasses.replace(m.config, loss_chunk_size=24)  # pads 64->72
+    chunked = m.loss(params, batch, rng=jax.random.PRNGKey(1))
+    np.testing.assert_allclose(float(dense), float(chunked), rtol=1e-6)
+
+    g_d = jax.grad(lambda p: m.loss(p, batch, rng=jax.random.PRNGKey(1)))(params)
+    m.config = dataclasses.replace(m.config, loss_chunk_size=0)
+    g_c = jax.grad(lambda p: m.loss(p, batch, rng=jax.random.PRNGKey(1)))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=2e-5, atol=2e-6),
+        g_d, g_c)

@@ -104,3 +104,30 @@ def test_paged_positions_mask_tail():
     got = paged_attention(q, kp2, vp2, tables, positions, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(base),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_paged_seq_slots_indirection():
+    """Per-seq tables + seq_slots must match the expanded per-token path —
+    the SplitFuse configuration, where many ragged tokens share a sequence
+    and the per-token [T, max_pages] table would not fit SMEM."""
+    rng = np.random.default_rng(7)
+    S, toks_per_seq, hq, hkv, hd, block, max_pages = 3, 5, 4, 2, 64, 16, 4
+    n_pages = S * max_pages + 1
+    T = S * toks_per_seq
+    q = jnp.asarray(rng.standard_normal((T, hq, hd)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((n_pages, hkv, block, hd)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((n_pages, hkv, block, hd)), jnp.float32)
+    seq_tables = jnp.asarray(
+        rng.permutation(n_pages - 1)[: S * max_pages].reshape(S, max_pages),
+        jnp.int32)
+    seq_slots = jnp.repeat(jnp.arange(S, dtype=jnp.int32), toks_per_seq)
+    # consecutive positions per sequence, as a prefill chunk would carry
+    positions = jnp.concatenate([
+        jnp.arange(toks_per_seq, dtype=jnp.int32) + 7 * (s + 1)
+        for s in range(S)])
+    via_slots = paged_attention(q, kp, vp, seq_tables, positions,
+                                seq_slots=seq_slots, interpret=True)
+    expanded = paged_attention(q, kp, vp, seq_tables[seq_slots], positions,
+                               interpret=True)
+    np.testing.assert_allclose(np.asarray(via_slots), np.asarray(expanded),
+                               rtol=2e-5, atol=2e-5)
