@@ -68,7 +68,8 @@ def _child_main():
                       d_ff=2816, vocab_size=32000, max_seq_len=2048, remat=remat,
                       remat_policy=remat_env if remat else "full",
                       use_flash=use_flash, loss_chunk_size=ce_chunk)
-        batch_size, seq_len, steps, warmup = 8, 2048, 10, 2
+        batch_size = int(os.environ.get("DST_BENCH_BS", "8"))
+        seq_len, steps, warmup = 2048, 10, 2
     else:  # CPU smoke fallback
         model = Llama("tiny", n_layers=2, d_model=128, n_heads=4, n_kv_heads=4,
                       vocab_size=1024, max_seq_len=256, remat=False, use_flash=False)
@@ -114,6 +115,7 @@ def _child_main():
             "params": model.config.param_count(),
             "platform": jax.devices()[0].device_kind,
             "flash_attention": use_flash,
+            "batch_size": batch_size,
             "remat": remat_env,
             "ce_chunk": ce_chunk if on_tpu else 0,
             "step_ms": round(dt / steps * 1e3, 1),

@@ -255,11 +255,7 @@ class RaggedInferenceEngine:
                     f"uid {seq.uid}: context {new_total} exceeds "
                     f"max_context {cfg.max_context}")
             needs.append(-(-new_total // cfg.kv_block_size) - len(seq.blocks))
-        if sum(n for n in needs if n > 0) > self.allocator.free_blocks:
-            raise RuntimeError(
-                f"KV pool exhausted: need {sum(n for n in needs if n > 0)} "
-                f"blocks, have {self.allocator.free_blocks}; flush() finished "
-                "sequences first")
+        self._check_pool(needs)
 
         # ---- build the flat step batch (reference: C++ fast_host_buffer).
         # T rounds the scheduled token count up to a bucket, not the full
@@ -308,6 +304,17 @@ class RaggedInferenceEngine:
                 out[i] = logits[seq.slot]
         return out
 
+    def _check_pool(self, needs) -> None:
+        """Admission check shared by put()/decode_steps(): the whole
+        schedule's new-block demand must fit the pool before ANY uid is
+        granted blocks (two-phase validate-then-allocate)."""
+        short = sum(n for n in needs if n > 0)
+        if short > self.allocator.free_blocks:
+            raise RuntimeError(
+                f"KV pool exhausted: need {short} blocks, have "
+                f"{self.allocator.free_blocks}; flush() finished "
+                "sequences first")
+
     def _host_tables(self) -> np.ndarray:
         tables = np.zeros((self.config.max_seqs, self.max_pages), np.int32)
         for seq in self.seqs.values():
@@ -337,6 +344,10 @@ class RaggedInferenceEngine:
         cfg = self.config
         if k < 1:
             raise ValueError(f"decode_steps needs k >= 1, got {k}")
+        # validate every uid before allocating anything (same two-phase
+        # discipline as put()): a rejected uid must not leave earlier uids
+        # holding blocks with no KV written
+        needs = []
         for uid in first_tokens:
             seq = self.seqs[uid]
             if seq.pending:
@@ -346,9 +357,11 @@ class RaggedInferenceEngine:
                 raise ValueError(
                     f"uid {uid}: decode chunk to {total} exceeds "
                     f"max_context {cfg.max_context}")
-            need = -(-total // cfg.kv_block_size) - len(seq.blocks)
+            needs.append(-(-total // cfg.kv_block_size) - len(seq.blocks))
+        self._check_pool(needs)
+        for uid, need in zip(first_tokens, needs):
             if need > 0:
-                seq.blocks.extend(self.allocator.allocate(need))
+                self.seqs[uid].blocks.extend(self.allocator.allocate(need))
 
         S = cfg.max_seqs
         toks = np.zeros((S,), np.int32)

@@ -214,3 +214,48 @@ def test_ragged_serves_relu_activation():
                                config={"dtype": "fp32", "temperature": 0.0})
     ref = dense.generate(np.asarray([prompts[1]], np.int32), max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(out[1]), ref[0, 8:])
+
+
+def test_chunked_decode_matches_single_step():
+    """generate() with a multi-token on-device decode chunk must produce
+    exactly the tokens of the one-token-at-a-time path (same model, same
+    prompts), including across page-boundary crossings mid-chunk."""
+    rng = np.random.default_rng(11)
+    prompts = {i: rng.integers(1, 128, (11 + 5 * i,)).tolist() for i in range(3)}
+    outs = []
+    for chunk in (1, 7):
+        eng = RaggedInferenceEngine(_llama(), _cfg(),
+                                    rng=jax.random.PRNGKey(3))
+        outs.append(eng.generate({k: list(v) for k, v in prompts.items()},
+                                 max_new_tokens=20, decode_chunk=chunk))
+    for u in prompts:
+        assert outs[0][u] == outs[1][u], (u, outs[0][u], outs[1][u])
+        assert len(outs[0][u]) == 20
+
+
+def test_chunked_decode_eos_and_k_guard():
+    """EOS inside a decode chunk stops that sequence; decode_steps rejects
+    k < 1 and context overflow before touching any allocator state."""
+    rng = np.random.default_rng(12)
+    prompt = rng.integers(1, 128, (9,)).tolist()
+    eng = RaggedInferenceEngine(_llama(), _cfg(), rng=jax.random.PRNGKey(3))
+    ref = eng.generate({0: list(prompt)}, max_new_tokens=12, decode_chunk=1)
+    eos = ref[0][3]
+    eng2 = RaggedInferenceEngine(_llama(), _cfg(), rng=jax.random.PRNGKey(3))
+    out = eng2.generate({0: list(prompt)}, max_new_tokens=12,
+                        eos_token_id=eos, decode_chunk=5)
+    assert out[0] == ref[0][:4], (out[0], ref[0])
+
+    eng3 = RaggedInferenceEngine(_llama(), _cfg(), rng=jax.random.PRNGKey(3))
+    eng3.put([7, 8], [prompt, prompt[:5]])
+    free_before = eng3.allocator.free_blocks
+    blocks_before = {u: list(eng3.seqs[u].blocks) for u in (7, 8)}
+    with pytest.raises(ValueError, match="k >= 1"):
+        eng3.decode_steps({7: 5}, 0)
+    # multi-uid: uid 8 (5 seen) fits and is validated first; uid 7 (9 seen)
+    # overflows — the whole call must reject before uid 8 is granted blocks
+    ctx = eng3.config.max_context
+    with pytest.raises(ValueError, match="max_context"):
+        eng3.decode_steps({8: 5, 7: 5}, ctx - len(prompt) + 1)
+    assert eng3.allocator.free_blocks == free_before
+    assert {u: list(eng3.seqs[u].blocks) for u in (7, 8)} == blocks_before
