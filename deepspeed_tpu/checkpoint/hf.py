@@ -242,10 +242,51 @@ def hf_config(model_dir: str):
             tie_embeddings=hc.get("tie_word_embeddings", True),
             use_bias=bool(hc.get("bias", False)),
             norm_eps=hc.get("layer_norm_epsilon", 1e-5))
+    elif family == "bert":
+        if hc.get("position_embedding_type", "absolute") != "absolute":
+            raise NotImplementedError(
+                f"bert position_embedding_type="
+                f"'{hc['position_embedding_type']}' not supported "
+                "(absolute only — relative-key biases would be dropped)")
+        act = hc.get("hidden_act", "gelu")
+        act_map = {"gelu": "gelu_exact",  # HF BERT "gelu" is the erf GELU
+                   "gelu_new": "gelu", "gelu_pytorch_tanh": "gelu",
+                   "relu": "relu"}
+        if act not in act_map:
+            raise NotImplementedError(f"bert hidden_act '{act}' not supported")
+        cfg = TransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
+            n_layers=hc["num_hidden_layers"],
+            n_heads=hc["num_attention_heads"],
+            d_ff=hc.get("intermediate_size", 4 * hc["hidden_size"]),
+            max_seq_len=hc.get("max_position_embeddings", 512),
+            norm="layer", activation=act_map[act], position="learned",
+            causal=False, prenorm=False, embed_norm=True,
+            type_vocab_size=hc.get("type_vocab_size", 2),
+            mlm_head=True, pooler=False,  # from_pretrained reconciles to ckpt
+            tie_embeddings=True, use_bias=True,
+            norm_eps=hc.get("layer_norm_eps", 1e-12))
+    elif family == "distilbert":
+        if hc.get("sinusoidal_pos_embds", False):
+            raise NotImplementedError(
+                "distilbert sinusoidal_pos_embds=true not supported")
+        act = hc.get("activation", "gelu")
+        act_map = {"gelu": "gelu_exact", "relu": "relu"}
+        if act not in act_map:
+            raise NotImplementedError(
+                f"distilbert activation '{act}' not supported")
+        cfg = TransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["dim"],
+            n_layers=hc["n_layers"], n_heads=hc["n_heads"],
+            d_ff=hc.get("hidden_dim", 4 * hc["dim"]),
+            max_seq_len=hc.get("max_position_embeddings", 512),
+            norm="layer", activation=act_map[act], position="learned",
+            causal=False, prenorm=False, embed_norm=True,
+            mlm_head=True, tie_embeddings=True, use_bias=True, norm_eps=1e-12)
     else:
         raise ValueError(f"unsupported HF model_type '{family}' "
                          f"(supported: llama, mistral, gpt2, opt, bloom, "
-                         f"gptj, gpt_neox, falcon, mixtral)")
+                         f"gptj, gpt_neox, falcon, mixtral, bert, distilbert)")
     return family, cfg
 
 
@@ -561,11 +602,98 @@ def _map_falcon(state, c) -> Dict[str, Any]:
     return params
 
 
+def _map_bert(state, c) -> Dict[str, Any]:
+    n = c.n_layers
+    pre = "bert." if "bert.embeddings.word_embeddings.weight" in state else ""
+    L = pre + "encoder.layer.{}."
+    layers = {
+        # post-LN mapping: attention.output.LayerNorm runs AFTER the attn
+        # residual -> attn_norm; output.LayerNorm after the FFN -> mlp_norm
+        "wq": _stack(state, L + "attention.self.query.weight", n, transpose=True),
+        "bq": _stack(state, L + "attention.self.query.bias", n),
+        "wk": _stack(state, L + "attention.self.key.weight", n, transpose=True),
+        "bk": _stack(state, L + "attention.self.key.bias", n),
+        "wv": _stack(state, L + "attention.self.value.weight", n, transpose=True),
+        "bv": _stack(state, L + "attention.self.value.bias", n),
+        "wo": _stack(state, L + "attention.output.dense.weight", n, transpose=True),
+        "bo": _stack(state, L + "attention.output.dense.bias", n),
+        "attn_norm_w": _stack(state, L + "attention.output.LayerNorm.weight", n),
+        "attn_norm_b": _stack(state, L + "attention.output.LayerNorm.bias", n),
+        "w_up": _stack(state, L + "intermediate.dense.weight", n, transpose=True),
+        "b_up": _stack(state, L + "intermediate.dense.bias", n),
+        "w_down": _stack(state, L + "output.dense.weight", n, transpose=True),
+        "b_down": _stack(state, L + "output.dense.bias", n),
+        "mlp_norm_w": _stack(state, L + "output.LayerNorm.weight", n),
+        "mlp_norm_b": _stack(state, L + "output.LayerNorm.bias", n),
+    }
+    params = {
+        "tok_embed": state[pre + "embeddings.word_embeddings.weight"],
+        "pos_embed": state[pre + "embeddings.position_embeddings.weight"],
+        "type_embed": state[pre + "embeddings.token_type_embeddings.weight"],
+        "embed_norm_w": state[pre + "embeddings.LayerNorm.weight"],
+        "embed_norm_b": state[pre + "embeddings.LayerNorm.bias"],
+        "layers": layers,
+    }
+    # head surface varies by checkpoint class (BertModel carries neither,
+    # BertForMaskedLM the MLM head, BertForPreTraining both) — map whatever
+    # the weights provide; from_pretrained reconciles the config flags to
+    # the mapped tree BEFORE constructing the model (no cfg mutation here)
+    if "cls.predictions.transform.dense.weight" in state:
+        params["mlm_dense_w"] = state["cls.predictions.transform.dense.weight"].T
+        params["mlm_dense_b"] = state["cls.predictions.transform.dense.bias"]
+        params["mlm_norm_w"] = state["cls.predictions.transform.LayerNorm.weight"]
+        params["mlm_norm_b"] = state["cls.predictions.transform.LayerNorm.bias"]
+        params["mlm_bias"] = state["cls.predictions.bias"]
+    if pre + "pooler.dense.weight" in state:
+        params["pooler_w"] = state[pre + "pooler.dense.weight"].T
+        params["pooler_b"] = state[pre + "pooler.dense.bias"]
+    return params
+
+
+def _map_distilbert(state, c) -> Dict[str, Any]:
+    n = c.n_layers
+    pre = "distilbert." if "distilbert.embeddings.word_embeddings.weight" in state else ""
+    L = pre + "transformer.layer.{}."
+    layers = {
+        "wq": _stack(state, L + "attention.q_lin.weight", n, transpose=True),
+        "bq": _stack(state, L + "attention.q_lin.bias", n),
+        "wk": _stack(state, L + "attention.k_lin.weight", n, transpose=True),
+        "bk": _stack(state, L + "attention.k_lin.bias", n),
+        "wv": _stack(state, L + "attention.v_lin.weight", n, transpose=True),
+        "bv": _stack(state, L + "attention.v_lin.bias", n),
+        "wo": _stack(state, L + "attention.out_lin.weight", n, transpose=True),
+        "bo": _stack(state, L + "attention.out_lin.bias", n),
+        "attn_norm_w": _stack(state, L + "sa_layer_norm.weight", n),
+        "attn_norm_b": _stack(state, L + "sa_layer_norm.bias", n),
+        "w_up": _stack(state, L + "ffn.lin1.weight", n, transpose=True),
+        "b_up": _stack(state, L + "ffn.lin1.bias", n),
+        "w_down": _stack(state, L + "ffn.lin2.weight", n, transpose=True),
+        "b_down": _stack(state, L + "ffn.lin2.bias", n),
+        "mlp_norm_w": _stack(state, L + "output_layer_norm.weight", n),
+        "mlp_norm_b": _stack(state, L + "output_layer_norm.bias", n),
+    }
+    params = {
+        "tok_embed": state[pre + "embeddings.word_embeddings.weight"],
+        "pos_embed": state[pre + "embeddings.position_embeddings.weight"],
+        "embed_norm_w": state[pre + "embeddings.LayerNorm.weight"],
+        "embed_norm_b": state[pre + "embeddings.LayerNorm.bias"],
+        "layers": layers,
+    }
+    if "vocab_transform.weight" in state:
+        params["mlm_dense_w"] = state["vocab_transform.weight"].T
+        params["mlm_dense_b"] = state["vocab_transform.bias"]
+        params["mlm_norm_w"] = state["vocab_layer_norm.weight"]
+        params["mlm_norm_b"] = state["vocab_layer_norm.bias"]
+        params["mlm_bias"] = state["vocab_projector.bias"]
+    return params
+
+
 _MAPPERS: Dict[str, Callable] = {
     "llama": _map_llama, "mistral": _map_llama,
     "gpt2": _map_gpt2, "opt": _map_opt,
     "bloom": _map_bloom, "gptj": _map_gptj, "gpt_neox": _map_gpt_neox,
     "falcon": _map_falcon, "mixtral": _map_mixtral,
+    "bert": _map_bert, "distilbert": _map_distilbert,
 }
 
 
@@ -603,6 +731,12 @@ def from_pretrained(model_dir: str, dtype=None, topology=None,
     state = read_hf_state(model_dir)
     host_params = map_hf_params(state, family, cfg)
     del state  # mappers pop what they stack; drop the embeds' extra refs too
+    if family in ("bert", "distilbert"):
+        # the head surface follows the checkpoint class (BertModel vs
+        # ForMaskedLM vs ForPreTraining); align the config to the mapped
+        # tree before the model is constructed
+        cfg.mlm_head = "mlm_dense_w" in host_params
+        cfg.pooler = "pooler_w" in host_params
     if family == "mixtral":
         from ..models.moe import MoETransformer
 

@@ -1,0 +1,63 @@
+"""BERT / DistilBERT encoder family configs.
+
+Parity target: reference containers for the encoder models
+(``module_inject/containers/bert.py``, ``distil_bert.py``; policy classes
+``module_inject/replace_policy.py``) and the BERT-era fused training layer
+(``csrc/transformer/ds_transformer_cuda.cpp`` ``BertTransformerLayer``) —
+here the same shared Transformer core serves them with post-LN
+(``prenorm=False``) bidirectional (``causal=False``) blocks, so the flash /
+XLA attention path and all parallelism specs carry over unchanged.
+
+BERT specifics on the core: learned positions + segment (token-type)
+embeddings normalized together (``embed_norm``), exact-erf GELU, MLM head
+(dense + gelu + LN + tied decoder + vocab bias) and the [CLS] tanh pooler.
+DistilBERT drops token types and the pooler.
+"""
+
+from __future__ import annotations
+
+from .transformer import Transformer, TransformerConfig
+
+
+def bert_config(size: str = "base", **overrides) -> TransformerConfig:
+    presets = {
+        "tiny": dict(d_model=128, n_layers=2, n_heads=2),
+        "mini": dict(d_model=256, n_layers=4, n_heads=4),
+        "base": dict(d_model=768, n_layers=12, n_heads=12),
+        "large": dict(d_model=1024, n_layers=24, n_heads=16),
+    }
+    if size not in presets:
+        raise ValueError(f"unknown bert size '{size}'; have {sorted(presets)}")
+    kw = dict(presets[size])
+    kw.update(vocab_size=30522, max_seq_len=512, norm="layer",
+              activation="gelu_exact", position="learned",
+              causal=False, prenorm=False, embed_norm=True,
+              type_vocab_size=2, mlm_head=True, pooler=True,
+              tie_embeddings=True, use_bias=True, norm_eps=1e-12)
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def distilbert_config(size: str = "base", **overrides) -> TransformerConfig:
+    presets = {
+        "tiny": dict(d_model=128, n_layers=2, n_heads=2),
+        "base": dict(d_model=768, n_layers=6, n_heads=12),
+    }
+    if size not in presets:
+        raise ValueError(f"unknown distilbert size '{size}'; have {sorted(presets)}")
+    kw = dict(presets[size])
+    kw.update(vocab_size=30522, max_seq_len=512, norm="layer",
+              activation="gelu_exact", position="learned",
+              causal=False, prenorm=False, embed_norm=True,
+              type_vocab_size=0, mlm_head=True, pooler=False,
+              tie_embeddings=True, use_bias=True, norm_eps=1e-12)
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def Bert(size: str = "base", **overrides) -> Transformer:
+    return Transformer(bert_config(size, **overrides))
+
+
+def DistilBert(size: str = "base", **overrides) -> Transformer:
+    return Transformer(distilbert_config(size, **overrides))

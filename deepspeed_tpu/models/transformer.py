@@ -72,6 +72,13 @@ class TransformerConfig:
     rope_interleaved: bool = False    # GPT-J pairing instead of half-split
     parallel_residual: bool = False   # x + attn(ln1 x) + mlp(ln2 x)
     embed_norm: bool = False          # LayerNorm after token embed (Bloom)
+    # encoder-family knobs (BERT / DistilBERT; reference
+    # module_inject/containers/{bert,distil_bert}.py):
+    causal: bool = True               # False = bidirectional encoder
+    prenorm: bool = True              # False = post-LN (x = LN(x + sub(x)))
+    type_vocab_size: int = 0          # >0 adds segment (token-type) embeddings
+    mlm_head: bool = False            # BERT MLM head: dense+gelu+LN+decoder+bias
+    pooler: bool = False              # [CLS] dense+tanh pooler
 
     def __post_init__(self):
         if self.n_kv_heads is None:
@@ -99,13 +106,21 @@ class TransformerConfig:
         attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd + self.n_heads * hd * d
         if self.use_bias:
             attn += self.n_heads * hd + 2 * self.n_kv_heads * hd + d
-        norms = (2 * d) * n + d
+        norms = (2 * d) * n + (d if self.prenorm else 0)
         if self.norm == "layer":
             norms *= 2  # weights + biases
         emb = v * d * (1 if self.tie_embeddings else 2)
         if self.position == "learned":
             emb += self.max_seq_len * d
-        return n * attn + norms + emb
+        emb += self.type_vocab_size * d
+        if self.embed_norm:
+            emb += 2 * d
+        head = 0
+        if self.mlm_head:
+            head += d * d + d + 2 * d + v  # transform + LN + decoder bias
+        if self.pooler:
+            head += d * d + d
+        return n * attn + norms + emb + head
 
     def param_count(self) -> int:
         d, f, n = self.d_model, self.d_ff, self.n_layers
@@ -186,17 +201,29 @@ class Transformer:
         params: Dict[str, Any] = {
             "tok_embed": dense(next(k), (c.vocab_size, c.d_model), scale=0.02),
             "layers": layers,
-            "final_norm_w": jnp.ones((c.d_model,), dtype),
         }
-        if c.norm == "layer":
-            params["final_norm_b"] = jnp.zeros((c.d_model,), dtype)
+        if c.prenorm:  # post-LN blocks end in their own norm — no final norm
+            params["final_norm_w"] = jnp.ones((c.d_model,), dtype)
+            if c.norm == "layer":
+                params["final_norm_b"] = jnp.zeros((c.d_model,), dtype)
         if c.position == "learned":
             params["pos_embed"] = dense(next(k), (c.max_seq_len, c.d_model), scale=0.02)
+        if c.type_vocab_size > 0:
+            params["type_embed"] = dense(next(k), (c.type_vocab_size, c.d_model), scale=0.02)
         if c.embed_norm:
             params["embed_norm_w"] = jnp.ones((c.d_model,), dtype)
             params["embed_norm_b"] = jnp.zeros((c.d_model,), dtype)
         if not c.tie_embeddings:
             params["lm_head"] = dense(next(k), (c.d_model, c.vocab_size))
+        if c.mlm_head:
+            params["mlm_dense_w"] = dense(next(k), (c.d_model, c.d_model))
+            params["mlm_dense_b"] = jnp.zeros((c.d_model,), dtype)
+            params["mlm_norm_w"] = jnp.ones((c.d_model,), dtype)
+            params["mlm_norm_b"] = jnp.zeros((c.d_model,), dtype)
+            params["mlm_bias"] = jnp.zeros((c.vocab_size,), dtype)
+        if c.pooler:
+            params["pooler_w"] = dense(next(k), (c.d_model, c.d_model))
+            params["pooler_b"] = jnp.zeros((c.d_model,), dtype)
         return params
 
     # ------------------------------------------------------------------
@@ -220,13 +247,25 @@ class Transformer:
                       else dot_product_attention)
         return DistributedAttention(local_attn, self._mesh)(q, k, v, causal=True)
 
-    def _block(self, x, lp, angles, positions, kv_cache=None, rng=None, training=False):
-        """One transformer block. x: [b, s, d]. Returns (x, new_kv, aux)."""
+    def _block(self, x, lp, angles, positions, kv_cache=None, rng=None, training=False,
+               attn_mask=None):
+        """One transformer block. x: [b, s, d]. Returns (x, new_kv, aux).
+
+        ``attn_mask``: optional [b, s] padding mask (1 = attend) for the
+        bidirectional (causal=False) encoder path."""
         c = self.config
         hd = c.head_dim
         b, s, _ = x.shape
+        if attn_mask is not None and c.causal:
+            raise NotImplementedError(
+                "attn_mask with a causal model is not supported (padding "
+                "masks are an encoder feature; causal batches should pack "
+                "or left-trim instead)")
 
-        h = self._norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"))
+        # pre-LN normalizes the branch input; post-LN (BERT-era,
+        # prenorm=False) runs the branch on x and norms AFTER the residual
+        h = self._norm(x, lp["attn_norm_w"], lp.get("attn_norm_b")) \
+            if c.prenorm else x
         q = h @ lp["wq"]
         kk = h @ lp["wk"]
         vv = h @ lp["wv"]
@@ -269,15 +308,24 @@ class Transformer:
             if c.position == "alibi":
                 raise NotImplementedError(
                     "ALiBi + sequence-parallel attention not supported yet")
+            if not c.causal:
+                raise NotImplementedError(
+                    "bidirectional encoder + sequence-parallel attention "
+                    "not supported yet")
             attn = self._sp_attention(q, kk, vv)
         elif c.position == "alibi":
             # flash kernel carries no additive bias — use the jnp path
             attn = dot_product_attention(q, kk, vv, causal=True,
                                          bias=_alibi_bias(s))
+        elif not c.causal and attn_mask is not None:
+            # encoder with padding: keys at padded positions are masked for
+            # every query ([b, 1, 1, s] broadcast)
+            key_mask = attn_mask.astype(bool)[:, None, None, :]
+            attn = dot_product_attention(q, kk, vv, causal=False, mask=key_mask)
         elif c.use_flash:
-            attn = flash_attention(q, kk, vv, causal=True)
+            attn = flash_attention(q, kk, vv, causal=c.causal)
         else:
-            attn = dot_product_attention(q, kk, vv, causal=True)
+            attn = dot_product_attention(q, kk, vv, causal=c.causal)
 
         attn = attn.reshape(b, s, c.n_heads * hd) @ lp["wo"]
         if c.use_bias:
@@ -289,6 +337,11 @@ class Transformer:
             h2 = self._norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
             down, aux = self._mlp(h2, lp, rng, training)
             return x + attn + down, new_kv, aux
+
+        if not c.prenorm:  # post-LN: norm AFTER each residual add
+            x = self._norm(x + attn, lp["attn_norm_w"], lp.get("attn_norm_b"))
+            down, aux = self._mlp(x, lp, rng, training)
+            return self._norm(x + down, lp["mlp_norm_w"], lp.get("mlp_norm_b")), new_kv, aux
 
         x = x + attn
         h = self._norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
@@ -317,7 +370,7 @@ class Transformer:
 
     def apply(self, params, tokens, positions=None, kv_caches=None, cache_pos=None,
               rng=None, training=False, return_aux=False, last_token_only=False,
-              return_hidden=False):
+              return_hidden=False, token_type_ids=None, attn_mask=None):
         """Forward. tokens: [b, s] int32 -> logits [b, s, vocab] (fp32).
 
         ``kv_caches``: optional stacked (k,v) cache [n_layers, b, max_s, hkv, hd]
@@ -326,9 +379,14 @@ class Transformer:
         balancing) accumulated across layers.
         ``return_hidden``: return the pre-head hidden states [b, s, d]
         instead of logits (the chunked-CE loss runs the head itself).
+        ``token_type_ids``: [b, s] segment ids (encoder families; defaults
+        to zeros when the config has type embeddings).
+        ``attn_mask``: [b, s] padding mask for the bidirectional path.
         """
         c = self.config
-        x = self._embed(params, tokens, positions)  # [b, s, d]
+        if kv_caches is not None and not c.causal:
+            raise ValueError("KV-cache decode requires a causal model")
+        x = self._embed(params, tokens, positions, token_type_ids)  # [b, s, d]
         angles = rope_frequencies(c.rotary_dim, c.max_seq_len, c.rope_theta) \
             if c.position == "rope" else None
 
@@ -337,7 +395,8 @@ class Transformer:
             layer_rng = rng if rng is not None else jax.random.PRNGKey(0)
 
             def block(x, lp, r):
-                return self._block(x, lp, angles, positions, None, r, training)
+                return self._block(x, lp, angles, positions, None, r, training,
+                                   attn_mask)
 
             if c.remat:
                 from ..runtime.activation_checkpointing import checkpoint_wrapper
@@ -387,6 +446,14 @@ class Transformer:
             if mask is not None:
                 mask = mask.astype(jnp.float32)
             return tokens, batch["labels"], mask
+        if not self.config.causal:
+            # next-token shift is degenerate under bidirectional attention
+            # (position i sees token i+1 directly — loss collapses to a
+            # copy task); encoders must train on explicit labels (MLM)
+            raise ValueError(
+                "bidirectional (causal=False) models require explicit "
+                "'labels' (+ 'loss_mask') in the batch — next-token "
+                "prediction is not a valid encoder objective")
         # keep the full sequence length (it must stay divisible by the
         # seq mesh axis); predict shift-left targets and mask the final
         # position instead of slicing
@@ -417,16 +484,27 @@ class Transformer:
         return nll_sum, denom, z_sum
 
     def loss(self, params, batch, rng=None):
-        """Next-token cross entropy (+ z-loss + MoE aux)."""
+        """Next-token (or masked-LM, via explicit labels) cross entropy
+        (+ z-loss + MoE aux). Encoder batches may carry "attention_mask"
+        (padding) and "token_type_ids" (segments); both flow into the
+        forward."""
         inputs, targets, mask = self._targets_from_batch(batch)
+        # only encoder configs consume these; causal models ignore them the
+        # way HF-tokenizer batches expect (all-ones attention_mask is the
+        # decoder norm and must not trip the causal+mask guard)
+        fwd_kw = {}
+        if not self.config.causal and "attention_mask" in batch:
+            fwd_kw["attn_mask"] = batch["attention_mask"]
+        if self.config.type_vocab_size > 0 and "token_type_ids" in batch:
+            fwd_kw["token_type_ids"] = batch["token_type_ids"]
         cs = self.config.loss_chunk_size
         if cs > 0:
             x, aux = self.apply(params, inputs, rng=rng, training=True,
-                                return_aux=True, return_hidden=True)
+                                return_aux=True, return_hidden=True, **fwd_kw)
             nll_sum, denom, z_sum = self._ce_chunked(params, x, targets, mask, cs)
         else:
             logits, aux = self.apply(params, inputs, rng=rng, training=True,
-                                     return_aux=True)
+                                     return_aux=True, **fwd_kw)
             nll_sum, denom, z_sum = self._ce_terms(logits, targets, mask)
         loss = nll_sum / jnp.maximum(denom, 1.0)
         if self.config.z_loss > 0:
@@ -467,7 +545,7 @@ class Transformer:
 
     # ------------------------------------------------------------------
     # pipeline-parallel path (reference: runtime/pipe/engine.py train_batch)
-    def _embed(self, params, tokens, positions=None):
+    def _embed(self, params, tokens, positions=None, token_type_ids=None):
         """Token (+ learned position) embedding: [b, s] -> [b, s, d] in the
         compute dtype.
 
@@ -494,22 +572,51 @@ class Transformer:
             s = tokens.shape[-1]
             pos_emb = params["pos_embed"][:s] if positions is None else params["pos_embed"][positions]
             x = x + pos_emb.astype(compute_dtype)
+        if c.type_vocab_size > 0:
+            # segment embeddings (BERT); embed_norm below then normalizes
+            # the SUM of word+position+type, matching BertEmbeddings
+            tt = jnp.zeros_like(tokens) if token_type_ids is None else token_type_ids
+            x = x + params["type_embed"][tt].astype(compute_dtype)
         if c.embed_norm:
             x = layer_norm(x, params["embed_norm_w"], params["embed_norm_b"],
                            c.norm_eps)
         return x
 
     def _head(self, params, x):
-        """Final norm + LM head: [..., s, d] -> fp32 logits [..., s, vocab]."""
+        """Final norm + LM head: [..., s, d] -> fp32 logits [..., s, vocab].
+
+        Encoder MLM head (mlm_head): dense + gelu + LN transform before the
+        tied decoder, plus a vocab bias (BertLMPredictionHead)."""
         c = self.config
-        x = self._norm(x, params["final_norm_w"], params.get("final_norm_b"))
+        if c.prenorm:
+            x = self._norm(x, params["final_norm_w"], params.get("final_norm_b"))
+        if c.mlm_head:
+            x = x @ params["mlm_dense_w"].astype(x.dtype) + params["mlm_dense_b"].astype(x.dtype)
+            # HF BertPredictionHeadTransform reuses config.hidden_act —
+            # follow the model's FFN activation, not a hardcoded GELU
+            if c.activation == "relu":
+                x = jax.nn.relu(x)
+            else:
+                x = jax.nn.gelu(x, approximate=(c.activation != "gelu_exact"))
+            x = layer_norm(x, params["mlm_norm_w"], params["mlm_norm_b"], c.norm_eps)
         w_out = params["tok_embed"].T if c.tie_embeddings else params["lm_head"]
         logits = (x @ w_out.astype(x.dtype)).astype(jnp.float32)
+        if c.mlm_head:
+            logits = logits + params["mlm_bias"].astype(jnp.float32)
         if "lm_head_b" in params:  # GPT-J carries an LM-head bias
             logits = logits + params["lm_head_b"].astype(jnp.float32)
         if c.logits_softcap > 0:
             logits = jnp.tanh(logits / c.logits_softcap) * c.logits_softcap
         return logits
+
+    def pooled(self, params, hidden):
+        """BertPooler: tanh dense on the [CLS] (first) token of the final
+        hidden states ([b, s, d] from apply(..., return_hidden=True))."""
+        if not self.config.pooler:
+            raise ValueError("model config has pooler=False")
+        cls = hidden[:, 0]
+        return jnp.tanh(cls @ params["pooler_w"].astype(cls.dtype)
+                        + params["pooler_b"].astype(cls.dtype))
 
     def pipeline_loss(self, params, batch, rng, num_microbatches: int):
         """Pipelined training loss over the whole global batch.
@@ -530,6 +637,12 @@ class Transformer:
             raise NotImplementedError(
                 "pipe x seq parallel composition not supported yet; "
                 "use Ulysses/ring SP without the pipe axis")
+        if not self.config.causal and (
+                "attention_mask" in batch or "token_type_ids" in batch):
+            raise NotImplementedError(
+                "encoder attention_mask/token_type_ids not plumbed through "
+                "the pipeline path yet — drop the pipe axis for BERT-style "
+                "training")
         if rng is None:
             rng = jax.random.PRNGKey(0)
 
@@ -631,12 +744,15 @@ class Transformer:
         specs: Dict[str, Any] = {
             "tok_embed": P("model", None),
             "layers": layer_specs,
-            "final_norm_w": P(None),
         }
-        if c.norm == "layer":
-            specs["final_norm_b"] = P(None)
+        if c.prenorm:
+            specs["final_norm_w"] = P(None)
+            if c.norm == "layer":
+                specs["final_norm_b"] = P(None)
         if c.position == "learned":
             specs["pos_embed"] = P(None, None)
+        if c.type_vocab_size > 0:
+            specs["type_embed"] = P(None, None)
         if c.embed_norm:
             specs["embed_norm_w"] = P(None)
             specs["embed_norm_b"] = P(None)
@@ -644,4 +760,13 @@ class Transformer:
             specs["lm_head"] = P(None, "model")
             if isinstance(params, dict) and "lm_head_b" in params:
                 specs["lm_head_b"] = P("model")  # GPT-J ingests carry one
+        if c.mlm_head:
+            # transform stays replicated (its output feeds a LayerNorm over
+            # full d); the vocab bias follows the vocab-sharded embedding
+            specs.update({"mlm_dense_w": P(None, None), "mlm_dense_b": P(None),
+                          "mlm_norm_w": P(None), "mlm_norm_b": P(None),
+                          "mlm_bias": P("model")})
+        if c.pooler:
+            specs["pooler_w"] = P(None, None)
+            specs["pooler_b"] = P(None)
         return specs

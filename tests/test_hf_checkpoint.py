@@ -71,6 +71,17 @@ def _save_tiny(tmp_path, family: str, safe: bool):
             activation_function="relu", do_layer_norm_before=True,
             word_embed_proj_dim=64)
         m = transformers.OPTForCausalLM(hf_cfg)
+    elif family == "bert":
+        hf_cfg = transformers.BertConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=256,
+            max_position_embeddings=128, type_vocab_size=2)
+        m = transformers.BertForMaskedLM(hf_cfg)
+    elif family == "distilbert":
+        hf_cfg = transformers.DistilBertConfig(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, hidden_dim=256,
+            max_position_embeddings=128)
+        m = transformers.DistilBertForMaskedLM(hf_cfg)
     else:
         raise AssertionError(family)
     m = m.eval()
@@ -84,7 +95,9 @@ def _save_tiny(tmp_path, family: str, safe: bool):
                                          ("bloom", True), ("gptj", True),
                                          ("gpt_neox", True),
                                          ("falcon", True),
-                                         ("mixtral", True)])
+                                         ("mixtral", True),
+                                         ("bert", True),
+                                         ("distilbert", True)])
 def test_hf_logits_parity(tmp_path, family, safe):
     """Native forward on ingested weights == torch forward (fp32)."""
     hf_model, d = _save_tiny(tmp_path, family, safe)
