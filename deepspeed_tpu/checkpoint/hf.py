@@ -283,10 +283,42 @@ def hf_config(model_dir: str):
             norm="layer", activation=act_map[act], position="learned",
             causal=False, prenorm=False, embed_norm=True,
             mlm_head=True, tie_embeddings=True, use_bias=True, norm_eps=1e-12)
+    elif family == "clip":
+        from ..models.clip import CLIPConfig
+
+        act_map = {"quick_gelu": "quick_gelu", "gelu": "gelu_exact"}
+
+        def tower(tc, **kw):
+            act = tc.get("hidden_act", "quick_gelu")
+            if act not in act_map:
+                raise NotImplementedError(f"clip hidden_act '{act}' not supported")
+            return TransformerConfig(
+                d_model=tc["hidden_size"], n_layers=tc["num_hidden_layers"],
+                n_heads=tc["num_attention_heads"],
+                d_ff=tc["intermediate_size"], norm="layer",
+                activation=act_map[act], tie_embeddings=True, use_bias=True,
+                norm_eps=tc.get("layer_norm_eps", 1e-5), **kw)
+
+        tc, vc = hc["text_config"], hc["vision_config"]
+        eos = tc.get("eos_token_id", 2)
+        cfg = CLIPConfig(
+            text=tower(tc, vocab_size=tc["vocab_size"],
+                       max_seq_len=tc.get("max_position_embeddings", 77),
+                       position="learned", causal=True),
+            vision=tower(vc, vocab_size=1, max_seq_len=1, position="none",
+                         causal=False, embed_norm=True),
+            proj_dim=hc.get("projection_dim", 512),
+            image_size=vc.get("image_size", 224),
+            patch_size=vc.get("patch_size", 32),
+            n_channels=vc.get("num_channels", 3),
+            # HF CLIPTextTransformer: eos_token_id==2 is the legacy config
+            # whose pooling is plain argmax (EOS = highest id)
+            eos_token_id=None if eos == 2 else eos)
     else:
         raise ValueError(f"unsupported HF model_type '{family}' "
                          f"(supported: llama, mistral, gpt2, opt, bloom, "
-                         f"gptj, gpt_neox, falcon, mixtral, bert, distilbert)")
+                         f"gptj, gpt_neox, falcon, mixtral, bert, distilbert, "
+                         f"clip)")
     return family, cfg
 
 
@@ -688,12 +720,68 @@ def _map_distilbert(state, c) -> Dict[str, Any]:
     return params
 
 
+def _clip_tower_layers(state, prefix: str, n: int) -> Dict[str, Any]:
+    """Shared pre-LN CLIP encoder layer stack (text and vision towers use
+    identical per-layer key names under different prefixes)."""
+    L = prefix + "encoder.layers.{}."
+    return {
+        "attn_norm_w": _stack(state, L + "layer_norm1.weight", n),
+        "attn_norm_b": _stack(state, L + "layer_norm1.bias", n),
+        "wq": _stack(state, L + "self_attn.q_proj.weight", n, transpose=True),
+        "bq": _stack(state, L + "self_attn.q_proj.bias", n),
+        "wk": _stack(state, L + "self_attn.k_proj.weight", n, transpose=True),
+        "bk": _stack(state, L + "self_attn.k_proj.bias", n),
+        "wv": _stack(state, L + "self_attn.v_proj.weight", n, transpose=True),
+        "bv": _stack(state, L + "self_attn.v_proj.bias", n),
+        "wo": _stack(state, L + "self_attn.out_proj.weight", n, transpose=True),
+        "bo": _stack(state, L + "self_attn.out_proj.bias", n),
+        "mlp_norm_w": _stack(state, L + "layer_norm2.weight", n),
+        "mlp_norm_b": _stack(state, L + "layer_norm2.bias", n),
+        "w_up": _stack(state, L + "mlp.fc1.weight", n, transpose=True),
+        "b_up": _stack(state, L + "mlp.fc1.bias", n),
+        "w_down": _stack(state, L + "mlp.fc2.weight", n, transpose=True),
+        "b_down": _stack(state, L + "mlp.fc2.bias", n),
+    }
+
+
+def _map_clip(state, c) -> Dict[str, Any]:
+    text = {
+        "tok_embed": state["text_model.embeddings.token_embedding.weight"],
+        "pos_embed": state["text_model.embeddings.position_embedding.weight"],
+        "layers": _clip_tower_layers(state, "text_model.", c.text.n_layers),
+        "final_norm_w": state["text_model.final_layer_norm.weight"],
+        "final_norm_b": state["text_model.final_layer_norm.bias"],
+    }
+    pw = state["vision_model.embeddings.patch_embedding.weight"]  # [d,3,p,p]
+    d = pw.shape[0]
+    vision = {
+        # the 1-row token table is an unused core artifact on the pixel path
+        "tok_embed": np.zeros((1, d), pw.dtype),
+        "patch_w": pw.reshape(d, -1).T,  # (c, ph, pw)-ordered patch vectors
+        "cls_embed": state["vision_model.embeddings.class_embedding"],
+        "pos_embed": state["vision_model.embeddings.position_embedding.weight"],
+        "embed_norm_w": state["vision_model.pre_layrnorm.weight"],
+        "embed_norm_b": state["vision_model.pre_layrnorm.bias"],
+        "layers": _clip_tower_layers(state, "vision_model.", c.vision.n_layers),
+        "final_norm_w": state["vision_model.post_layernorm.weight"],
+        "final_norm_b": state["vision_model.post_layernorm.bias"],
+    }
+    return {
+        "text": text,
+        "vision": vision,
+        "text_proj": state["text_projection.weight"].T,
+        "vision_proj": state["visual_projection.weight"].T,
+        "logit_scale": state["logit_scale"],
+    }
+
+
 _MAPPERS: Dict[str, Callable] = {
     "llama": _map_llama, "mistral": _map_llama,
     "gpt2": _map_gpt2, "opt": _map_opt,
     "bloom": _map_bloom, "gptj": _map_gptj, "gpt_neox": _map_gpt_neox,
     "falcon": _map_falcon, "mixtral": _map_mixtral,
     "bert": _map_bert, "distilbert": _map_distilbert,
+    "clip": _map_clip,
 }
 
 
@@ -741,6 +829,10 @@ def from_pretrained(model_dir: str, dtype=None, topology=None,
         from ..models.moe import MoETransformer
 
         model = MoETransformer(cfg)
+    elif family == "clip":
+        from ..models.clip import CLIP
+
+        model = CLIP(cfg)
     else:
         model = Transformer(cfg)
     # cast on host (ml_dtypes covers bf16 numpy) so each leaf ships to the

@@ -1,0 +1,207 @@
+"""CLIP family: contrastive text + vision towers.
+
+Parity target: reference ``module_inject/containers/clip.py`` (CLIP layer
+policy) and the stable-diffusion serving path's text encoder
+(``model_implementations/``). Both towers run on the shared Transformer
+core:
+
+* **text tower** — causal pre-LN encoder with learned positions and
+  quick-GELU; features are the final-LN hidden state at the EOS position,
+  projected without bias (HF ``CLIPTextTransformer`` semantics).
+* **vision tower** — a ViT on the same block stack: non-overlapping patch
+  embedding expressed as a reshape + one MXU matmul (equivalent to the
+  stride-p conv), a learned class token, ``embed_norm`` standing in for
+  HF's ``pre_layrnorm`` and ``final_norm`` for ``post_layernorm``.
+
+The contrastive head L2-normalizes both embeddings and scales by
+``exp(logit_scale)`` (CLIPModel.forward).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.norms import layer_norm
+from .transformer import Transformer, TransformerConfig
+
+
+def clip_text_config(vocab_size=49408, d_model=512, n_layers=12, n_heads=8,
+                     d_ff=2048, max_seq_len=77, **overrides) -> TransformerConfig:
+    kw = dict(vocab_size=vocab_size, d_model=d_model, n_layers=n_layers,
+              n_heads=n_heads, d_ff=d_ff, max_seq_len=max_seq_len,
+              norm="layer", activation="quick_gelu", position="learned",
+              causal=True, tie_embeddings=True, use_bias=True, norm_eps=1e-5)
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def clip_vision_config(d_model=768, n_layers=12, n_heads=12, d_ff=3072,
+                       **overrides) -> TransformerConfig:
+    kw = dict(vocab_size=1,  # token table unused by the pixel path
+              d_model=d_model, n_layers=n_layers, n_heads=n_heads, d_ff=d_ff,
+              max_seq_len=1, norm="layer", activation="quick_gelu",
+              position="none",  # learned positions are added in apply_pixels
+              causal=False, embed_norm=True, tie_embeddings=True,
+              use_bias=True, norm_eps=1e-5)
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+class CLIPVision(Transformer):
+    """ViT tower: pixels [b, 3, H, W] -> (hidden [b, 1+n, d], pooled [b, d])."""
+
+    def __init__(self, config: TransformerConfig, image_size: int = 224,
+                 patch_size: int = 32, n_channels: int = 3):
+        super().__init__(config)
+        assert image_size % patch_size == 0
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.n_channels = n_channels
+        self.n_patches = (image_size // patch_size) ** 2
+
+    def init(self, rng, dtype=jnp.float32) -> Dict[str, Any]:
+        c = self.config
+        params = super().init(rng, dtype)
+        k1, k2, k3 = jax.random.split(jax.random.fold_in(rng, 7), 3)
+        pdim = self.n_channels * self.patch_size ** 2
+        params["patch_w"] = (jax.random.normal(k1, (pdim, c.d_model), jnp.float32)
+                             / np.sqrt(pdim)).astype(dtype)
+        params["cls_embed"] = (jax.random.normal(k2, (c.d_model,), jnp.float32)
+                               * 0.02).astype(dtype)
+        params["pos_embed"] = (jax.random.normal(
+            k3, (self.n_patches + 1, c.d_model), jnp.float32) * 0.02).astype(dtype)
+        return params
+
+    def apply_pixels(self, params, pixels, rng=None, training=False):
+        """pixels: [b, 3, H, W] float. The stride-p conv is a reshape into
+        (c, ph, pw)-ordered patch vectors + one matmul — identical math,
+        MXU-shaped."""
+        c = self.config
+        p = self.patch_size
+        b, ch, H, W = pixels.shape
+        assert ch == self.n_channels and H == W == self.image_size, (
+            f"expected [b, {self.n_channels}, {self.image_size}, "
+            f"{self.image_size}], got {pixels.shape}")
+        hp = H // p
+        compute_dtype = params["layers"]["wq"].dtype
+        patches = pixels.reshape(b, ch, hp, p, hp, p) \
+                        .transpose(0, 2, 4, 1, 3, 5) \
+                        .reshape(b, hp * hp, ch * p * p).astype(compute_dtype)
+        x = patches @ params["patch_w"].astype(compute_dtype)
+        cls = jnp.broadcast_to(params["cls_embed"].astype(compute_dtype),
+                               (b, 1, c.d_model))
+        x = jnp.concatenate([cls, x], axis=1)
+        x = x + params["pos_embed"].astype(compute_dtype)
+        x = layer_norm(x, params["embed_norm_w"], params["embed_norm_b"],
+                       c.norm_eps)  # HF pre_layrnorm
+        h, _ = self._encode(params, x, rng=rng, training=training)
+        pooled = layer_norm(h[:, 0], params["final_norm_w"],
+                            params["final_norm_b"], c.norm_eps)  # post_layernorm
+        return h, pooled
+
+    def partition_specs(self, params, topo=None) -> Dict[str, Any]:
+        specs = super().partition_specs(params, topo)
+        specs["tok_embed"] = P(None, None)  # unused 1-row table: replicate
+        specs["patch_w"] = P(None, None)
+        specs["cls_embed"] = P(None)
+        specs["pos_embed"] = P(None, None)
+        return specs
+
+
+@dataclass
+class CLIPConfig:
+    text: TransformerConfig
+    vision: TransformerConfig
+    proj_dim: int = 512
+    image_size: int = 224
+    patch_size: int = 32
+    n_channels: int = 3
+    eos_token_id: Optional[int] = None  # None -> argmax pooling (pre-HF4.30)
+
+
+class CLIP:
+    """Two-tower contrastive model (reference CLIPModel surface)."""
+
+    def __init__(self, config: CLIPConfig):
+        self.config = config
+        self.text = Transformer(config.text)
+        self.vision = CLIPVision(config.vision, config.image_size,
+                                 config.patch_size, config.n_channels)
+
+    def bind_topology(self, topo) -> "CLIP":
+        self.text.bind_topology(topo)
+        self.vision.bind_topology(topo)
+        return self
+
+    def init(self, rng, dtype=jnp.float32) -> Dict[str, Any]:
+        kt, kv, kp1, kp2 = jax.random.split(rng, 4)
+        c = self.config
+        return {
+            "text": self.text.init(kt, dtype),
+            "vision": self.vision.init(kv, dtype),
+            "text_proj": (jax.random.normal(
+                kp1, (c.text.d_model, c.proj_dim), jnp.float32)
+                / np.sqrt(c.text.d_model)).astype(dtype),
+            "vision_proj": (jax.random.normal(
+                kp2, (c.vision.d_model, c.proj_dim), jnp.float32)
+                / np.sqrt(c.vision.d_model)).astype(dtype),
+            "logit_scale": jnp.asarray(np.log(1 / 0.07), dtype),
+        }
+
+    def encode_text(self, params, tokens):
+        """tokens [b, s] -> projected text embedding [b, proj]. Pools the
+        final-LN hidden state at the EOS position (eos_token_id match, or
+        argmax like original CLIP where EOS is the highest id)."""
+        tp = params["text"]
+        h = self.text.apply(tp, tokens, return_hidden=True)
+        h = layer_norm(h, tp["final_norm_w"], tp["final_norm_b"],
+                       self.config.text.norm_eps)
+        if self.config.eos_token_id is not None:
+            eos = jnp.argmax((tokens == self.config.eos_token_id)
+                             .astype(jnp.int32), axis=-1)
+        else:
+            eos = jnp.argmax(tokens, axis=-1)
+        pooled = h[jnp.arange(h.shape[0]), eos]
+        return pooled @ params["text_proj"].astype(pooled.dtype)
+
+    def encode_image(self, params, pixels):
+        """pixels [b, 3, H, W] -> projected image embedding [b, proj]."""
+        _, pooled = self.vision.apply_pixels(params["vision"], pixels)
+        return pooled @ params["vision_proj"].astype(pooled.dtype)
+
+    def similarity(self, params, tokens, pixels):
+        """Returns (logits_per_text [bt, bi], logits_per_image [bi, bt])."""
+        t = self.encode_text(params, tokens)
+        v = self.encode_image(params, pixels)
+        t = t / jnp.linalg.norm(t, axis=-1, keepdims=True)
+        v = v / jnp.linalg.norm(v, axis=-1, keepdims=True)
+        scale = jnp.exp(params["logit_scale"]).astype(t.dtype)
+        lpt = (t @ v.T) * scale
+        return lpt, lpt.T
+
+    def loss(self, params, batch, rng=None):
+        """Symmetric InfoNCE over in-batch pairs (CLIP training objective)."""
+        lpt, lpi = self.similarity(params, batch["input_ids"],
+                                   batch["pixel_values"])
+        n = lpt.shape[0]
+        labels = jnp.arange(n)
+        lt = -jnp.take_along_axis(jax.nn.log_softmax(lpt, -1),
+                                  labels[:, None], -1).mean()
+        li = -jnp.take_along_axis(jax.nn.log_softmax(lpi, -1),
+                                  labels[:, None], -1).mean()
+        return 0.5 * (lt + li)
+
+    def partition_specs(self, params, topo=None) -> Dict[str, Any]:
+        return {
+            "text": self.text.partition_specs(params.get("text"), topo),
+            "vision": self.vision.partition_specs(params.get("vision"), topo),
+            "text_proj": P(None, None),
+            "vision_proj": P(None, None),
+            "logit_scale": P(),
+        }

@@ -361,12 +361,40 @@ class Transformer:
                 up = jax.nn.relu(up)
             elif c.activation == "gelu_exact":   # erf GELU (GPT-NeoX/Pythia)
                 up = jax.nn.gelu(up, approximate=False)
+            elif c.activation == "quick_gelu":   # x*sigmoid(1.702x) (CLIP)
+                up = up * jax.nn.sigmoid(1.702 * up)
             else:
                 up = jax.nn.gelu(up)             # tanh approx (GPT-2 family)
         down = up @ lp["w_down"]
         if c.use_bias:
             down = down + lp["b_down"]
         return down, jnp.zeros((), jnp.float32)
+
+    def _encode(self, params, x, angles=None, positions=None, rng=None,
+                training=False, attn_mask=None):
+        """Scan the block stack over already-embedded inputs x: [b, s, d].
+        Returns (hidden, summed aux loss). Shared by the token path
+        (:meth:`apply`) and non-token towers (vision patch embeddings)."""
+        c = self.config
+        layer_rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        def block(x, lp, r):
+            return self._block(x, lp, angles, positions, None, r, training,
+                               attn_mask)
+
+        if c.remat:
+            from ..runtime.activation_checkpointing import checkpoint_wrapper
+
+            block = checkpoint_wrapper(block, policy=c.remat_policy)
+
+        def scan_fn(carry, lp):
+            y, r = carry
+            r, sub = jax.random.split(r)
+            y, _, aux = block(y, lp, sub)
+            return (y, r), aux
+
+        (x, _), auxes = jax.lax.scan(scan_fn, (x, layer_rng), params["layers"])
+        return x, jnp.sum(auxes)
 
     def apply(self, params, tokens, positions=None, kv_caches=None, cache_pos=None,
               rng=None, training=False, return_aux=False, last_token_only=False,
@@ -392,25 +420,8 @@ class Transformer:
 
         aux_total = jnp.zeros((), jnp.float32)
         if kv_caches is None:
-            layer_rng = rng if rng is not None else jax.random.PRNGKey(0)
-
-            def block(x, lp, r):
-                return self._block(x, lp, angles, positions, None, r, training,
-                                   attn_mask)
-
-            if c.remat:
-                from ..runtime.activation_checkpointing import checkpoint_wrapper
-
-                block = checkpoint_wrapper(block, policy=c.remat_policy)
-
-            def scan_fn(carry, lp):
-                y, r = carry
-                r, sub = jax.random.split(r)
-                y, _, aux = block(y, lp, sub)
-                return (y, r), aux
-
-            (x, _), auxes = jax.lax.scan(scan_fn, (x, layer_rng), params["layers"])
-            aux_total = jnp.sum(auxes)
+            x, aux_total = self._encode(params, x, angles, positions, rng,
+                                        training, attn_mask)
             new_caches = None
         else:
             ks, vs = kv_caches

@@ -1,0 +1,118 @@
+"""CLIP two-tower family: text/image feature parity against the torch
+CLIPModel, the reshape-as-conv patch embedding, and the contrastive loss.
+
+Parity surface: reference module_inject/containers/clip.py (CLIP layer
+policy used by the stable-diffusion serving path).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from deepspeed_tpu.checkpoint import from_pretrained  # noqa: E402
+from deepspeed_tpu.models import CLIP, CLIPConfig  # noqa: E402
+from deepspeed_tpu.models.clip import clip_text_config, clip_vision_config  # noqa: E402
+
+
+def _save_tiny_clip(tmp_path, legacy_eos=False):
+    torch.manual_seed(0)
+    # legacy_eos: eos_token_id == 2 is the pre-HF4.30 config family whose
+    # pooling is plain argmax (all original openai/clip-* checkpoints)
+    cfg = transformers.CLIPConfig(
+        text_config={"vocab_size": 99, "hidden_size": 64,
+                     "intermediate_size": 128, "num_hidden_layers": 2,
+                     "num_attention_heads": 4, "max_position_embeddings": 32,
+                     "bos_token_id": 97,
+                     "eos_token_id": 2 if legacy_eos else 98},
+        vision_config={"hidden_size": 64, "intermediate_size": 128,
+                       "num_hidden_layers": 2, "num_attention_heads": 4,
+                       "image_size": 32, "patch_size": 8},
+        projection_dim=48)
+    m = transformers.CLIPModel(cfg).eval()
+    d = tmp_path / "clip"
+    m.save_pretrained(str(d), safe_serialization=True)
+    return m, str(d)
+
+
+def _tokens():
+    # one EOS (highest id, 98) per row so argmax and eos-match pooling agree
+    rng = np.random.default_rng(0)
+    toks = rng.integers(1, 98, (2, 16)).astype(np.int32)
+    toks[0, 10] = 98
+    toks[1, 14] = 98
+    return toks
+
+
+@pytest.mark.parametrize("legacy_eos", [False, True])
+def test_clip_feature_parity(tmp_path, legacy_eos):
+    hf, d = _save_tiny_clip(tmp_path, legacy_eos)
+    model, params = from_pretrained(d, dtype=jnp.float32)
+    assert isinstance(model, CLIP)
+
+    toks = _tokens()
+    pixels = np.random.default_rng(1).normal(size=(2, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        ref_t = hf.get_text_features(torch.tensor(toks, dtype=torch.long)).numpy()
+        ref_v = hf.get_image_features(torch.tensor(pixels)).numpy()
+        ref_lpi = hf(input_ids=torch.tensor(toks, dtype=torch.long),
+                     pixel_values=torch.tensor(pixels)).logits_per_image.numpy()
+
+    got_t = np.asarray(model.encode_text(params, jnp.asarray(toks)))
+    got_v = np.asarray(model.encode_image(params, jnp.asarray(pixels)))
+    np.testing.assert_allclose(got_t, ref_t, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(got_v, ref_v, rtol=2e-3, atol=2e-3)
+
+    _, got_lpi = model.similarity(params, jnp.asarray(toks), jnp.asarray(pixels))
+    np.testing.assert_allclose(np.asarray(got_lpi), ref_lpi, rtol=2e-3, atol=2e-3)
+
+
+def test_clip_contrastive_loss_trains():
+    cfg = CLIPConfig(
+        text=clip_text_config(vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+                              d_ff=64, max_seq_len=16, use_flash=False,
+                              remat=False),
+        vision=clip_vision_config(d_model=32, n_layers=2, n_heads=2, d_ff=64,
+                                  use_flash=False, remat=False),
+        proj_dim=16, image_size=16, patch_size=8)
+    model = CLIP(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(2)
+    batch = {"input_ids": jnp.asarray(rng.integers(1, 64, (4, 16)), jnp.int32),
+             "pixel_values": jnp.asarray(rng.normal(size=(4, 3, 16, 16)),
+                                         jnp.float32)}
+
+    import optax
+    opt = optax.adam(1e-3)
+
+    @jax.jit
+    def step(p, s):
+        loss, g = jax.value_and_grad(model.loss)(p, batch)
+        u, s = opt.update(g, s)
+        return optax.apply_updates(p, u), s, loss
+
+    state = opt.init(params)
+    losses = []
+    for _ in range(8):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_clip_vision_rejects_wrong_shape():
+    cfg = CLIPConfig(
+        text=clip_text_config(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+                              d_ff=64, max_seq_len=16, use_flash=False,
+                              remat=False),
+        vision=clip_vision_config(d_model=32, n_layers=1, n_heads=2, d_ff=64,
+                                  use_flash=False, remat=False),
+        proj_dim=16, image_size=16, patch_size=8)
+    model = CLIP(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(AssertionError, match="expected"):
+        model.encode_image(params, jnp.zeros((1, 3, 24, 24), jnp.float32))
