@@ -36,6 +36,7 @@ import numpy as np
 from ..ops.norms import layer_norm, rms_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
 from ..utils.logging import log_dist
+from .engine import _sample
 
 
 def _use_pallas_paged(head_dim: int, block: int, dtype,
@@ -107,6 +108,10 @@ class RaggedConfig:
     n_kv_blocks: int = 256
     max_context: int = 2048
     dtype: Any = jnp.bfloat16
+    # sampling (parity: FastGen sampler / v1 engine _sample); 0.0 = greedy
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
 
 
 class RaggedInferenceEngine:
@@ -168,6 +173,15 @@ class RaggedInferenceEngine:
         self._step_fn = None
         self._core_fn = None
         self._decode_fn = None
+        # sampling streams: decode steps fold a GLOBAL step counter into the
+        # decode key, so sampled output is invariant to how decode_steps
+        # calls chunk the token budget; prefill first-tokens get their own
+        # stream (counter per put-round)
+        base = rng if rng is not None else jax.random.PRNGKey(0)
+        self._rng_prefill, self._rng_decode = jax.random.split(
+            jax.random.fold_in(base, 7919))
+        self._decode_step_counter = 0
+        self._prefill_round_counter = 0
         # ragged-step token buckets (ascending, capped by the budget): a
         # decode-heavy step compiles + runs at the smallest fitting width
         self._buckets = [b for b in (64, 256, 1024) if b < cfg.token_budget] \
@@ -332,8 +346,8 @@ class RaggedInferenceEngine:
         return min(b, self.max_pages)
 
     def decode_steps(self, first_tokens: Dict[int, int], k: int) -> Dict[int, List[int]]:
-        """Greedy-decode ``k`` tokens for every uid in ``first_tokens`` in
-        ONE device call (see _build_decode).
+        """Decode ``k`` tokens (greedy or sampled per config) for every uid
+        in ``first_tokens`` in ONE device call (see _build_decode).
 
         ``first_tokens[uid]`` is the next input token (produced by the
         previous step's logits, not yet admitted). Returns uid -> the k
@@ -375,10 +389,14 @@ class RaggedInferenceEngine:
 
         if self._decode_fn is None:
             self._decode_fn = self._build_decode()
+        steps_xs = np.arange(self._decode_step_counter,
+                             self._decode_step_counter + k, dtype=np.int32)
+        self._decode_step_counter += k
         gen, self.kv_pool = self._decode_fn(
             self.params, self.kv_pool, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(slots), jnp.asarray(self._host_tables()),
-            jnp.zeros((k,), jnp.int32), self._live_pages_bucket())
+            jnp.asarray(steps_xs), self._rng_decode,
+            self._live_pages_bucket())
         gen = np.asarray(gen)                                   # [S, k]
 
         out = {}
@@ -395,9 +413,11 @@ class RaggedInferenceEngine:
     def generate(self, prompts: Dict[int, Sequence[int]], max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None,
                  decode_chunk: int = 16) -> Dict[int, List[int]]:
-        """Greedy generation: SplitFuse put() steps until every prompt is
+        """Generation: SplitFuse put() steps until every prompt is
         prefilled, then ``decode_steps`` chunks of up to ``decode_chunk``
-        tokens per device call. Returns uid -> generated tokens."""
+        tokens per device call. Greedy when config.temperature == 0, else
+        temperature/top-k/top-p sampling (chunk-invariant streams).
+        Returns uid -> generated tokens."""
         done: Dict[int, List[int]] = {u: [] for u in prompts}
         uids = list(prompts)
         logits = self.put(uids, [list(p) for p in prompts.values()])
@@ -405,12 +425,26 @@ class RaggedInferenceEngine:
         # token as its row resolves (long prompts span multiple steps)
         first: Dict[int, int] = {}
         while True:
-            pending = []
+            pending, resolved = [], []
             for u, row in zip(uids, logits):
                 if np.isnan(row).any():
                     pending.append(u)
                 else:
-                    first[u] = int(np.argmax(row))
+                    resolved.append((u, row))
+            if resolved:
+                if self.config.temperature == 0.0:  # greedy: stay on host
+                    for u, row in resolved:
+                        first[u] = int(np.argmax(row))
+                else:
+                    rows = jnp.asarray(np.stack([r for _, r in resolved]))
+                    key = jax.random.fold_in(self._rng_prefill,
+                                             self._prefill_round_counter)
+                    self._prefill_round_counter += 1
+                    toks_out = np.asarray(_sample(
+                        rows, key, self.config.temperature,
+                        self.config.top_k, self.config.top_p))
+                    for (u, _), t in zip(resolved, toks_out):
+                        first[u] = int(t)
             if not pending:
                 break
             uids = pending
@@ -570,8 +604,9 @@ class RaggedInferenceEngine:
         return jax.jit(step, donate_argnums=(1,), static_argnums=(7,))
 
     def _build_decode(self):
-        """Multi-step greedy decode entirely on device: one token per live
-        slot per step, argmax fed straight into the next step, KV scattered
+        """Multi-step decode entirely on device: one token per live slot
+        per step (argmax, or temperature/top-k/top-p sampled), fed straight
+        into the next step, KV scattered
         into pre-allocated pages. The host round trip (the dominant cost of
         one-token-at-a-time serving through a remote runtime) amortizes over
         the whole chunk. Reference analog: FastGen schedules one engine call
@@ -580,18 +615,24 @@ class RaggedInferenceEngine:
         core = self._core
         model = self.model
 
+        cfg = self.config
+
         def decode(params, pools, tokens0, positions0, slots, block_tables,
-                   steps_xs, live_pages):
-            def one(carry, _):
+                   steps_xs, rng_key, live_pages):
+            # steps_xs: [k] GLOBAL decode-step ids — the per-step sample key
+            # is fold_in(rng_key, global_step), so token streams do not
+            # depend on the chunking of decode calls
+            def one(carry, step_i):
                 pools, toks, pos = carry
                 x, pools = core(params, pools, toks, slots, pos, block_tables,
                                 live_pages)
                 logits = model._head(params, x[None, :])[0]    # [S, vocab]
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt = _sample(logits, jax.random.fold_in(rng_key, step_i),
+                              cfg.temperature, cfg.top_k, cfg.top_p)
                 return (pools, nxt, pos + 1), nxt
 
             (pools, _, _), gen = jax.lax.scan(
                 one, (pools, tokens0, positions0), steps_xs)
             return gen.T, pools                                 # [S, k]
 
-        return jax.jit(decode, donate_argnums=(1,), static_argnums=(7,))
+        return jax.jit(decode, donate_argnums=(1,), static_argnums=(8,))
