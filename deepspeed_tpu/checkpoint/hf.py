@@ -242,6 +242,29 @@ def hf_config(model_dir: str):
             tie_embeddings=hc.get("tie_word_embeddings", True),
             use_bias=bool(hc.get("bias", False)),
             norm_eps=hc.get("layer_norm_epsilon", 1e-5))
+    elif family == "gpt_neo":
+        # attention_types: [[[pattern...], repeat], ...] expands to one
+        # entry per layer; "local" layers use window_size, "global" full
+        layer_types = []
+        for pattern, rep in hc["attention_types"]:
+            layer_types += list(pattern) * rep
+        if len(layer_types) != hc["num_layers"]:
+            raise ValueError(
+                f"gpt_neo attention_types expand to {len(layer_types)} "
+                f"layers, config has {hc['num_layers']}")
+        window = hc.get("window_size", 256)
+        cfg = TransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
+            n_layers=hc["num_layers"], n_heads=hc["num_heads"],
+            d_ff=hc.get("intermediate_size") or 4 * hc["hidden_size"],
+            max_seq_len=hc.get("max_position_embeddings", 2048),
+            norm="layer", activation="gelu", position="learned",
+            tie_embeddings=True, use_bias=True, qkv_bias=False,
+            attn_scale=1.0,  # GPT-Neo attention is unscaled
+            attn_windows=tuple(window if t == "local" else 0
+                               for t in layer_types),
+            use_flash=False,
+            norm_eps=hc.get("layer_norm_epsilon", 1e-5))
     elif family == "bert":
         if hc.get("position_embedding_type", "absolute") != "absolute":
             raise NotImplementedError(
@@ -317,8 +340,8 @@ def hf_config(model_dir: str):
     else:
         raise ValueError(f"unsupported HF model_type '{family}' "
                          f"(supported: llama, mistral, gpt2, opt, bloom, "
-                         f"gptj, gpt_neox, falcon, mixtral, bert, distilbert, "
-                         f"clip)")
+                         f"gptj, gpt_neo, gpt_neox, falcon, mixtral, bert, "
+                         f"distilbert, clip)")
     return family, cfg
 
 
@@ -634,6 +657,36 @@ def _map_falcon(state, c) -> Dict[str, Any]:
     return params
 
 
+def _map_gpt_neo(state, c) -> Dict[str, Any]:
+    n = c.n_layers
+    pre = "transformer." if "transformer.wte.weight" in state else ""
+    L = pre + "h.{}."
+    # GPT-Neo uses torch Linear ([out, in] -> transpose), unlike GPT-2's
+    # Conv1D; q/k/v carry no bias, out_proj does
+    layers = {
+        "attn_norm_w": _stack(state, L + "ln_1.weight", n),
+        "attn_norm_b": _stack(state, L + "ln_1.bias", n),
+        "wq": _stack(state, L + "attn.attention.q_proj.weight", n, transpose=True),
+        "wk": _stack(state, L + "attn.attention.k_proj.weight", n, transpose=True),
+        "wv": _stack(state, L + "attn.attention.v_proj.weight", n, transpose=True),
+        "wo": _stack(state, L + "attn.attention.out_proj.weight", n, transpose=True),
+        "bo": _stack(state, L + "attn.attention.out_proj.bias", n),
+        "mlp_norm_w": _stack(state, L + "ln_2.weight", n),
+        "mlp_norm_b": _stack(state, L + "ln_2.bias", n),
+        "w_up": _stack(state, L + "mlp.c_fc.weight", n, transpose=True),
+        "b_up": _stack(state, L + "mlp.c_fc.bias", n),
+        "w_down": _stack(state, L + "mlp.c_proj.weight", n, transpose=True),
+        "b_down": _stack(state, L + "mlp.c_proj.bias", n),
+    }
+    return {
+        "tok_embed": state[pre + "wte.weight"],
+        "pos_embed": state[pre + "wpe.weight"],
+        "layers": layers,
+        "final_norm_w": state[pre + "ln_f.weight"],
+        "final_norm_b": state[pre + "ln_f.bias"],
+    }
+
+
 def _map_bert(state, c) -> Dict[str, Any]:
     n = c.n_layers
     pre = "bert." if "bert.embeddings.word_embeddings.weight" in state else ""
@@ -779,6 +832,7 @@ _MAPPERS: Dict[str, Callable] = {
     "llama": _map_llama, "mistral": _map_llama,
     "gpt2": _map_gpt2, "opt": _map_opt,
     "bloom": _map_bloom, "gptj": _map_gptj, "gpt_neox": _map_gpt_neox,
+    "gpt_neo": _map_gpt_neo,
     "falcon": _map_falcon, "mixtral": _map_mixtral,
     "bert": _map_bert, "distilbert": _map_distilbert,
     "clip": _map_clip,

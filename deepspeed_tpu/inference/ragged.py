@@ -138,6 +138,12 @@ class RaggedInferenceEngine:
             raise NotImplementedError(
                 "RaggedInferenceEngine does not support ALiBi or parallel-"
                 "residual families yet; use InferenceEngine (dense KV cache)")
+        if getattr(c, "attn_windows", None) is not None \
+                or getattr(c, "attn_scale", None) is not None:
+            raise NotImplementedError(
+                "RaggedInferenceEngine does not support per-layer attention "
+                "windows / scale overrides (GPT-Neo) yet; use "
+                "InferenceEngine (dense KV cache)")
         if self.config.max_context % self.config.kv_block_size != 0:
             raise ValueError(
                 f"max_context {self.config.max_context} must be a multiple of "
@@ -521,7 +527,7 @@ class RaggedInferenceEngine:
                 q = (h @ lp["wq"]).reshape(-1, c.n_heads, c.head_dim)
                 kk = (h @ lp["wk"]).reshape(-1, c.n_kv_heads, c.head_dim)
                 vv = (h @ lp["wv"]).reshape(-1, c.n_kv_heads, c.head_dim)
-                if c.use_bias:
+                if c.qkv_bias:
                     q = q + lp["bq"].reshape(c.n_heads, c.head_dim)
                     kk = kk + lp["bk"].reshape(c.n_kv_heads, c.head_dim)
                     vv = vv + lp["bv"].reshape(c.n_kv_heads, c.head_dim)

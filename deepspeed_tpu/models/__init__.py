@@ -1,6 +1,6 @@
 from .transformer import Transformer, TransformerConfig  # noqa: F401
 from .llama import Llama, llama_config  # noqa: F401
-from .gpt2 import GPT2, OPT, gpt2_config, opt_config  # noqa: F401
+from .gpt2 import GPT2, OPT, GPTNeo, gpt2_config, opt_config, gpt_neo_config  # noqa: F401
 from .bert import Bert, DistilBert, bert_config, distilbert_config  # noqa: F401
 from .clip import CLIP, CLIPConfig, CLIPVision, clip_text_config, clip_vision_config  # noqa: F401
 from .moe import GPTMoE, MoETransformer, MoETransformerConfig, gpt_moe_config  # noqa: F401

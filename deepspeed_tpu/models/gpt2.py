@@ -45,8 +45,40 @@ def opt_config(size: str = "1.3b", **overrides) -> TransformerConfig:
     return TransformerConfig(**kw)
 
 
+def gpt_neo_config(size: str = "125m", **overrides) -> TransformerConfig:
+    """GPT-Neo: alternating global/local causal attention (window 256),
+    UNSCALED attention logits, qkv projections without bias.
+    Parity: reference module_inject/containers/gptneo.py."""
+    presets = {
+        "tiny": dict(vocab_size=50257, d_model=256, n_layers=4, n_heads=8,
+                     max_seq_len=512),
+        "125m": dict(vocab_size=50257, d_model=768, n_layers=12, n_heads=12,
+                     max_seq_len=2048),
+        "1.3b": dict(vocab_size=50257, d_model=2048, n_layers=24, n_heads=16,
+                     max_seq_len=2048),
+        "2.7b": dict(vocab_size=50257, d_model=2560, n_layers=32, n_heads=20,
+                     max_seq_len=2048),
+    }
+    if size not in presets:
+        raise ValueError(f"unknown gpt_neo size '{size}'; have {sorted(presets)}")
+    kw = dict(presets[size])
+    n = kw["n_layers"]
+    kw.update(norm="layer", activation="gelu", position="learned",
+              tie_embeddings=True, use_bias=True, qkv_bias=False,
+              attn_scale=1.0,
+              attn_windows=tuple(0 if i % 2 == 0 else 256 for i in range(n)),
+              use_flash=False,  # window masks need the jnp attention path
+              norm_eps=1e-5)
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
 def GPT2(size: str = "small", **overrides) -> Transformer:
     return Transformer(gpt2_config(size, **overrides))
+
+
+def GPTNeo(size: str = "125m", **overrides) -> Transformer:
+    return Transformer(gpt_neo_config(size, **overrides))
 
 
 def OPT(size: str = "1.3b", **overrides) -> Transformer:

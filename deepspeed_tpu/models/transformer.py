@@ -79,10 +79,23 @@ class TransformerConfig:
     type_vocab_size: int = 0          # >0 adds segment (token-type) embeddings
     mlm_head: bool = False            # BERT MLM head: dense+gelu+LN+decoder+bias
     pooler: bool = False              # [CLS] dense+tanh pooler
+    # GPT-Neo knobs (reference module_inject/containers/gptneo.py):
+    # per-layer sliding windows (0 = global causal), and attention logit
+    # scale override (GPT-Neo uses UNSCALED qk^T, i.e. attn_scale=1.0)
+    attn_windows: Optional[Tuple[int, ...]] = None
+    attn_scale: Optional[float] = None
+    qkv_bias: Optional[bool] = None   # None -> follow use_bias (Neo: False)
 
     def __post_init__(self):
         if self.n_kv_heads is None:
             self.n_kv_heads = self.n_heads
+        if self.qkv_bias is None:
+            self.qkv_bias = self.use_bias
+        if self.attn_windows is not None:
+            self.attn_windows = tuple(int(w) for w in self.attn_windows)
+            assert len(self.attn_windows) == self.n_layers, (
+                f"attn_windows has {len(self.attn_windows)} entries for "
+                f"{self.n_layers} layers")
         if self.d_ff is None:
             if self.activation == "silu_glu":
                 self.d_ff = int(8 * self.d_model / 3 / 128 + 1) * 128
@@ -104,8 +117,10 @@ class TransformerConfig:
         d, v, n = self.d_model, self.vocab_size, self.n_layers
         hd = self.head_dim
         attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd + self.n_heads * hd * d
+        if self.qkv_bias:
+            attn += self.n_heads * hd + 2 * self.n_kv_heads * hd
         if self.use_bias:
-            attn += self.n_heads * hd + 2 * self.n_kv_heads * hd + d
+            attn += d
         norms = (2 * d) * n + (d if self.prenorm else 0)
         if self.norm == "layer":
             norms *= 2  # weights + biases
@@ -190,10 +205,11 @@ class Transformer:
         if c.norm == "layer":
             layers["attn_norm_b"] = jnp.zeros((n, c.d_model), dtype)
             layers["mlp_norm_b"] = jnp.zeros((n, c.d_model), dtype)
-        if c.use_bias:
+        if c.qkv_bias:
             layers["bq"] = jnp.zeros((n, c.n_heads * hd), dtype)
             layers["bk"] = jnp.zeros((n, c.n_kv_heads * hd), dtype)
             layers["bv"] = jnp.zeros((n, c.n_kv_heads * hd), dtype)
+        if c.use_bias:
             layers["bo"] = jnp.zeros((n, c.d_model), dtype)
             layers["b_up"] = jnp.zeros((n, c.d_ff), dtype)
             layers["b_down"] = jnp.zeros((n, c.d_model), dtype)
@@ -248,11 +264,13 @@ class Transformer:
         return DistributedAttention(local_attn, self._mesh)(q, k, v, causal=True)
 
     def _block(self, x, lp, angles, positions, kv_cache=None, rng=None, training=False,
-               attn_mask=None):
+               attn_mask=None, attn_window=None):
         """One transformer block. x: [b, s, d]. Returns (x, new_kv, aux).
 
         ``attn_mask``: optional [b, s] padding mask (1 = attend) for the
-        bidirectional (causal=False) encoder path."""
+        bidirectional (causal=False) encoder path.
+        ``attn_window``: optional traced per-layer scalar — sliding-window
+        size for local attention (<=0 means global causal), GPT-Neo."""
         c = self.config
         hd = c.head_dim
         b, s, _ = x.shape
@@ -269,7 +287,7 @@ class Transformer:
         q = h @ lp["wq"]
         kk = h @ lp["wk"]
         vv = h @ lp["wv"]
-        if c.use_bias:
+        if c.qkv_bias:
             q, kk, vv = q + lp["bq"], kk + lp["bk"], vv + lp["bv"]
         q = q.reshape(b, s, c.n_heads, hd)
         kk = kk.reshape(b, s, c.n_kv_heads, hd)
@@ -300,10 +318,14 @@ class Transformer:
             # the unwritten zero tail of the cache)
             q_abs = cache_pos + jnp.arange(s)                   # [s]
             k_pos = jnp.arange(ck.shape[1])                     # [max_len]
-            mask = (k_pos[None, :] <= q_abs[:, None])[None, None]  # [1,1,s,max_len]
+            mask = k_pos[None, :] <= q_abs[:, None]             # [s, max_len]
+            if attn_window is not None:  # local layers trim the left edge
+                mask = mask & ((attn_window <= 0)
+                               | (k_pos[None, :] > q_abs[:, None] - attn_window))
             bias = _alibi_bias(ck.shape[1]) if c.position == "alibi" else None
-            attn = dot_product_attention(q, ck, cv, causal=False, mask=mask,
-                                         bias=bias)
+            attn = dot_product_attention(q, ck, cv, causal=False,
+                                         mask=mask[None, None], bias=bias,
+                                         scale=c.attn_scale)
         elif self._seq_size > 1:
             if c.position == "alibi":
                 raise NotImplementedError(
@@ -312,6 +334,10 @@ class Transformer:
                 raise NotImplementedError(
                     "bidirectional encoder + sequence-parallel attention "
                     "not supported yet")
+            if c.attn_windows is not None or c.attn_scale is not None:
+                raise NotImplementedError(
+                    "attention windows / scale overrides (GPT-Neo) + "
+                    "sequence-parallel attention not supported yet")
             attn = self._sp_attention(q, kk, vv)
         elif c.position == "alibi":
             # flash kernel carries no additive bias — use the jnp path
@@ -321,11 +347,23 @@ class Transformer:
             # encoder with padding: keys at padded positions are masked for
             # every query ([b, 1, 1, s] broadcast)
             key_mask = attn_mask.astype(bool)[:, None, None, :]
-            attn = dot_product_attention(q, kk, vv, causal=False, mask=key_mask)
+            attn = dot_product_attention(q, kk, vv, causal=False, mask=key_mask,
+                                         scale=c.attn_scale)
+        elif attn_window is not None:
+            # alternating global/local causal attention (GPT-Neo): local
+            # layers see only the trailing ``window`` positions
+            q_pos = jnp.arange(s)[:, None]
+            k_pos = jnp.arange(s)[None, :]
+            m = (k_pos <= q_pos) & ((attn_window <= 0)
+                                    | (k_pos > q_pos - attn_window))
+            attn = dot_product_attention(q, kk, vv, causal=False,
+                                         mask=m[None, None], scale=c.attn_scale)
         elif c.use_flash:
-            attn = flash_attention(q, kk, vv, causal=c.causal)
+            attn = flash_attention(q, kk, vv, causal=c.causal,
+                                   scale=c.attn_scale)
         else:
-            attn = dot_product_attention(q, kk, vv, causal=c.causal)
+            attn = dot_product_attention(q, kk, vv, causal=c.causal,
+                                         scale=c.attn_scale)
 
         attn = attn.reshape(b, s, c.n_heads * hd) @ lp["wo"]
         if c.use_bias:
@@ -377,23 +415,27 @@ class Transformer:
         (:meth:`apply`) and non-token towers (vision patch embeddings)."""
         c = self.config
         layer_rng = rng if rng is not None else jax.random.PRNGKey(0)
+        windows = jnp.asarray(c.attn_windows, jnp.int32) \
+            if c.attn_windows is not None else None
 
-        def block(x, lp, r):
+        def block(x, lp, r, w):
             return self._block(x, lp, angles, positions, None, r, training,
-                               attn_mask)
+                               attn_mask, w)
 
         if c.remat:
             from ..runtime.activation_checkpointing import checkpoint_wrapper
 
             block = checkpoint_wrapper(block, policy=c.remat_policy)
 
-        def scan_fn(carry, lp):
+        def scan_fn(carry, xs):
             y, r = carry
+            lp, w = (xs, None) if windows is None else xs
             r, sub = jax.random.split(r)
-            y, _, aux = block(y, lp, sub)
+            y, _, aux = block(y, lp, sub, w)
             return (y, r), aux
 
-        (x, _), auxes = jax.lax.scan(scan_fn, (x, layer_rng), params["layers"])
+        xs = params["layers"] if windows is None else (params["layers"], windows)
+        (x, _), auxes = jax.lax.scan(scan_fn, (x, layer_rng), xs)
         return x, jnp.sum(auxes)
 
     def apply(self, params, tokens, positions=None, kv_caches=None, cache_pos=None,
@@ -425,13 +467,22 @@ class Transformer:
             new_caches = None
         else:
             ks, vs = kv_caches
+            windows = jnp.asarray(c.attn_windows, jnp.int32) \
+                if c.attn_windows is not None else None
 
             def scan_fn(carry, layer_in):
-                lp, ck, cv = layer_in
-                y, (nk, nv), _aux = self._block(carry, lp, angles, positions, (ck, cv, cache_pos))
+                if windows is None:
+                    (lp, ck, cv), w = layer_in, None
+                else:
+                    lp, ck, cv, w = layer_in
+                y, (nk, nv), _aux = self._block(
+                    carry, lp, angles, positions, (ck, cv, cache_pos),
+                    attn_window=w)
                 return y, (nk, nv)
 
-            x, (nks, nvs) = jax.lax.scan(scan_fn, x, (params["layers"], ks, vs))
+            xs = (params["layers"], ks, vs) if windows is None \
+                else (params["layers"], ks, vs, windows)
+            x, (nks, nvs) = jax.lax.scan(scan_fn, x, xs)
             new_caches = (nks, nvs)
 
         if last_token_only:
@@ -654,6 +705,10 @@ class Transformer:
                 "encoder attention_mask/token_type_ids not plumbed through "
                 "the pipeline path yet — drop the pipe axis for BERT-style "
                 "training")
+        if self.config.attn_windows is not None:
+            raise NotImplementedError(
+                "per-layer attention windows (GPT-Neo) not plumbed through "
+                "the pipeline stage scan yet")
         if rng is None:
             rng = jax.random.PRNGKey(0)
 
@@ -747,10 +802,15 @@ class Transformer:
         if c.norm == "layer":
             layer_specs["attn_norm_b"] = P(pipe, None)
             layer_specs["mlp_norm_b"] = P(pipe, None)
+        if c.qkv_bias:
+            layer_specs.update({
+                "bq": P(pipe, "model"), "bk": P(pipe, "model"),
+                "bv": P(pipe, "model"),
+            })
         if c.use_bias:
             layer_specs.update({
-                "bq": P(pipe, "model"), "bk": P(pipe, "model"), "bv": P(pipe, "model"),
-                "bo": P(pipe, None), "b_up": P(pipe, "model"), "b_down": P(pipe, None),
+                "bo": P(pipe, None), "b_up": P(pipe, "model"),
+                "b_down": P(pipe, None),
             })
         specs: Dict[str, Any] = {
             "tok_embed": P("model", None),

@@ -71,6 +71,12 @@ def _save_tiny(tmp_path, family: str, safe: bool):
             activation_function="relu", do_layer_norm_before=True,
             word_embed_proj_dim=64)
         m = transformers.OPTForCausalLM(hf_cfg)
+    elif family == "gpt_neo":
+        hf_cfg = transformers.GPTNeoConfig(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            intermediate_size=256, max_position_embeddings=128,
+            attention_types=[[["global", "local"], 1]], window_size=8)
+        m = transformers.GPTNeoForCausalLM(hf_cfg)
     elif family == "bert":
         hf_cfg = transformers.BertConfig(
             vocab_size=256, hidden_size=64, num_hidden_layers=2,
@@ -97,7 +103,8 @@ def _save_tiny(tmp_path, family: str, safe: bool):
                                          ("falcon", True),
                                          ("mixtral", True),
                                          ("bert", True),
-                                         ("distilbert", True)])
+                                         ("distilbert", True),
+                                         ("gpt_neo", True)])
 def test_hf_logits_parity(tmp_path, family, safe):
     """Native forward on ingested weights == torch forward (fp32)."""
     hf_model, d = _save_tiny(tmp_path, family, safe)
@@ -153,6 +160,23 @@ def test_hf_greedy_decode_matches_torch(tmp_path):
             torch.tensor(prompt, dtype=torch.long), max_new_tokens=8,
             do_sample=False, use_cache=True).numpy()
 
+    eng = dst.init_inference(model=(model, params),
+                             config={"dtype": "fp32", "temperature": 0.0})
+    out = eng.generate(prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(out[0], ref[0])
+
+
+def test_hf_gpt_neo_decode_matches_torch(tmp_path):
+    """GPT-Neo KV-cache decode must honor the per-layer local window: the
+    prompt is longer than window_size=8, so the local layer's left-edge
+    trimming is live during generation."""
+    hf_model, d = _save_tiny(tmp_path, "gpt_neo", True)
+    model, params = from_pretrained(d, dtype=jnp.float32)
+    prompt = np.random.default_rng(3).integers(1, 250, (1, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model.generate(
+            torch.tensor(prompt, dtype=torch.long), max_new_tokens=8,
+            do_sample=False, use_cache=True).numpy()
     eng = dst.init_inference(model=(model, params),
                              config={"dtype": "fp32", "temperature": 0.0})
     out = eng.generate(prompt, max_new_tokens=8)
