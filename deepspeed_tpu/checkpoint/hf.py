@@ -98,6 +98,14 @@ def _read_one(path: str) -> Dict[str, np.ndarray]:
 
 # ----------------------------------------------------------------------
 # config translation
+def _uniform_windows(window, max_seq: int, n_layers: int):
+    """Per-layer attn_windows for a uniform sliding window (Mistral/
+    Mixtral); None when no window is configured or it never binds."""
+    if window is None or window >= max_seq:
+        return None
+    return tuple([int(window)] * n_layers)
+
+
 def hf_config(model_dir: str):
     """Parse HF config.json -> (family, TransformerConfig)."""
     from ..models.transformer import TransformerConfig
@@ -115,16 +123,20 @@ def hf_config(model_dir: str):
             raise NotImplementedError("llama attention_bias=true not supported")
         max_seq = hc.get("max_position_embeddings", 2048)
         window = hc.get("sliding_window")
-        if window is not None and window < max_seq:
-            # full attention == sliding-window attention while seq <= window;
-            # cap the usable context instead of serving wrong long-range math
-            max_seq = window
+        n_layers = hc["num_hidden_layers"]
+        # Mistral sliding window: the full position table stays usable
+        # (decode past the window is exact); every layer attends the
+        # trailing `window` positions. The core elides the window math —
+        # and keeps flash — whenever seq <= window; a BINDING window uses
+        # the masked O(s^2) jnp path, so cap non-cached forwards
+        # accordingly (see TransformerConfig.attn_windows)
+        windows = _uniform_windows(window, max_seq, n_layers)
         cfg = TransformerConfig(
             vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
-            n_layers=hc["num_hidden_layers"], n_heads=hc["num_attention_heads"],
+            n_layers=n_layers, n_heads=hc["num_attention_heads"],
             n_kv_heads=hc.get("num_key_value_heads", hc["num_attention_heads"]),
             d_ff=hc["intermediate_size"],
-            max_seq_len=max_seq,
+            max_seq_len=max_seq, attn_windows=windows,
             norm="rms", activation="silu_glu", position="rope",
             rope_theta=hc.get("rope_theta", 10000.0),
             tie_embeddings=hc.get("tie_word_embeddings", False),
@@ -162,13 +174,14 @@ def hf_config(model_dir: str):
             raise NotImplementedError("mixtral rope_scaling not supported")
         max_seq = hc.get("max_position_embeddings", 4096)
         window = hc.get("sliding_window")
-        if window is not None and window < max_seq:
-            max_seq = window
+        n_layers = hc["num_hidden_layers"]
+        windows = _uniform_windows(window, max_seq, n_layers)
         cfg = MoETransformerConfig(
             vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
-            n_layers=hc["num_hidden_layers"], n_heads=hc["num_attention_heads"],
+            n_layers=n_layers, n_heads=hc["num_attention_heads"],
             n_kv_heads=hc.get("num_key_value_heads", hc["num_attention_heads"]),
             d_ff=hc["intermediate_size"], max_seq_len=max_seq,
+            attn_windows=windows,
             norm="rms", activation="silu_glu", position="rope",
             rope_theta=hc.get("rope_theta", 1e6),
             tie_embeddings=hc.get("tie_word_embeddings", False),

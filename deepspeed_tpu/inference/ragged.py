@@ -138,11 +138,15 @@ class RaggedInferenceEngine:
             raise NotImplementedError(
                 "RaggedInferenceEngine does not support ALiBi or parallel-"
                 "residual families yet; use InferenceEngine (dense KV cache)")
-        if getattr(c, "attn_windows", None) is not None \
+        if c.window_binds(self.config.max_context) \
                 or getattr(c, "attn_scale", None) is not None:
+            # windows that never bind within max_context are plain causal —
+            # serve those (Mistral with max_context <= sliding_window);
+            # anything that would actually trim the page walk is unsupported
             raise NotImplementedError(
-                "RaggedInferenceEngine does not support per-layer attention "
-                "windows / scale overrides (GPT-Neo) yet; use "
+                "RaggedInferenceEngine does not implement sliding-window "
+                "paged attention (window < max_context) or attention-scale "
+                "overrides; cap max_context at the window or use "
                 "InferenceEngine (dense KV cache)")
         if self.config.max_context % self.config.kv_block_size != 0:
             raise ValueError(

@@ -79,9 +79,13 @@ class TransformerConfig:
     type_vocab_size: int = 0          # >0 adds segment (token-type) embeddings
     mlm_head: bool = False            # BERT MLM head: dense+gelu+LN+decoder+bias
     pooler: bool = False              # [CLS] dense+tanh pooler
-    # GPT-Neo knobs (reference module_inject/containers/gptneo.py):
-    # per-layer sliding windows (0 = global causal), and attention logit
-    # scale override (GPT-Neo uses UNSCALED qk^T, i.e. attn_scale=1.0)
+    # Sliding-window knobs (GPT-Neo alternating local layers, Mistral/
+    # Mixtral uniform windows): per-layer window sizes, 0 = global causal.
+    # At seq <= window the window is statically elided (flash path kept);
+    # a BINDING window routes through the masked jnp attention — O(s^2)
+    # score memory, so cap non-cached forwards well below max_seq_len
+    # until the flash kernel grows a banded skip. attn_scale overrides the
+    # logit scale (GPT-Neo uses UNSCALED qk^T, i.e. attn_scale=1.0).
     attn_windows: Optional[Tuple[int, ...]] = None
     attn_scale: Optional[float] = None
     qkv_bias: Optional[bool] = None   # None -> follow use_bias (Neo: False)
@@ -102,6 +106,12 @@ class TransformerConfig:
             else:
                 self.d_ff = 4 * self.d_model
         assert self.d_model % self.n_heads == 0
+
+    def window_binds(self, length: int) -> bool:
+        """True if any per-layer sliding window actually trims attention
+        at this sequence/context length (w == length attends everything)."""
+        return self.attn_windows is not None \
+            and any(0 < w < length for w in self.attn_windows)
 
     @property
     def head_dim(self) -> int:
@@ -334,9 +344,12 @@ class Transformer:
                 raise NotImplementedError(
                     "bidirectional encoder + sequence-parallel attention "
                     "not supported yet")
-            if c.attn_windows is not None or c.attn_scale is not None:
+            # attn_window is None here whenever no window binds at this
+            # length (_encode elides them) — Mistral at seq <= window keeps
+            # training under SP; only an actually-binding window raises
+            if attn_window is not None or c.attn_scale is not None:
                 raise NotImplementedError(
-                    "attention windows / scale overrides (GPT-Neo) + "
+                    "binding attention windows / scale overrides + "
                     "sequence-parallel attention not supported yet")
             attn = self._sp_attention(q, kk, vv)
         elif c.position == "alibi":
@@ -415,8 +428,10 @@ class Transformer:
         (:meth:`apply`) and non-token towers (vision patch embeddings)."""
         c = self.config
         layer_rng = rng if rng is not None else jax.random.PRNGKey(0)
-        windows = jnp.asarray(c.attn_windows, jnp.int32) \
-            if c.attn_windows is not None else None
+        # when no window binds at this (static) length, windowed causal ==
+        # plain causal: keep the flash path (Mistral at seq <= window)
+        aw = c.attn_windows if c.window_binds(x.shape[1]) else None
+        windows = jnp.asarray(aw, jnp.int32) if aw is not None else None
 
         def block(x, lp, r, w):
             return self._block(x, lp, angles, positions, None, r, training,
@@ -705,14 +720,17 @@ class Transformer:
                 "encoder attention_mask/token_type_ids not plumbed through "
                 "the pipeline path yet — drop the pipe axis for BERT-style "
                 "training")
-        if self.config.attn_windows is not None:
-            raise NotImplementedError(
-                "per-layer attention windows (GPT-Neo) not plumbed through "
-                "the pipeline stage scan yet")
         if rng is None:
             rng = jax.random.PRNGKey(0)
 
         inputs, targets, mask = self._targets_from_batch(batch)
+        if self.config.window_binds(inputs.shape[1]):
+            # the stage scan does not thread per-layer windows; a window
+            # that never binds at this length is plain causal and fine
+            raise NotImplementedError(
+                "binding attention windows not plumbed through the "
+                "pipeline stage scan yet — drop the pipe axis or keep "
+                "seq_len <= window")
         mb = microbatch(
             {"inputs": inputs, "targets": targets,
              **({"mask": mask} if mask is not None else {})},

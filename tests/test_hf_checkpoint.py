@@ -57,11 +57,14 @@ def _save_tiny(tmp_path, family: str, safe: bool):
             max_position_embeddings=128)
         m = transformers.FalconForCausalLM(hf_cfg)
     elif family == "mixtral":
+        # sliding_window=8 < the 16-token parity input: the windowed MoE
+        # forward is exercised, not just parsed
         hf_cfg = transformers.MixtralConfig(
             vocab_size=256, hidden_size=64, intermediate_size=112,
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             num_local_experts=4, num_experts_per_tok=2,
             max_position_embeddings=128, rms_norm_eps=1e-6,
+            sliding_window=8, attn_implementation="eager",
             tie_word_embeddings=False)
         m = transformers.MixtralForCausalLM(hf_cfg)
     elif family == "opt":
@@ -164,6 +167,40 @@ def test_hf_greedy_decode_matches_torch(tmp_path):
                              config={"dtype": "fp32", "temperature": 0.0})
     out = eng.generate(prompt, max_new_tokens=8)
     np.testing.assert_array_equal(out[0], ref[0])
+
+
+def test_hf_mistral_sliding_window_beyond_window(tmp_path):
+    """Mistral contexts LONGER than sliding_window must match torch (the
+    old behavior capped max context at the window instead)."""
+    torch.manual_seed(0)
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, sliding_window=8, rms_norm_eps=1e-6,
+        attn_implementation="eager")
+    hf_model = transformers.MistralForCausalLM(hf_cfg).eval()
+    d = tmp_path / "mistral_sw"
+    hf_model.save_pretrained(str(d), safe_serialization=True)
+    model, params = from_pretrained(d, dtype=jnp.float32)
+    assert model.config.max_seq_len == 128  # NOT capped at the window
+    assert model.config.attn_windows == (8, 8)
+
+    tokens = np.random.default_rng(4).integers(1, 250, (2, 24)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+    # decode across the window boundary stays token-exact
+    prompt = tokens[:1, :12]
+    with torch.no_grad():
+        gref = hf_model.generate(torch.tensor(prompt, dtype=torch.long),
+                                 max_new_tokens=8, do_sample=False,
+                                 use_cache=True).numpy()
+    eng = dst.init_inference(model=(model, params),
+                             config={"dtype": "fp32", "temperature": 0.0})
+    out = eng.generate(prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(out[0], gref[0])
 
 
 def test_hf_gpt_neo_decode_matches_torch(tmp_path):

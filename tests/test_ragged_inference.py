@@ -216,6 +216,29 @@ def test_ragged_serves_relu_activation():
     np.testing.assert_array_equal(np.asarray(out[1]), ref[0, 8:])
 
 
+def test_window_models_served_only_when_window_never_binds():
+    """Sliding-window configs (Mistral) are served when max_context <=
+    window (plain causal at that length) and rejected loudly when the
+    window would actually trim attention."""
+    def _win_llama(w):
+        return Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=2,
+                     vocab_size=128, max_seq_len=256, use_flash=False,
+                     remat=False, attn_windows=(w, w))
+
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        RaggedInferenceEngine(_win_llama(8), _cfg())  # 8 < max_context 128
+
+    eng = RaggedInferenceEngine(_win_llama(128), _cfg(),
+                                rng=jax.random.PRNGKey(0))  # never binds
+    rng = np.random.default_rng(30)
+    prompt = rng.integers(1, 128, (10,)).tolist()
+    out = eng.generate({0: list(prompt)}, max_new_tokens=8)
+    ref_eng = RaggedInferenceEngine(_llama(), _cfg(),
+                                    rng=jax.random.PRNGKey(0))
+    # same weights seed + window-free math at this length => same tokens
+    assert out[0] == ref_eng.generate({0: list(prompt)}, max_new_tokens=8)[0]
+
+
 def test_sampled_decode_chunk_invariant_and_seeded():
     """temperature>0 sampling: same engine seed -> identical streams
     regardless of decode chunking; different seed -> different tokens;

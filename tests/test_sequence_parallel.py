@@ -84,6 +84,38 @@ def test_model_trains_with_seq_parallel(impl):
     assert losses[-1] < losses[0]
 
 
+def test_sp_trains_nonbinding_window_and_rejects_binding():
+    """Mistral-style sliding-window configs under a seq mesh: train fine
+    while seq <= window (window statically elided), raise loudly when the
+    window would actually bind."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.models import Llama
+    from deepspeed_tpu.runtime.dataloader import shard_batch
+
+    def build(window):
+        model = Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=4,
+                      vocab_size=128, max_seq_len=64, use_flash=False,
+                      remat=False, sp_attention="ulysses",
+                      attn_windows=(window, window))
+        engine, _, _, _ = dst.initialize(model=model, config={
+            "train_batch_size": 4,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "mesh": {"data": 2, "seq": 4},
+            "steps_per_print": 1000,
+        }, rng=jax.random.PRNGKey(0))
+        return model, engine
+
+    toks = np.random.default_rng(0).integers(0, 128, (4, 32)).astype(np.int32)
+    model, engine = build(window=32)  # == seq: never binds, SP path runs
+    batch = shard_batch({"input_ids": toks}, engine.topo)
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+    model, engine = build(window=8)  # binds at seq 32: must refuse
+    with pytest.raises(NotImplementedError, match="window"):
+        engine.train_batch(shard_batch({"input_ids": toks}, engine.topo))
+
+
 def test_sp_matches_dense_numerics():
     """Seq-parallel model forward == plain forward (same params)."""
     import deepspeed_tpu as dst
