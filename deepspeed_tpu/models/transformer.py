@@ -81,11 +81,12 @@ class TransformerConfig:
     pooler: bool = False              # [CLS] dense+tanh pooler
     # Sliding-window knobs (GPT-Neo alternating local layers, Mistral/
     # Mixtral uniform windows): per-layer window sizes, 0 = global causal.
-    # At seq <= window the window is statically elided (flash path kept);
-    # a BINDING window routes through the masked jnp attention — O(s^2)
-    # score memory, so cap non-cached forwards well below max_seq_len
-    # until the flash kernel grows a banded skip. attn_scale overrides the
-    # logit scale (GPT-Neo uses UNSCALED qk^T, i.e. attn_scale=1.0).
+    # At seq <= window the window is statically elided (flash path kept).
+    # A BINDING uniform window dispatches the banded flash kernel
+    # (O(s*window) compute, below-band tiles skipped); per-layer-VARYING
+    # windows use the masked jnp path (O(s^2) score memory — GPT-Neo's
+    # windows are small). attn_scale overrides the logit scale (GPT-Neo
+    # uses UNSCALED qk^T, i.e. attn_scale=1.0).
     attn_windows: Optional[Tuple[int, ...]] = None
     attn_scale: Optional[float] = None
     qkv_bias: Optional[bool] = None   # None -> follow use_bias (Neo: False)
@@ -279,8 +280,11 @@ class Transformer:
 
         ``attn_mask``: optional [b, s] padding mask (1 = attend) for the
         bidirectional (causal=False) encoder path.
-        ``attn_window``: optional traced per-layer scalar — sliding-window
-        size for local attention (<=0 means global causal), GPT-Neo."""
+        ``attn_window``: sliding-window size for local attention (<= 0
+        means global causal). A STATIC python int (uniform windows,
+        Mistral) dispatches the banded flash kernel — keep it static, a
+        traced scalar silently falls to the O(s^2) masked path reserved
+        for per-layer-varying windows (GPT-Neo)."""
         c = self.config
         hd = c.head_dim
         b, s, _ = x.shape
@@ -362,9 +366,15 @@ class Transformer:
             key_mask = attn_mask.astype(bool)[:, None, None, :]
             attn = dot_product_attention(q, kk, vv, causal=False, mask=key_mask,
                                          scale=c.attn_scale)
+        elif attn_window is not None and isinstance(attn_window, int):
+            # uniform static window (Mistral/Mixtral): banded flash kernel
+            # on TPU (tiles below the band skipped), banded jnp otherwise
+            fn = flash_attention if c.use_flash else dot_product_attention
+            attn = fn(q, kk, vv, causal=True, scale=c.attn_scale,
+                      window=attn_window)
         elif attn_window is not None:
-            # alternating global/local causal attention (GPT-Neo): local
-            # layers see only the trailing ``window`` positions
+            # per-layer-varying (traced) windows — alternating global/local
+            # causal attention (GPT-Neo): numeric banded mask
             q_pos = jnp.arange(s)[:, None]
             k_pos = jnp.arange(s)[None, :]
             m = (k_pos <= q_pos) & ((attn_window <= 0)
@@ -429,13 +439,19 @@ class Transformer:
         c = self.config
         layer_rng = rng if rng is not None else jax.random.PRNGKey(0)
         # when no window binds at this (static) length, windowed causal ==
-        # plain causal: keep the flash path (Mistral at seq <= window)
+        # plain causal: keep the flash path (Mistral at seq <= window).
+        # A BINDING uniform window stays a static python int so _block can
+        # dispatch the banded flash kernel; only per-layer-varying windows
+        # (GPT-Neo) ride the scan as traced scalars.
         aw = c.attn_windows if c.window_binds(x.shape[1]) else None
+        static_window = None
+        if aw is not None and len(set(aw)) == 1:
+            static_window, aw = aw[0], None
         windows = jnp.asarray(aw, jnp.int32) if aw is not None else None
 
         def block(x, lp, r, w):
             return self._block(x, lp, angles, positions, None, r, training,
-                               attn_mask, w)
+                               attn_mask, static_window if w is None else w)
 
         if c.remat:
             from ..runtime.activation_checkpointing import checkpoint_wrapper

@@ -35,14 +35,16 @@ def dot_product_attention(q, k, v, *, causal: bool = True,
                           mask: Optional[jnp.ndarray] = None,
                           bias: Optional[jnp.ndarray] = None,
                           scale: Optional[float] = None,
-                          logits_dtype=jnp.float32):
+                          logits_dtype=jnp.float32,
+                          window: int = 0):
     """Reference attention. q: [b, sq, hq, d]; k/v: [b, skv, hkv, d].
 
     Softmax in fp32 (the reference kernels do the same via float accumulators
     in attn_softmax_v2). Causal masking uses absolute positions aligned to
     the *end* of the KV sequence so decode (sq=1, skv=cache_len) works.
     ``bias``: optional additive logit bias broadcastable to [b, h, sq, skv]
-    (ALiBi).
+    (ALiBi). ``window`` > 0 bands causal attention to the trailing
+    ``window`` keys (k > q - window).
     """
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
@@ -57,6 +59,8 @@ def dot_product_attention(q, k, v, *, causal: bool = True,
         q_pos = jnp.arange(sq)[:, None] + (skv - sq)
         k_pos = jnp.arange(skv)[None, :]
         causal_mask = q_pos >= k_pos  # [sq, skv]
+        if window > 0:
+            causal_mask = causal_mask & (k_pos > q_pos - window)
         logits = jnp.where(causal_mask[None, None], logits, jnp.finfo(logits_dtype).min)
     if mask is not None:
         logits = jnp.where(mask, logits, jnp.finfo(logits_dtype).min)
@@ -65,20 +69,27 @@ def dot_product_attention(q, k, v, *, causal: bool = True,
 
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 1024, block_k: int = 1024):
+                    block_q: int = 1024, block_k: int = 1024, window: int = 0):
     """Blocked flash attention. Dispatches to the Pallas TPU kernel when
     running on TPU with compatible shapes (padding odd causal self-attention
-    lengths up to a lane multiple); jnp reference otherwise."""
+    lengths up to a lane multiple); jnp reference otherwise. ``window`` > 0
+    (static; requires causal) bands attention to the trailing ``window``
+    keys — the kernel skips tiles fully below the band (Mistral sliding
+    window at O(s*window) compute)."""
+    if window > 0 and not causal:
+        raise ValueError("window > 0 requires causal attention")
     if _use_pallas(q, k, block_q, block_k):
         from .pallas.flash_attention import flash_attention as _pallas_flash
 
-        return _pallas_flash(q, k, v, causal, scale, block_q, block_k)
+        return _pallas_flash(q, k, v, causal, scale, block_q, block_k,
+                             window=window)
     if _use_pallas_padded(q, k, causal):
         from .pallas.flash_attention import flash_attention_padded
 
         return flash_attention_padded(q, k, v, causal, scale,
-                                      block_q, block_k)
-    return dot_product_attention(q, k, v, causal=causal, scale=scale)
+                                      block_q, block_k, window=window)
+    return dot_product_attention(q, k, v, causal=causal, scale=scale,
+                                 window=window)
 
 
 def _on_tpu() -> bool:

@@ -43,19 +43,36 @@ NEG_INF = -1e30  # large-negative instead of -inf: avoids NaN from (-inf)-(-inf)
 LANES = 128
 
 
-def _causal_mask(qi, ki, block_q: int, block_k: int, sq: int, skv: int):
+def _causal_mask(qi, ki, block_q: int, block_k: int, sq: int, skv: int,
+                 window: int = 0):
     """[block_q, block_k] bool mask for the (qi, ki) tile; query positions are
     aligned to the END of the kv sequence (decode parity with
-    ops/attention.py dot_product_attention)."""
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    ops/attention.py dot_product_attention). ``window`` > 0 additionally
+    bands the mask to the trailing ``window`` keys (k > q - window)."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) \
+        + (skv - sq)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    return (q_pos + (skv - sq)) >= k_pos
+    m = q_pos >= k_pos
+    if window > 0:
+        m = jnp.logical_and(m, k_pos > q_pos - window)
+    return m
+
+
+def _tile_runs(qi, ki, block_q: int, block_k: int, diag_offset: int,
+               causal: bool, window: int):
+    """Whether the (qi, ki) tile intersects the (banded) causal region:
+    skip above the diagonal (causal) AND fully below the band (window)."""
+    run = (not causal) or (ki * block_k <= qi * block_q + (block_q - 1) + diag_offset)
+    if window > 0:
+        run = jnp.logical_and(
+            run, ki * block_k + (block_k - 1) > qi * block_q + diag_offset - window)
+    return run
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
                 *, scale: float, causal: bool, block_q: int, block_k: int,
-                sq: int, skv: int):
+                sq: int, skv: int, window: int):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -65,9 +82,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # skip tiles strictly above the causal diagonal
+    # skip tiles above the causal diagonal / fully below the window band
     diag_offset = skv - sq
-    run = (not causal) or (ki * block_k <= qi * block_q + (block_q - 1) + diag_offset)
+    run = _tile_runs(qi, ki, block_q, block_k, diag_offset, causal, window)
 
     @pl.when(run)
     def _step():
@@ -78,7 +95,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         k = k_ref[0, 0]                              # [bk, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal and window > 0:
+            # banded tiles can be partial on both edges — mask every
+            # running tile (windowed models only pay this)
+            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, sq, skv,
+                                       window), s, NEG_INF)
+        elif causal:
             # apply the element mask only on blocks crossing the diagonal
             partial = ki * block_k + (block_k - 1) > qi * block_q + diag_offset
             s = jnp.where(
@@ -115,7 +137,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
+                   window=0):
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
     group = hq // hkv
@@ -129,7 +152,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, sq=sq, skv=skv)
+        block_q=block_q, block_k=block_k, sq=sq, skv=skv, window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, hq, nq, nk),
@@ -166,7 +189,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                acc_scr, *, scale: float, causal: bool,
-               block_q: int, block_k: int, sq: int, skv: int):
+               block_q: int, block_k: int, sq: int, skv: int, window: int):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -175,7 +198,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     diag_offset = skv - sq
-    run = (not causal) or (ki * block_k <= qi * block_q + (block_q - 1) + diag_offset)
+    run = _tile_runs(qi, ki, block_q, block_k, diag_offset, causal, window)
 
     @pl.when(run)
     def _step():
@@ -188,8 +211,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, sq, skv),
-                          s, NEG_INF)
+            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, sq, skv,
+                                       window), s, NEG_INF)
         p = jnp.exp(s - lse)                         # [bq, bk] fp32
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -205,7 +228,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
                 *, scale: float, causal: bool,
-                block_q: int, block_k: int, sq: int, skv: int, nq: int):
+                block_q: int, block_k: int, sq: int, skv: int, nq: int,
+                window: int):
     # last grid dim fuses (q-head group, q block): dk/dv accumulate across
     # the whole group in scratch without materializing per-q-head K/V
     ki, gq = pl.program_id(2), pl.program_id(3)
@@ -218,7 +242,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     diag_offset = skv - sq
-    run = (not causal) or (ki * block_k <= qi * block_q + (block_q - 1) + diag_offset)
+    run = _tile_runs(qi, ki, block_q, block_k, diag_offset, causal, window)
 
     @pl.when(run)
     def _step():
@@ -231,8 +255,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, sq, skv),
-                          s, NEG_INF)
+            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, sq, skv,
+                                       window), s, NEG_INF)
         p = jnp.exp(s - lse)                         # [bq, bk] fp32
         # dv += P^T @ dO
         dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
@@ -263,7 +287,7 @@ def _row_spec(block: int, index_map):
 
 
 def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
-                    interpret):
+                    interpret, window=0):
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
     group = hq // hkv
@@ -282,7 +306,8 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
     # (same trick as the forward — never expanded to q-heads)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, sq=sq, skv=skv),
+                          block_q=block_q, block_k=block_k, sq=sq, skv=skv,
+                          window=window),
         grid=(b, hq, nq, nk),
         in_specs=[
             _seq_spec(block_q, d, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -309,7 +334,7 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, sq=sq, skv=skv,
-                          nq=nq),
+                          nq=nq, window=window),
         grid=(b, hkv, nk, group * nq),
         in_specs=[
             _seq_spec(block_q, d,
@@ -341,31 +366,38 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
             dv.transpose(0, 2, 1, 3))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
                     block_q: int = 1024, block_k: int = 1024,
-                    interpret: bool = False):
+                    interpret: bool = False, window: int = 0):
     """q: [b, sq, hq, d]; k/v: [b, skv, hkv, d] -> [b, sq, hq, d].
 
     ``sq``/``skv`` must divide by the (clamped) block sizes; the dispatcher
     in ``ops/attention.py`` falls back to the jnp path otherwise.
+    ``window`` > 0 (static, requires causal) bands attention to the
+    trailing ``window`` keys: tiles fully below the band are skipped, so
+    compute is O(s * window) instead of O(s^2 / 2) (Mistral sliding
+    window).
     """
+    assert window <= 0 or causal, "window requires causal attention"
     scale_v = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    out, _ = _flash_forward(q, k, v, scale_v, causal, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, scale_v, causal, block_q, block_k,
+                            interpret, window)
     return out
 
 
-def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret, window):
     scale_v = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    out, lse = _flash_forward(q, k, v, scale_v, causal, block_q, block_k, interpret)
+    out, lse = _flash_forward(q, k, v, scale_v, causal, block_q, block_k,
+                              interpret, window)
     return out, (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _fa_bwd(causal, scale, block_q, block_k, interpret, window, res, g):
     q, k, v, out, lse = res
     scale_v = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     dq, dk, dv = _flash_backward(q, k, v, out, lse, g, scale_v, causal,
-                                 block_q, block_k, interpret)
+                                 block_q, block_k, interpret, window)
     return dq, dk, dv
 
 
@@ -375,21 +407,22 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 def flash_attention_padded(q, k, v, causal: bool = True,
                            scale: Optional[float] = None,
                            block_q: int = 1024, block_k: int = 1024,
-                           interpret: bool = False):
+                           interpret: bool = False, window: int = 0):
     """Arbitrary-length causal SELF-attention via symmetric zero-padding to
     a lane multiple. Exact: with sq == skv and causal masking, a real query
     i attends keys <= i, so padded keys (> real length) are always masked
     out; padded query rows produce garbage that the final slice drops, and
-    their cotangent is zero so dk/dv stay exact through the backward."""
+    their cotangent is zero so dk/dv stay exact through the backward.
+    (Banding by ``window`` composes: the band only removes keys.)"""
     assert causal and q.shape[1] == k.shape[1], \
         "padding trick requires causal self-attention (sq == skv)"
     s = q.shape[1]
     pad = (-s) % LANES
     if pad == 0:
         return flash_attention(q, k, v, causal, scale, block_q, block_k,
-                               interpret)
+                               interpret, window)
     widths = ((0, 0), (0, pad), (0, 0), (0, 0))
     out = flash_attention(jnp.pad(q, widths), jnp.pad(k, widths),
                           jnp.pad(v, widths), causal, scale,
-                          block_q, block_k, interpret)
+                          block_q, block_k, interpret, window)
     return out[:, :s]

@@ -60,6 +60,44 @@ def test_flash_backward_matches_reference(b, sq, skv, hq, hkv, d, causal):
                                    err_msg=f"d{name} mismatch")
 
 
+@pytest.mark.parametrize("b,s,hq,hkv,d,window", [
+    (1, 512, 2, 2, 64, 128),   # window == block: band skips whole tiles
+    (1, 512, 4, 2, 64, 100),   # GQA, window not tile-aligned
+    (2, 384, 2, 2, 64, 300),   # window spans multiple tiles
+    (1, 256, 2, 1, 64, 1),     # degenerate: attend self only
+])
+def test_flash_windowed_forward_matches_reference(b, s, hq, hkv, d, window):
+    q, k, v = _make_qkv(b, s, s, hq, hkv, d, seed=11)
+    out = flash_attention(q, k, v, True, None, 128, 128, True, window)
+    ref = dot_product_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("window", [128, 100])
+def test_flash_windowed_backward_matches_reference(window):
+    q, k, v = _make_qkv(1, 384, 384, 4, 2, 64, seed=12)
+
+    def loss(fn):
+        def f(q, k, v):
+            o = fn(q, k, v)
+            return jnp.sum(o * (1 + jnp.arange(64, dtype=o.dtype) / 64))
+        return f
+
+    g_flash = jax.grad(
+        loss(lambda q, k, v: flash_attention(q, k, v, True, None, 128, 128,
+                                             True, window)),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        loss(lambda q, k, v: dot_product_attention(q, k, v, causal=True,
+                                                   window=window)),
+        argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=5e-4, atol=5e-4,
+                                   err_msg=f"d{name} mismatch (window)")
+
+
 def test_flash_multiblock_kv_accumulation():
     """Online-softmax accumulation across many kv blocks (nk > 1)."""
     q, k, v = _make_qkv(1, 128, 512, 2, 2, 64, seed=3)
