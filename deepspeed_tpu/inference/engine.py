@@ -248,13 +248,196 @@ class InferenceEngine:
 
         return jax.jit(decode, donate_argnums=(1,))
 
+    def _build_beam_step(self, beams: int):
+        model = self.model
+
+        def step(params, caches, last_tokens, cache_pos, scores):
+            # last_tokens/scores: flat [b*beams]. Returns the updated caches
+            # (new KV written in the CURRENT beam order) and the top
+            # 2*beams candidate (score, beams*V index) per row — enough
+            # non-eos candidates to always refill `beams` live beams
+            # (HF beam_search's 2k trick).
+            params = self._dequant_tree(params)
+            logits, caches = model.apply(
+                params, last_tokens[:, None], positions=cache_pos[None, None],
+                kv_caches=caches, cache_pos=cache_pos)
+            logp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32), -1)
+            V = logp.shape[-1]
+            total = scores.reshape(-1, beams)[:, :, None] + logp.reshape(-1, beams, V)
+            top_scores, top_idx = jax.lax.top_k(
+                total.reshape(-1, beams * V), 2 * beams)
+            return caches, top_scores, top_idx
+
+        gather = jax.jit(
+            lambda caches, idx: jax.tree_util.tree_map(
+                lambda c: c[:, idx], caches),
+            donate_argnums=(0,))
+        return jax.jit(step, donate_argnums=(1,)), gather
+
+    def _generate_beam(self, input_ids, max_new_tokens: int, num_beams: int,
+                       eos_token_id: Optional[int],
+                       length_penalty: float = 1.0) -> np.ndarray:
+        """Deterministic beam search with HF ``generate(num_beams=N)``
+        semantics (the reference engine reaches it through the wrapped HF
+        module): per row, EOS candidates among the top-2k move to a
+        finished-hypothesis pool (kept if the pool has room or they beat
+        its worst entry), live beams refill to k from the rest, and rows
+        stop when the pool is full and no live beam can still beat it.
+        Scores normalize by full sequence length ** length_penalty."""
+        k = num_beams
+        b, s = input_ids.shape
+        max_len = s + max_new_tokens
+        assert max_len <= self.model.config.max_seq_len
+        if self._prefill_fn is None:
+            self._prefill_fn = self._build_prefill()
+            self._decode_fn = self._build_decode()
+        fns = self._alloc_fns.get(("beam", k))
+        if fns is None:
+            fns = self._build_beam_step(k)
+            self._alloc_fns[("beam", k)] = fns
+        beam_step, cache_gather = fns
+
+        caches = self._alloc_cache(b, max_len)
+        logits, caches = self._prefill_fn(self.params, input_ids, caches)
+        logp0 = jax.nn.log_softmax(logits.astype(jnp.float32), -1)  # [b, V]
+        # expand caches to [L, b*k, ...] AFTER the (1x) prefill
+        caches = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, k, axis=1), caches)
+
+        eos = eos_token_id
+        lp = length_penalty
+        V = self.model.config.vocab_size
+        # pools[r]: finished hypotheses (sum_logprobs, gen_tokens WITHOUT
+        # the closing eos, norm_len). HF (4.4x) normalization: sum /
+        # GENERATED length ** lp, where a pooled hypothesis counts its
+        # closing eos and the prompt never counts.
+        pools = [[] for _ in range(b)]
+        done = np.zeros((b,), bool)
+        live_scores = np.zeros((b, k), np.float32)
+        live_seqs = np.zeros((b, k, 0), np.int64)
+
+        def norm(score_sum, gen_len):
+            return score_sum / float(gen_len) ** lp
+
+        def select(cand_scores, cand_idx):
+            """HF BeamSearchScorer.process: walk the 2k candidates per row
+            in score order; eos candidates enter the pool (if it has room
+            or they beat its worst), others refill k live beams."""
+            nonlocal live_scores, live_seqs
+            parents = np.zeros((b, k), np.int64)
+            new_scores = live_scores.copy()
+            new_tokens = np.zeros((b, k), np.int64)
+            for r in range(b):
+                if done[r]:
+                    parents[r] = np.arange(k)   # frozen; results ignored
+                    new_tokens[r] = eos if eos is not None else 0
+                    continue
+                filled = 0
+                for rank, (sc, idx) in enumerate(zip(cand_scores[r],
+                                                     cand_idx[r])):
+                    parent, tok = divmod(int(idx), V)
+                    if eos is not None and tok == eos:
+                        if rank >= k:  # HF: eos beyond the top-k ranks is
+                            continue   # dropped, never pooled
+                        hyp = live_seqs[r, parent].copy()
+                        nl = len(hyp) + 1  # closing eos counts (HF
+                        # process: generated_len = cur_len - prompt_len)
+                        if len(pools[r]) < k:
+                            pools[r].append((float(sc), hyp, nl))
+                        else:
+                            worst_i = min(range(k), key=lambda i: norm(
+                                pools[r][i][0], pools[r][i][2]))
+                            if norm(float(sc), nl) > norm(
+                                    pools[r][worst_i][0],
+                                    pools[r][worst_i][2]):
+                                pools[r][worst_i] = (float(sc), hyp, nl)
+                        continue
+                    parents[r, filled] = parent
+                    new_scores[r, filled] = sc
+                    new_tokens[r, filled] = tok
+                    filled += 1
+                    if filled == k:
+                        break
+            live_scores = new_scores
+            live_seqs = np.take_along_axis(live_seqs, parents[:, :, None],
+                                           axis=1)
+            live_seqs = np.concatenate([live_seqs, new_tokens[:, :, None]],
+                                       axis=2)
+            if eos is not None:
+                cur = live_seqs.shape[2]
+                for r in range(b):
+                    if not done[r] and len(pools[r]) >= k:
+                        # early_stopping=False heuristic (HF
+                        # _check_early_stop_heuristic): stop when the best
+                        # RUNNING beam's sum, normalized at the current
+                        # generated length, cannot beat the pool's worst
+                        # (live_scores[r, 0] is the best non-eos candidate
+                        # — selection fills in score order)
+                        worst = min(norm(sc, nl) for sc, _, nl in pools[r])
+                        done[r] = worst >= norm(float(live_scores[r, 0]),
+                                                cur)
+            return parents
+
+        # first token step: every beam is identical, so the top-2k of the
+        # prefill logits ARE the candidates (HF beam_scores init trick)
+        cs0, ci0 = jax.lax.top_k(logp0, 2 * k)
+        select(np.asarray(cs0), np.asarray(ci0))  # parents all 0: no gather
+
+        pos = s
+        for _ in range(max_new_tokens - 1):
+            if done.all():
+                break
+            caches, cand_scores, cand_idx = beam_step(
+                self.params, caches, jnp.asarray(live_seqs[:, :, -1]
+                                                 .reshape(-1), jnp.int32),
+                jnp.asarray(pos, jnp.int32),
+                jnp.asarray(live_scores.reshape(-1), jnp.float32))
+            parents = select(np.asarray(cand_scores), np.asarray(cand_idx))
+            flat_parent = (np.arange(b)[:, None] * k + parents).reshape(-1)
+            if not (flat_parent == np.arange(b * k)).all():
+                # identity permutations (stable beams, done rows, and the
+                # final iteration) skip the full-cache copy
+                caches = cache_gather(caches, jnp.asarray(flat_parent))
+            pos += 1
+
+        # finalize (HF): open rows contribute their live beams to the pool;
+        # output = gen (+ closing eos if finished) + eos padding
+        out = np.full((b, max_new_tokens),
+                      eos if eos is not None else 0, np.int64)
+        longest = 0
+        for r in range(b):
+            hyps = [(sc, g, nl, True) for sc, g, nl in pools[r]]
+            if len(pools[r]) < k or not done[r]:
+                # HF finalize: open live beams normalize by their generated
+                # length (no eos to count)
+                hyps += [(float(live_scores[r, j]), live_seqs[r, j],
+                          live_seqs.shape[2], False) for j in range(k)]
+            best = max(hyps, key=lambda h: norm(h[0], h[2]))
+            gen = np.asarray(best[1], np.int64)
+            if best[3] and eos is not None and len(gen) < max_new_tokens:
+                gen = np.append(gen, eos)
+            gen = gen[:max_new_tokens]
+            out[r, : len(gen)] = gen
+            longest = max(longest, len(gen))
+        # HF crops the batch to the longest returned generation (rows that
+        # finished earlier are eos-padded up to it)
+        return np.concatenate([np.asarray(input_ids), out[:, :longest]],
+                              axis=1)
+
     # -- public API (parity: engine.generate / engine.forward) ----------
     def generate(self, input_ids, max_new_tokens: int = 64,
-                 eos_token_id: Optional[int] = None) -> np.ndarray:
-        """Greedy/sampled decode. input_ids: [b, s] int32 (right-aligned, no
+                 eos_token_id: Optional[int] = None, num_beams: int = 1,
+                 length_penalty: float = 1.0) -> np.ndarray:
+        """Greedy/sampled decode (or beam search when num_beams > 1).
+        input_ids: [b, s] int32 (right-aligned, no
         padding support yet — FastGen-style ragged batching handles mixed
         lengths in inference/ragged.py)."""
         input_ids = jnp.asarray(input_ids, jnp.int32)
+        if num_beams > 1:  # beam search is deterministic (sampling ignored)
+            if max_new_tokens <= 0:
+                return np.asarray(input_ids)
+            return self._generate_beam(input_ids, max_new_tokens, num_beams,
+                                       eos_token_id, length_penalty)
         b, s = input_ids.shape
         if max_new_tokens <= 0:
             return np.asarray(input_ids)

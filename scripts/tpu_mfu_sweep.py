@@ -18,10 +18,17 @@ import sys
 
 CONFIGS = [
     {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective"},
+    # batch is the biggest untried single-chip lever: larger per-step
+    # matmuls amortize dispatch + pad the MXU (HBM is the bound)
+    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective",
+     "DST_BENCH_BS": "16"},
+    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective",
+     "DST_BENCH_BS": "12"},
     {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective",
      "DST_BENCH_CE_CHUNK": "0"},
+    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "full",
+     "DST_BENCH_BS": "16"},
     {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "full"},
-    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "none"},
     {"DST_BENCH_FLASH": "0", "DST_BENCH_REMAT": "selective"},
 ]
 

@@ -113,3 +113,100 @@ def test_generation_overflow_rejected():
     eng = InferenceEngine(model, InferenceConfig(dtype="float32"))
     with pytest.raises(AssertionError):
         eng.generate(_prompt(b=1, s=100), max_new_tokens=100)
+
+
+def test_beam_one_equals_greedy():
+    eng = InferenceEngine(_llama(), InferenceConfig(dtype="float32",
+                                                    temperature=0.0),
+                          rng=jax.random.PRNGKey(0))
+    p = _prompt()
+    greedy = eng.generate(p, max_new_tokens=8)
+    beam1 = eng.generate(p, max_new_tokens=8, num_beams=1)
+    np.testing.assert_array_equal(greedy, beam1)
+
+
+def test_beam_search_matches_torch(tmp_path):
+    """num_beams=4 vs HF beam search, token-exact (eos disabled so the
+    finished-hypothesis pools cannot diverge)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from deepspeed_tpu.checkpoint import from_pretrained
+
+    torch.manual_seed(0)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    d = tmp_path / "llama_beam"
+    hf.save_pretrained(str(d), safe_serialization=True)
+    model, params = from_pretrained(str(d), dtype=jnp.float32)
+
+    prompt = np.random.default_rng(7).integers(1, 250, (2, 8)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(prompt, dtype=torch.long),
+                          max_new_tokens=8, num_beams=4, do_sample=False,
+                          eos_token_id=None, early_stopping=False,
+                          length_penalty=1.0).numpy()
+    eng = dst.init_inference(model=(model, params),
+                             config={"dtype": "fp32", "temperature": 0.0})
+    out = eng.generate(prompt, max_new_tokens=8, num_beams=4)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_beam_eos_matches_torch(tmp_path):
+    """Beam search WITH a firing EOS: the finished-hypothesis pool
+    (add/evict, early_stopping=False is_done, finalize) must reproduce HF
+    token-for-token — the no-eos parity test cannot catch pool bugs."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from deepspeed_tpu.checkpoint import from_pretrained
+
+    torch.manual_seed(0)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    d = tmp_path / "llama_beam_eos"
+    hf.save_pretrained(str(d), safe_serialization=True)
+    model, params = from_pretrained(str(d), dtype=jnp.float32)
+    eng = dst.init_inference(model=(model, params),
+                             config={"dtype": "fp32", "temperature": 0.0})
+
+    for seed in (7, 8, 9):
+        prompt = np.random.default_rng(seed).integers(
+            1, 250, (2, 8)).astype(np.int32)
+        with torch.no_grad():
+            free = hf.generate(torch.tensor(prompt, dtype=torch.long),
+                               max_new_tokens=10, num_beams=4,
+                               do_sample=False, eos_token_id=None,
+                               early_stopping=False).numpy()
+        # an eos that demonstrably fires: a token the best beam emits early
+        eos = int(free[0, prompt.shape[1] + 1])
+        with torch.no_grad():
+            ref = hf.generate(torch.tensor(prompt, dtype=torch.long),
+                              max_new_tokens=10, num_beams=4,
+                              do_sample=False, eos_token_id=eos,
+                              pad_token_id=eos,
+                              early_stopping=False).numpy()
+        out = eng.generate(prompt, max_new_tokens=10, num_beams=4,
+                           eos_token_id=eos)
+        np.testing.assert_array_equal(out, ref, err_msg=f"seed {seed}")
+
+    # b=1 with eos = the best FIRST token: finishes immediately, output
+    # cropped to the longest returned generation like HF
+    prompt = np.random.default_rng(5).integers(1, 250, (1, 8)).astype(np.int32)
+    with torch.no_grad():
+        free = hf.generate(torch.tensor(prompt, dtype=torch.long),
+                           max_new_tokens=10, num_beams=4, do_sample=False,
+                           eos_token_id=None, early_stopping=False).numpy()
+    eos = int(free[0, prompt.shape[1]])
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(prompt, dtype=torch.long),
+                          max_new_tokens=10, num_beams=4, do_sample=False,
+                          eos_token_id=eos, pad_token_id=eos,
+                          early_stopping=False).numpy()
+    out = eng.generate(prompt, max_new_tokens=10, num_beams=4,
+                       eos_token_id=eos)
+    np.testing.assert_array_equal(out, ref)
