@@ -259,19 +259,29 @@ class Transformer:
             return rms_norm(x, w, self.config.norm_eps)
         return layer_norm(x, w, b, self.config.norm_eps)
 
-    def _sp_attention(self, q, k, v):
+    def _sp_attention(self, q, k, v, window=None):
         """Sequence-parallel attention over the bound mesh's seq axis."""
         if self._sp_impl == "ring":
             from ..parallel.ring import ring_attention_sharded
 
+            assert window is None and self.config.attn_scale is None, \
+                "ring attention ignores window/scale — caller must reject"
             return ring_attention_sharded(q, k, v, self._mesh, causal=True)
         from ..parallel.ulysses import DistributedAttention
 
         # after the a2a each device holds FULL sequences for a head subset —
-        # exactly the flash kernel's shape; the dispatcher falls back to the
+        # exactly the flash kernel's shape (so a static sliding window and
+        # scale override apply cleanly); the dispatcher falls back to the
         # jnp path off-TPU / on odd shapes
         local_attn = (flash_attention if self.config.use_flash
                       else dot_product_attention)
+        kw = {}
+        if window is not None:
+            kw["window"] = window
+        if self.config.attn_scale is not None:
+            kw["scale"] = self.config.attn_scale
+        if kw:
+            local_attn = partial(local_attn, **kw)
         return DistributedAttention(local_attn, self._mesh)(q, k, v, causal=True)
 
     def _block(self, x, lp, angles, positions, kv_cache=None, rng=None, training=False,
@@ -349,13 +359,20 @@ class Transformer:
                     "bidirectional encoder + sequence-parallel attention "
                     "not supported yet")
             # attn_window is None here whenever no window binds at this
-            # length (_encode elides them) — Mistral at seq <= window keeps
-            # training under SP; only an actually-binding window raises
-            if attn_window is not None or c.attn_scale is not None:
+            # length (_encode elides them). Ulysses supports static
+            # (uniform) binding windows and scale overrides — the a2a
+            # yields full local sequences so the banded kernel applies;
+            # traced per-layer windows and the ring path do not.
+            if attn_window is not None and not isinstance(attn_window, int):
                 raise NotImplementedError(
-                    "binding attention windows / scale overrides + "
-                    "sequence-parallel attention not supported yet")
-            attn = self._sp_attention(q, kk, vv)
+                    "per-layer-varying attention windows + sequence-"
+                    "parallel attention not supported")
+            if (attn_window is not None or c.attn_scale is not None) \
+                    and self._sp_impl != "ulysses":
+                raise NotImplementedError(
+                    "binding attention windows / scale overrides require "
+                    "ulysses sequence parallelism (ring unsupported)")
+            attn = self._sp_attention(q, kk, vv, window=attn_window)
         elif c.position == "alibi":
             # flash kernel carries no additive bias — use the jnp path
             attn = dot_product_attention(q, kk, vv, causal=True,

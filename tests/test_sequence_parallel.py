@@ -84,18 +84,19 @@ def test_model_trains_with_seq_parallel(impl):
     assert losses[-1] < losses[0]
 
 
-def test_sp_trains_nonbinding_window_and_rejects_binding():
-    """Mistral-style sliding-window configs under a seq mesh: train fine
-    while seq <= window (window statically elided), raise loudly when the
-    window would actually bind."""
+def test_sp_windows_ulysses_trains_ring_rejects():
+    """Mistral-style sliding windows under a seq mesh: Ulysses handles a
+    BINDING uniform window (post-a2a sequences are full, the banded local
+    attention applies) with numerics equal to the dense windowed forward;
+    ring raises loudly."""
     import deepspeed_tpu as dst
     from deepspeed_tpu.models import Llama
     from deepspeed_tpu.runtime.dataloader import shard_batch
 
-    def build(window):
+    def build(window, impl="ulysses"):
         model = Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=4,
                       vocab_size=128, max_seq_len=64, use_flash=False,
-                      remat=False, sp_attention="ulysses",
+                      remat=False, sp_attention=impl,
                       attn_windows=(window, window))
         engine, _, _, _ = dst.initialize(model=model, config={
             "train_batch_size": 4,
@@ -106,13 +107,28 @@ def test_sp_trains_nonbinding_window_and_rejects_binding():
         return model, engine
 
     toks = np.random.default_rng(0).integers(0, 128, (4, 32)).astype(np.int32)
-    model, engine = build(window=32)  # == seq: never binds, SP path runs
+    model, engine = build(window=8)  # binds at seq 32: Ulysses trains
     batch = shard_batch({"input_ids": toks}, engine.topo)
     losses = [float(engine.train_batch(batch)["loss"]) for _ in range(4)]
     assert losses[-1] < losses[0]
 
-    model, engine = build(window=8)  # binds at seq 32: must refuse
-    with pytest.raises(NotImplementedError, match="window"):
+    # non-binding window (== seq): statically elided, plain SP path trains
+    model_nb, engine_nb = build(window=32)
+    batch_nb = shard_batch({"input_ids": toks}, engine_nb.topo)
+    l_nb = [float(engine_nb.train_batch(batch_nb)["loss"]) for _ in range(3)]
+    assert l_nb[-1] < l_nb[0]
+
+    # numerics: SP windowed forward == dense windowed forward, same params
+    dense = Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=4,
+                  vocab_size=128, max_seq_len=64, use_flash=False,
+                  remat=False, attn_windows=(8, 8))
+    params = dense.init(jax.random.PRNGKey(1))
+    ref = np.asarray(dense.apply(params, jnp.asarray(toks)))
+    got = np.asarray(model.apply(params, jnp.asarray(toks)))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    model, engine = build(window=8, impl="ring")  # ring: must refuse
+    with pytest.raises(NotImplementedError, match="ring"):
         engine.train_batch(shard_batch({"input_ids": toks}, engine.topo))
 
 
