@@ -11,12 +11,16 @@ tensors are mapped once into the native stacked-layer pytree
 with ``jax.device_put`` under the model's PartitionSpecs — GSPMD handles
 TP/ZeRO sharding from there; no injection machinery.
 
-Supported families: Llama/Mistral (RMSNorm+RoPE+SwiGLU+GQA), GPT-2
-(Conv1D fused qkv), OPT (learned positions with the +2 offset, ReLU),
-Bloom (ALiBi + embed-norm), GPT-J (interleaved partial rotary, parallel
-residual), GPT-NeoX/Pythia (rotary_pct, dual-norm parallel residual),
-Falcon-7B-style (multi-query, parallel attention), and Mixtral (routed
-experts over the MoE transformer).
+Supported families: Llama/Mistral (RMSNorm+RoPE+SwiGLU+GQA; Mistral
+sliding windows kept exact past the window), Qwen2 (qkv-only biases,
+mixed full/sliding layers), GPT-2 (Conv1D fused qkv), OPT (learned
+positions with the +2 offset, ReLU), Bloom (ALiBi + embed-norm), GPT-J
+(interleaved partial rotary, parallel residual), GPT-NeoX/Pythia
+(rotary_pct, dual-norm parallel residual), GPT-Neo (alternating
+global/local attention, unscaled logits), Falcon-7B-style (multi-query,
+parallel attention), Mixtral (routed experts over the MoE transformer),
+BERT/DistilBERT (post-LN encoders, MLM head), and CLIP (two-tower
+contrastive).
 
 Formats: ``*.safetensors`` (single or index-sharded) and
 ``pytorch_model.bin`` (torch pickle, single or index-sharded).
@@ -141,6 +145,43 @@ def hf_config(model_dir: str):
             rope_theta=hc.get("rope_theta", 10000.0),
             tie_embeddings=hc.get("tie_word_embeddings", False),
             use_bias=False, norm_eps=hc.get("rms_norm_eps", 1e-6))
+    elif family == "qwen2":
+        if hc.get("rope_scaling"):
+            raise NotImplementedError("qwen2 rope_scaling not supported")
+        n_layers = hc["num_hidden_layers"]
+        max_seq = hc.get("max_position_embeddings", 32768)
+        windows = None
+        if hc.get("use_sliding_window", False) and hc.get("sliding_window") \
+                and hc["sliding_window"] < max_seq:
+            w = int(hc["sliding_window"])
+            if "layer_types" in hc:
+                # honor the explicit per-layer pattern (transformers >=4.51
+                # serializes and masks by it; it may be hand-edited)
+                if len(hc["layer_types"]) != n_layers:
+                    raise ValueError(
+                        f"qwen2 layer_types has {len(hc['layer_types'])} "
+                        f"entries for {n_layers} layers")
+                windows = tuple(w if t == "sliding_attention" else 0
+                                for t in hc["layer_types"])
+            else:
+                # legacy derivation: layers below max_window_layers stay
+                # full attention, the rest slide
+                mwl = hc.get("max_window_layers", n_layers)
+                windows = tuple(0 if i < mwl else w
+                                for i in range(n_layers))
+            if not any(windows):
+                windows = None
+        cfg = TransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
+            n_layers=n_layers, n_heads=hc["num_attention_heads"],
+            n_kv_heads=hc.get("num_key_value_heads", hc["num_attention_heads"]),
+            d_ff=hc["intermediate_size"], max_seq_len=max_seq,
+            attn_windows=windows,
+            norm="rms", activation="silu_glu", position="rope",
+            rope_theta=hc.get("rope_theta", 10000.0),  # HF Qwen2Config default
+            tie_embeddings=hc.get("tie_word_embeddings", False),
+            use_bias=False, qkv_bias=True,  # Qwen2: bias on q/k/v only
+            norm_eps=hc.get("rms_norm_eps", 1e-6))
     elif family == "gpt2":
         cfg = TransformerConfig(
             vocab_size=hc["vocab_size"], d_model=hc["n_embd"],
@@ -354,7 +395,7 @@ def hf_config(model_dir: str):
         raise ValueError(f"unsupported HF model_type '{family}' "
                          f"(supported: llama, mistral, gpt2, opt, bloom, "
                          f"gptj, gpt_neo, gpt_neox, falcon, mixtral, bert, "
-                         f"distilbert, clip)")
+                         f"distilbert, clip, qwen2)")
     return family, cfg
 
 
@@ -386,6 +427,10 @@ def _map_llama(state, c) -> Dict[str, Any]:
         "w_up": _stack(state, L + "mlp.up_proj.weight", n, transpose=True),
         "w_down": _stack(state, L + "mlp.down_proj.weight", n, transpose=True),
     }
+    if c.qkv_bias:  # Qwen2-style q/k/v-only biases on the llama layout
+        layers["bq"] = _stack(state, L + "self_attn.q_proj.bias", n)
+        layers["bk"] = _stack(state, L + "self_attn.k_proj.bias", n)
+        layers["bv"] = _stack(state, L + "self_attn.v_proj.bias", n)
     params = {
         "tok_embed": state[pre + "embed_tokens.weight"],
         "layers": layers,
@@ -842,7 +887,7 @@ def _map_clip(state, c) -> Dict[str, Any]:
 
 
 _MAPPERS: Dict[str, Callable] = {
-    "llama": _map_llama, "mistral": _map_llama,
+    "llama": _map_llama, "mistral": _map_llama, "qwen2": _map_llama,
     "gpt2": _map_gpt2, "opt": _map_opt,
     "bloom": _map_bloom, "gptj": _map_gptj, "gpt_neox": _map_gpt_neox,
     "gpt_neo": _map_gpt_neo,

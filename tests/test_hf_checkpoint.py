@@ -74,6 +74,16 @@ def _save_tiny(tmp_path, family: str, safe: bool):
             activation_function="relu", do_layer_norm_before=True,
             word_embed_proj_dim=64)
         m = transformers.OPTForCausalLM(hf_cfg)
+    elif family == "qwen2":
+        # mixed per-layer windows: layer 0 full, layer 1 slides at 8 < the
+        # 16-token parity input, so the varying-window path is exercised
+        hf_cfg = transformers.Qwen2Config(
+            vocab_size=256, hidden_size=64, intermediate_size=176,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, use_sliding_window=True,
+            sliding_window=8, max_window_layers=1,
+            attn_implementation="eager", tie_word_embeddings=False)
+        m = transformers.Qwen2ForCausalLM(hf_cfg)
     elif family == "gpt_neo":
         hf_cfg = transformers.GPTNeoConfig(
             vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -107,7 +117,8 @@ def _save_tiny(tmp_path, family: str, safe: bool):
                                          ("mixtral", True),
                                          ("bert", True),
                                          ("distilbert", True),
-                                         ("gpt_neo", True)])
+                                         ("gpt_neo", True),
+                                         ("qwen2", True)])
 def test_hf_logits_parity(tmp_path, family, safe):
     """Native forward on ingested weights == torch forward (fp32)."""
     hf_model, d = _save_tiny(tmp_path, family, safe)
