@@ -1,0 +1,66 @@
+// Host-side ragged batch construction for the continuous-batching engine.
+//
+// Parity target: the reference keeps FastGen's batch building native —
+// inference/v2/ragged/csrc/fast_host_buffer.cpp builds the flattened
+// token/metadata buffers the ragged kernels consume. Here the same role:
+// given the scheduled per-sequence chunks (concatenated tokens + offsets)
+// fill the flat [T] token/slot/position lanes, and scatter per-sequence
+// block lists into the dense [max_seqs, max_pages] table the paged
+// attention kernel prefetches.
+//
+// Plain C ABI for the ctypes registry (ops/op_builder.py); no torch, no
+// pybind — see csrc/aio/ds_aio.cpp for the house style.
+
+#include <cstdint>
+
+extern "C" {
+
+// tokens_concat: all scheduled chunks back-to-back; offsets: [n+1] chunk
+// boundaries; seens/slots: [n] per scheduled sequence. Fills
+// flat_tokens/flat_slot/flat_pos (caller-allocated [T], pre-filled with
+// padding) and last_index [n] = flat index of each sequence's final token.
+void ds_ragged_build_batch(int32_t n,
+                           const int32_t* tokens_concat,
+                           const int32_t* offsets,
+                           const int32_t* seens,
+                           const int32_t* slots,
+                           int32_t* flat_tokens,
+                           int32_t* flat_slot,
+                           int32_t* flat_pos,
+                           int32_t* last_index) {
+  int32_t cursor = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    const int32_t take = offsets[i + 1] - offsets[i];
+    const int32_t* chunk = tokens_concat + offsets[i];
+    const int32_t seen = seens[i];
+    const int32_t slot = slots[i];
+    for (int32_t j = 0; j < take; ++j) {
+      flat_tokens[cursor + j] = chunk[j];
+      flat_slot[cursor + j] = slot;
+      flat_pos[cursor + j] = seen + j;
+    }
+    cursor += take;
+    last_index[i] = cursor - 1;
+  }
+}
+
+// blocks_concat: every live sequence's block list back-to-back; offsets:
+// [n+1]; slots: [n]. Scatters into tables [max_seqs * max_pages]
+// (caller-zeroed), row-major by slot.
+void ds_ragged_fill_tables(int32_t n,
+                           const int32_t* blocks_concat,
+                           const int32_t* offsets,
+                           const int32_t* slots,
+                           int32_t max_pages,
+                           int32_t* tables) {
+  for (int32_t i = 0; i < n; ++i) {
+    const int32_t count = offsets[i + 1] - offsets[i];
+    const int32_t* blocks = blocks_concat + offsets[i];
+    int32_t* row = tables + static_cast<int64_t>(slots[i]) * max_pages;
+    for (int32_t j = 0; j < count && j < max_pages; ++j) {
+      row[j] = blocks[j];
+    }
+  }
+}
+
+}  // extern "C"

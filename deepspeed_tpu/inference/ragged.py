@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.norms import layer_norm, rms_norm
+from ..ops.ragged_host import build_batch, fill_tables
 from ..ops.rotary import apply_rotary, rope_frequencies
 from ..utils.logging import log_dist
 from .engine import _sample
@@ -287,22 +288,21 @@ class RaggedInferenceEngine:
         # 4096-lane forward (one compile per bucket, cached by jit)
         scheduled = sum(take for _, take in sched)
         T = next(b for b in self._buckets if b >= scheduled)
-        flat_tokens = np.zeros((T,), np.int32)
-        flat_slot = np.full((T,), -1, np.int32)
-        flat_pos = np.zeros((T,), np.int32)
-        last_index = {}  # uid -> index in flat batch of its last token
-        cursor = 0
+        chunks, seens_l, slots_l = [], [], []
         for (seq, take), need in zip(sched, needs):
-            new_total = seq.seen + take
             if need > 0:
                 seq.blocks.extend(self.allocator.allocate(need))
-            chunk = seq.tokens[seq.seen:seq.seen + take]
-            flat_tokens[cursor:cursor + take] = chunk
-            flat_slot[cursor:cursor + take] = seq.slot
-            flat_pos[cursor:cursor + take] = np.arange(seq.seen, new_total)
-            seq.seen = new_total
-            last_index[seq.uid] = cursor + take - 1
-            cursor += take
+            chunks.append(seq.tokens[seq.seen:seq.seen + take])
+            seens_l.append(seq.seen)
+            slots_l.append(seq.slot)
+        # flat-lane construction on the native host-buffer builder
+        # (reference fast_host_buffer.cpp); numpy fallback is bit-identical
+        flat_tokens, flat_slot, flat_pos, last_idx = build_batch(
+            chunks, seens_l, slots_l, T)
+        last_index = {}  # uid -> index in flat batch of its last token
+        for (seq, take), li in zip(sched, last_idx):
+            seq.seen += take
+            last_index[seq.uid] = int(li)
 
         block_tables = self._host_tables()
 
@@ -340,10 +340,9 @@ class RaggedInferenceEngine:
                 "sequences first")
 
     def _host_tables(self) -> np.ndarray:
-        tables = np.zeros((self.config.max_seqs, self.max_pages), np.int32)
-        for seq in self.seqs.values():
-            tables[seq.slot, :len(seq.blocks)] = seq.blocks
-        return tables
+        live = list(self.seqs.values())
+        return fill_tables([s.blocks for s in live], [s.slot for s in live],
+                           self.config.max_seqs, self.max_pages)
 
     def _live_pages_bucket(self) -> int:
         """Static page-walk bound for this step: smallest power of two >=

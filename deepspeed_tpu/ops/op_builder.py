@@ -106,8 +106,25 @@ class AsyncIOBuilder(OpBuilder):
         lib.ds_aio_inflight.argtypes = [p]
 
 
+class RaggedHostBuilder(OpBuilder):
+    """Host-side ragged batch building (reference
+    inference/v2/ragged/csrc/fast_host_buffer.cpp analog)."""
+
+    NAME = "ds_ragged_host"
+    SOURCES = ["ragged/ds_ragged_host.cpp"]
+
+    def _configure(self, lib: ctypes.CDLL) -> None:
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.ds_ragged_build_batch.restype = None
+        lib.ds_ragged_build_batch.argtypes = [ctypes.c_int32] + [i32p] * 8
+        lib.ds_ragged_fill_tables.restype = None
+        lib.ds_ragged_fill_tables.argtypes = \
+            [ctypes.c_int32] + [i32p] * 3 + [ctypes.c_int32, i32p]
+
+
 ALL_OPS: Dict[str, type] = {
     AsyncIOBuilder.NAME: AsyncIOBuilder,
+    RaggedHostBuilder.NAME: RaggedHostBuilder,
 }
 
 
