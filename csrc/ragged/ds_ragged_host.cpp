@@ -46,21 +46,29 @@ void ds_ragged_build_batch(int32_t n,
 
 // blocks_concat: every live sequence's block list back-to-back; offsets:
 // [n+1]; slots: [n]. Scatters into tables [max_seqs * max_pages]
-// (caller-zeroed), row-major by slot.
-void ds_ragged_fill_tables(int32_t n,
-                           const int32_t* blocks_concat,
-                           const int32_t* offsets,
-                           const int32_t* slots,
-                           int32_t max_pages,
-                           int32_t* tables) {
+// (caller-zeroed), row-major by slot. Returns the number of sequences
+// whose block list exceeded max_pages — such rows are written only up to
+// max_pages (no OOB), and a non-zero return is an engine invariant
+// violation the wrapper raises on (never silently truncate into wrong
+// attention reads).
+int32_t ds_ragged_fill_tables(int32_t n,
+                              const int32_t* blocks_concat,
+                              const int32_t* offsets,
+                              const int32_t* slots,
+                              int32_t max_pages,
+                              int32_t* tables) {
+  int32_t overflowed = 0;
   for (int32_t i = 0; i < n; ++i) {
     const int32_t count = offsets[i + 1] - offsets[i];
+    if (count > max_pages) ++overflowed;
     const int32_t* blocks = blocks_concat + offsets[i];
     int32_t* row = tables + static_cast<int64_t>(slots[i]) * max_pages;
-    for (int32_t j = 0; j < count && j < max_pages; ++j) {
+    const int32_t lim = count < max_pages ? count : max_pages;
+    for (int32_t j = 0; j < lim; ++j) {
       row[j] = blocks[j];
     }
   }
+  return overflowed;
 }
 
 }  // extern "C"

@@ -139,16 +139,16 @@ class RaggedInferenceEngine:
             raise NotImplementedError(
                 "RaggedInferenceEngine does not support ALiBi or parallel-"
                 "residual families yet; use InferenceEngine (dense KV cache)")
-        if c.window_binds(self.config.max_context) \
-                or getattr(c, "attn_scale", None) is not None:
-            # windows that never bind within max_context are plain causal —
-            # serve those (Mistral with max_context <= sliding_window);
-            # anything that would actually trim the page walk is unsupported
+        if getattr(c, "attn_scale", None) is not None:
             raise NotImplementedError(
-                "RaggedInferenceEngine does not implement sliding-window "
-                "paged attention (window < max_context) or attention-scale "
-                "overrides; cap max_context at the window or use "
-                "InferenceEngine (dense KV cache)")
+                "RaggedInferenceEngine does not support attention-scale "
+                "overrides (GPT-Neo); use InferenceEngine (dense KV cache)")
+        if c.window_binds(self.config.max_context):
+            # sliding windows that bind within max_context (Mistral/Qwen2
+            # long-context serving) run on the banded gather path — the
+            # Pallas kernel's trimmed page walk is a later optimization
+            log_dist("RaggedInferenceEngine: binding sliding window — "
+                     "using the banded gather attention path")
         if self.config.max_context % self.config.kv_block_size != 0:
             raise ValueError(
                 f"max_context {self.config.max_context} must be a multiple of "
@@ -499,9 +499,17 @@ class RaggedInferenceEngine:
         c = model.config
         cfg = self.config
         bs = cfg.kv_block_size
+        # per-layer sliding windows (static tuple; 0 = global causal);
+        # a binding window forces the gather path for ALL layers — mixed
+        # kernel/gather would duplicate the table plumbing for no win
+        aw = getattr(c, "attn_windows", None)
+        windows = tuple(int(w) if 0 < int(w) < cfg.max_context else 0
+                        for w in aw) if aw is not None \
+            else (0,) * c.n_layers
         use_pallas = _use_pallas_paged(
             c.head_dim, bs, self.config.dtype,
-            scalar_ints=cfg.max_seqs * self.max_pages + 2 * cfg.token_budget)
+            scalar_ints=cfg.max_seqs * self.max_pages + 2 * cfg.token_budget) \
+            and not any(windows)
 
         def norm(x, w, b=None):
             return rms_norm(x, w, c.norm_eps) if c.norm == "rms" \
@@ -565,7 +573,8 @@ class RaggedInferenceEngine:
                                            live_pages=live_pages)
                 else:
                     attn = paged_attention_reference(q, kp, vp, tables,
-                                                     positions)
+                                                     positions,
+                                                     window=windows[li])
                 attn = attn.astype(x.dtype)
                 attn = attn.reshape(-1, c.n_heads * c.head_dim) @ lp["wo"]
                 if c.use_bias:

@@ -117,7 +117,7 @@ class RaggedHostBuilder(OpBuilder):
         i32p = ctypes.POINTER(ctypes.c_int32)
         lib.ds_ragged_build_batch.restype = None
         lib.ds_ragged_build_batch.argtypes = [ctypes.c_int32] + [i32p] * 8
-        lib.ds_ragged_fill_tables.restype = None
+        lib.ds_ragged_fill_tables.restype = ctypes.c_int32
         lib.ds_ragged_fill_tables.argtypes = \
             [ctypes.c_int32] + [i32p] * 3 + [ctypes.c_int32, i32p]
 

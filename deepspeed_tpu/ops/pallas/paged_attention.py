@@ -189,9 +189,11 @@ def paged_attention(q, k_pool, v_pool, tables, positions, *,
 
 
 def paged_attention_reference(q, k_pool, v_pool, tables, positions, *,
-                              scale=None):
+                              scale=None, window: int = 0):
     """jnp reference (gather-based) with identical semantics — the numerics
-    oracle for the kernel and the off-TPU fallback formulation."""
+    oracle for the kernel and the off-TPU fallback formulation.
+    ``window`` > 0 bands attention to the trailing ``window`` positions
+    (sliding-window serving: k > pos - window)."""
     T, hq, hd = q.shape
     n_pages, hkv, block, _ = k_pool.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(hd)
@@ -207,6 +209,8 @@ def paged_attention_reference(q, k_pool, v_pool, tables, positions, *,
                         keys.astype(jnp.float32)) * scale
     kv_pos = jnp.arange(keys.shape[1])[None, :]
     visible = kv_pos <= positions[:, None]
+    if window > 0:
+        visible = visible & (kv_pos > positions[:, None] - window)
     logits = jnp.where(visible[:, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("thk,tkhd->thd", probs,

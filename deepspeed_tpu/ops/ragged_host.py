@@ -107,9 +107,11 @@ def fill_tables(block_lists: Sequence[Sequence[int]], slots: Sequence[int],
 
     lib = _lib()
     if lib is not None:
-        lib.ds_ragged_fill_tables(
+        overflowed = lib.ds_ragged_fill_tables(
             np.int32(n), _i32p(concat), _i32p(offsets), _i32p(slots_a),
             np.int32(max_pages), _i32p(tables))
+        if overflowed:  # unreachable past the pre-check; belt and braces
+            raise ValueError(f"{overflowed} block lists exceed max_pages")
         return tables
 
     for i in range(n):

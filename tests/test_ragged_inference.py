@@ -216,27 +216,38 @@ def test_ragged_serves_relu_activation():
     np.testing.assert_array_equal(np.asarray(out[1]), ref[0, 8:])
 
 
-def test_window_models_served_only_when_window_never_binds():
-    """Sliding-window configs (Mistral) are served when max_context <=
-    window (plain causal at that length) and rejected loudly when the
-    window would actually trim attention."""
-    def _win_llama(w):
+def test_windowed_models_serve_on_gather_path():
+    """Sliding-window models (Mistral/Qwen2 long-context) serve in the
+    ragged engine: a BINDING window decodes token-exactly vs the dense
+    KV-cache engine (itself torch-verified), including mixed per-layer
+    windows; windows that never bind match the window-free engine."""
+    def _win_llama(windows):
         return Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=2,
                      vocab_size=128, max_seq_len=256, use_flash=False,
-                     remat=False, attn_windows=(w, w))
+                     remat=False, attn_windows=windows)
 
-    with pytest.raises(NotImplementedError, match="sliding-window"):
-        RaggedInferenceEngine(_win_llama(8), _cfg())  # 8 < max_context 128
-
-    eng = RaggedInferenceEngine(_win_llama(128), _cfg(),
-                                rng=jax.random.PRNGKey(0))  # never binds
     rng = np.random.default_rng(30)
-    prompt = rng.integers(1, 128, (10,)).tolist()
-    out = eng.generate({0: list(prompt)}, max_new_tokens=8)
+    prompt = rng.integers(1, 128, (20,)).tolist()  # > window 8: binds
+    for windows in ((8, 8), (0, 8)):  # uniform and mixed per-layer
+        model = _win_llama(windows)
+        params = model.init(jax.random.PRNGKey(0))
+        ragged = RaggedInferenceEngine(model, _cfg(), params=params)
+        out = ragged.generate({0: list(prompt)}, max_new_tokens=10)
+        dense = InferenceEngine(model, InferenceConfig(dtype="float32",
+                                                       temperature=0.0),
+                                params=params)
+        ref = dense.generate(np.asarray([prompt], np.int32),
+                             max_new_tokens=10)
+        assert out[0] == ref[0, len(prompt):].tolist(), (windows, out[0])
+
+    eng = RaggedInferenceEngine(_win_llama((128, 128)), _cfg(),
+                                rng=jax.random.PRNGKey(0))  # never binds
+    short = rng.integers(1, 128, (10,)).tolist()
+    out = eng.generate({0: list(short)}, max_new_tokens=8)
     ref_eng = RaggedInferenceEngine(_llama(), _cfg(),
                                     rng=jax.random.PRNGKey(0))
     # same weights seed + window-free math at this length => same tokens
-    assert out[0] == ref_eng.generate({0: list(prompt)}, max_new_tokens=8)[0]
+    assert out[0] == ref_eng.generate({0: list(short)}, max_new_tokens=8)[0]
 
 
 def test_sampled_decode_chunk_invariant_and_seeded():
