@@ -53,8 +53,10 @@ class MoETransformerConfig(TransformerConfig):
         return self._shared_param_count() + n * active_moe
 
     def flops_per_token(self, seq_len: int) -> float:
-        """MoE FLOPs count only the experts a token routes through."""
-        return 6.0 * self.active_param_count() + 12.0 * self.n_layers * self.d_model * seq_len
+        """MoE FLOPs count only the experts a token routes through (and
+        the shared window-aware attention term)."""
+        return 6.0 * self.active_param_count() \
+            + 12.0 * self.d_model * self._attn_flop_len(seq_len)
 
 
 class MoETransformer(Transformer):

@@ -155,9 +155,19 @@ class TransformerConfig:
             mlp += f + d
         return self._shared_param_count() + n * mlp
 
+    def _attn_flop_len(self, seq_len: int) -> int:
+        """Summed per-layer attention lengths: sliding-window layers attend
+        at most ``window`` keys, so min(seq, window) — keeps MFU honest for
+        windowed models (shared by the dense and MoE flops counts)."""
+        if self.attn_windows is not None:
+            return sum(min(seq_len, w) if w > 0 else seq_len
+                       for w in self.attn_windows)
+        return self.n_layers * seq_len
+
     def flops_per_token(self, seq_len: int) -> float:
         """Forward+backward FLOPs/token (standard 6N + attention term)."""
-        return 6.0 * self.param_count() + 12.0 * self.n_layers * self.d_model * seq_len
+        return 6.0 * self.param_count() \
+            + 12.0 * self.d_model * self._attn_flop_len(seq_len)
 
 
 class Transformer:
