@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# One-command on-chip evidence refresh, run when the TPU tunnel is up:
+#   bash scripts/tpu_roundup.sh
+# Each stage claims the chip in its own python process (never run two at
+# once through the axon relay — see .claude/skills/verify/SKILL.md) and
+# writes its committed artifact. Stages are independent; a failure moves
+# on so one flaky claim doesn't void the rest.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== [1/4] compiled-kernel lane (flash incl. windowed, paged) =="
+DST_TPU_TESTS=1 python -m pytest tests/test_tpu_kernels.py -q || true
+
+echo "== [2/4] kernel numerics + perf report (TPU_KERNEL_CHECK) =="
+python scripts/tpu_flash_check.py || true
+
+echo "== [3/4] MFU sweep (flash x remat x ce-chunk x batch) =="
+python scripts/tpu_mfu_sweep.py || true
+
+echo "== [4/4] ragged decode benchmark (TPU_DECODE_BENCH) =="
+python scripts/tpu_decode_bench.py || true
+
+echo "== headline bench =="
+python bench.py || true
