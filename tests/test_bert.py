@@ -136,6 +136,26 @@ def test_causal_model_rejects_attention_mask():
         model.apply(params, toks, attn_mask=jnp.ones((1, 8)))
 
 
+def test_mlm_finetune_dp_tp_sharded():
+    """Encoder MLM training composes with dp x tp ZeRO-2 (the TP specs
+    cover the encoder-only params: type embeddings, MLM head, pooler)."""
+    model = _tiny_bert()
+    engine, _, _, _ = dst.initialize(
+        model=model,
+        config={"train_batch_size": 8, "mesh": {"data": 4, "model": 2},
+                "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+                "zero_optimization": {"stage": 2}})
+    rng = np.random.default_rng(9)
+    toks = rng.integers(1, 128, (8, 16)).astype(np.int32)
+    mask = (rng.random((8, 16)) < 0.3).astype(np.float32)
+    batch = shard_batch(
+        {"input_ids": np.where(mask > 0, 3, toks).astype(np.int32),
+         "labels": toks, "loss_mask": mask,
+         "token_type_ids": np.zeros_like(toks)}, engine.topo)
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
+
+
 def test_mlm_finetune_step():
     """Masked-LM objective through the full engine: 15%-style masking via
     labels + loss_mask; loss decreases over a few steps."""
