@@ -101,6 +101,13 @@ class TransformerConfig:
             assert len(self.attn_windows) == self.n_layers, (
                 f"attn_windows has {len(self.attn_windows)} entries for "
                 f"{self.n_layers} layers")
+            if not self.causal:
+                # every window path (banded kernel, masks, paged gather)
+                # implements the CAUSAL band k > q - w; a bidirectional
+                # model would silently get causal attention
+                raise ValueError(
+                    "attn_windows requires a causal model "
+                    "(sliding windows are a decoder feature)")
         if self.d_ff is None:
             if self.activation == "silu_glu":
                 self.d_ff = int(8 * self.d_model / 3 / 128 + 1) * 128
@@ -269,20 +276,23 @@ class Transformer:
             return rms_norm(x, w, self.config.norm_eps)
         return layer_norm(x, w, b, self.config.norm_eps)
 
-    def _sp_attention(self, q, k, v, window=None):
+    def _sp_attention(self, q, k, v, window=None, causal=True):
         """Sequence-parallel attention over the bound mesh's seq axis."""
         if self._sp_impl == "ring":
             from ..parallel.ring import ring_attention_sharded
 
-            assert window is None and self.config.attn_scale is None, \
-                "ring attention ignores window/scale — caller must reject"
+            assert window is None and self.config.attn_scale is None \
+                and causal, \
+                "ring attention is causal-only, no window/scale — caller " \
+                "must reject"
             return ring_attention_sharded(q, k, v, self._mesh, causal=True)
         from ..parallel.ulysses import DistributedAttention
 
         # after the a2a each device holds FULL sequences for a head subset —
         # exactly the flash kernel's shape (so a static sliding window and
-        # scale override apply cleanly); the dispatcher falls back to the
-        # jnp path off-TPU / on odd shapes
+        # scale override apply cleanly, and bidirectional encoders work
+        # unchanged); the dispatcher falls back to the jnp path off-TPU /
+        # on odd shapes
         local_attn = (flash_attention if self.config.use_flash
                       else dot_product_attention)
         kw = {}
@@ -292,7 +302,8 @@ class Transformer:
             kw["scale"] = self.config.attn_scale
         if kw:
             local_attn = partial(local_attn, **kw)
-        return DistributedAttention(local_attn, self._mesh)(q, k, v, causal=True)
+        return DistributedAttention(local_attn, self._mesh)(q, k, v,
+                                                            causal=causal)
 
     def _block(self, x, lp, angles, positions, kv_cache=None, rng=None, training=False,
                attn_mask=None, attn_window=None):
@@ -364,25 +375,29 @@ class Transformer:
             if c.position == "alibi":
                 raise NotImplementedError(
                     "ALiBi + sequence-parallel attention not supported yet")
-            if not c.causal:
-                raise NotImplementedError(
-                    "bidirectional encoder + sequence-parallel attention "
-                    "not supported yet")
             # attn_window is None here whenever no window binds at this
             # length (_encode elides them). Ulysses supports static
-            # (uniform) binding windows and scale overrides — the a2a
-            # yields full local sequences so the banded kernel applies;
-            # traced per-layer windows and the ring path do not.
+            # (uniform) binding windows, scale overrides, and
+            # bidirectional encoders — the a2a yields full local
+            # sequences; traced per-layer windows and the (causal-only)
+            # ring path do not.
             if attn_window is not None and not isinstance(attn_window, int):
                 raise NotImplementedError(
                     "per-layer-varying attention windows + sequence-"
                     "parallel attention not supported")
-            if (attn_window is not None or c.attn_scale is not None) \
-                    and self._sp_impl != "ulysses":
+            if (attn_window is not None or c.attn_scale is not None
+                    or not c.causal) and self._sp_impl != "ulysses":
                 raise NotImplementedError(
-                    "binding attention windows / scale overrides require "
-                    "ulysses sequence parallelism (ring unsupported)")
-            attn = self._sp_attention(q, kk, vv, window=attn_window)
+                    "binding attention windows / scale overrides / "
+                    "bidirectional encoders require ulysses sequence "
+                    "parallelism (ring is causal-only)")
+            if not c.causal and attn_mask is not None:
+                raise NotImplementedError(
+                    "encoder padding masks not threaded through sequence-"
+                    "parallel attention yet — drop the seq axis or pack "
+                    "unpadded batches")
+            attn = self._sp_attention(q, kk, vv, window=attn_window,
+                                      causal=c.causal)
         elif c.position == "alibi":
             # flash kernel carries no additive bias — use the jnp path
             attn = dot_product_attention(q, kk, vv, causal=True,

@@ -80,6 +80,13 @@ def test_distilbert_has_no_type_embeddings():
     assert out.shape == (1, 8, 128) and np.isfinite(out).all()
 
 
+def test_encoder_rejects_sliding_windows():
+    """Windowed attention implements the causal band only — a
+    bidirectional config with attn_windows must fail at construction."""
+    with pytest.raises(ValueError, match="causal"):
+        _tiny_bert(attn_windows=(8, 8))
+
+
 def test_encoder_rejects_kv_cache():
     model = _tiny_bert()
     params = model.init(jax.random.PRNGKey(0))
@@ -154,6 +161,45 @@ def test_mlm_finetune_dp_tp_sharded():
          "token_type_ids": np.zeros_like(toks)}, engine.topo)
     losses = [float(engine.train_batch(batch)["loss"]) for _ in range(5)]
     assert losses[-1] < losses[0], losses
+
+
+def test_encoder_ulysses_sequence_parallel():
+    """Bidirectional encoders compose with Ulysses SP: the seq-mesh
+    forward matches the dense forward, MLM trains on a dp x seq mesh,
+    and the causal-only ring impl rejects encoders loudly."""
+    def build(impl):
+        model = Bert("tiny", vocab_size=128, max_seq_len=32, n_heads=4,
+                     use_flash=False, remat=False, sp_attention=impl)
+        engine, _, _, _ = dst.initialize(model=model, config={
+            "train_batch_size": 4,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "mesh": {"data": 2, "seq": 4},
+            "steps_per_print": 1000})
+        return model, engine
+
+    rng = np.random.default_rng(13)
+    toks = rng.integers(1, 128, (4, 32)).astype(np.int32)
+    model, engine = build("ulysses")
+
+    dense = Bert("tiny", vocab_size=128, max_seq_len=32, n_heads=4,
+                 use_flash=False, remat=False)
+    params = dense.init(jax.random.PRNGKey(2))
+    ref = np.asarray(dense.apply(params, jnp.asarray(toks)))
+    got = np.asarray(model.apply(params, jnp.asarray(toks)))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    mask = (rng.random((4, 32)) < 0.3).astype(np.float32)
+    batch = shard_batch(
+        {"input_ids": np.where(mask > 0, 3, toks).astype(np.int32),
+         "labels": toks, "loss_mask": mask}, engine.topo)
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(4)]
+    assert losses[-1] < losses[0], losses
+
+    model_r, engine_r = build("ring")
+    with pytest.raises(NotImplementedError, match="causal-only"):
+        engine_r.train_batch(shard_batch(
+            {"input_ids": toks, "labels": toks,
+             "loss_mask": np.ones_like(toks, np.float32)}, engine_r.topo))
 
 
 def test_mlm_finetune_step():
