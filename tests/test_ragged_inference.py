@@ -157,15 +157,36 @@ def test_can_schedule_and_slot_exhaustion():
         eng.put([3], [[5, 6]])
 
 
+def _assert_ragged_matches_dense(model, params, prompts, max_new_tokens):
+    """Shared ragged-vs-dense greedy parity scaffold: serve ``prompts``
+    (uid -> tokens) through the ragged engine, compare token-exact against
+    the dense-KV engine row by row."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.parallel.mesh import reset_topology
+
+    reset_topology()
+    eng = RaggedInferenceEngine(
+        model, RaggedConfig(token_budget=64, max_seqs=4, kv_block_size=8,
+                            n_kv_blocks=64, max_context=64,
+                            dtype=jnp.float32), params=params)
+    out = eng.generate({k: list(v) for k, v in prompts.items()},
+                       max_new_tokens=max_new_tokens)
+    reset_topology()
+    dense = dst.init_inference(model=(model, params),
+                               config={"dtype": "fp32", "temperature": 0.0})
+    for uid, prompt in prompts.items():
+        ref = dense.generate(np.asarray([prompt], np.int32),
+                             max_new_tokens=max_new_tokens)
+        np.testing.assert_array_equal(np.asarray(out[uid]),
+                                      ref[0, len(prompt):], err_msg=f"uid {uid}")
+
+
 def test_ragged_serves_moe_model():
     """FastGen + MoE (the reference's Mixtral-class serving): ragged
     continuous batching over a GPTMoE model matches the dense-KV engine's
     greedy decode."""
-    import deepspeed_tpu as dst
     from deepspeed_tpu.models import GPTMoE
-    from deepspeed_tpu.parallel.mesh import reset_topology
 
-    reset_topology()
     # n_experts > top_k: routing is genuinely selective, so this also
     # proves the no-drop grouped-GEMM dispatch (capacity semantics would
     # make logits depend on co-scheduled traffic)
@@ -173,81 +194,34 @@ def test_ragged_serves_moe_model():
                    n_heads=4, n_kv_heads=4, vocab_size=64, max_seq_len=64,
                    use_flash=False, remat=False)
     params = model.init(jax.random.PRNGKey(0))
-    prompts = {7: list(range(1, 9)), 9: list(range(20, 30))}
+    _assert_ragged_matches_dense(
+        model, params, {7: list(range(1, 9)), 9: list(range(20, 30))}, 6)
 
-    eng = RaggedInferenceEngine(
-        model, RaggedConfig(token_budget=64, max_seqs=4, kv_block_size=8,
-                            n_kv_blocks=64, max_context=64,
-                            dtype=jnp.float32), params=params)
-    out = eng.generate(prompts, max_new_tokens=6)
 
-    reset_topology()
-    dense = dst.init_inference(model=(model, params),
-                               config={"dtype": "fp32", "temperature": 0.0})
-    for uid, prompt in prompts.items():
-        ref = dense.generate(np.asarray([prompt], np.int32), max_new_tokens=6)
-        np.testing.assert_array_equal(np.asarray(out[uid]),
-                                      ref[0, len(prompt):])
+def test_ragged_serves_windowed_moe():
+    """Mixtral-class serving: routed experts + a BINDING sliding window
+    in the ragged engine, token-exact vs the dense-KV engine."""
+    from deepspeed_tpu.models import GPTMoE
+
+    model = GPTMoE("tiny", n_experts=4, top_k=1, n_layers=2, d_model=32,
+                   n_heads=4, n_kv_heads=4, vocab_size=64, max_seq_len=64,
+                   use_flash=False, remat=False, attn_windows=(8, 8))
+    params = model.init(jax.random.PRNGKey(0))
+    # prompt 14 > window 8: the band binds during decode
+    _assert_ragged_matches_dense(model, params, {3: list(range(1, 15))}, 8)
 
 
 def test_ragged_serves_relu_activation():
     """OPT-style relu MLP must not silently become gelu in the ragged step."""
-    import deepspeed_tpu as dst
     from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
-    from deepspeed_tpu.parallel.mesh import reset_topology
 
-    reset_topology()
     cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
                             max_seq_len=64, norm="layer", activation="relu",
                             position="learned", use_bias=True,
                             use_flash=False, remat=False)
     model = Transformer(cfg)
     params = model.init(jax.random.PRNGKey(1))
-    prompts = {1: list(range(1, 9))}
-    eng = RaggedInferenceEngine(
-        model, RaggedConfig(token_budget=64, max_seqs=4, kv_block_size=8,
-                            n_kv_blocks=64, max_context=64,
-                            dtype=jnp.float32), params=params)
-    out = eng.generate(prompts, max_new_tokens=6)
-    reset_topology()
-    dense = dst.init_inference(model=(model, params),
-                               config={"dtype": "fp32", "temperature": 0.0})
-    ref = dense.generate(np.asarray([prompts[1]], np.int32), max_new_tokens=6)
-    np.testing.assert_array_equal(np.asarray(out[1]), ref[0, 8:])
-
-
-def test_windowed_models_serve_on_gather_path():
-    """Sliding-window models (Mistral/Qwen2 long-context) serve in the
-    ragged engine: a BINDING window decodes token-exactly vs the dense
-    KV-cache engine (itself torch-verified), including mixed per-layer
-    windows; windows that never bind match the window-free engine."""
-    def _win_llama(windows):
-        return Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=2,
-                     vocab_size=128, max_seq_len=256, use_flash=False,
-                     remat=False, attn_windows=windows)
-
-    rng = np.random.default_rng(30)
-    prompt = rng.integers(1, 128, (20,)).tolist()  # > window 8: binds
-    for windows in ((8, 8), (0, 8)):  # uniform and mixed per-layer
-        model = _win_llama(windows)
-        params = model.init(jax.random.PRNGKey(0))
-        ragged = RaggedInferenceEngine(model, _cfg(), params=params)
-        out = ragged.generate({0: list(prompt)}, max_new_tokens=10)
-        dense = InferenceEngine(model, InferenceConfig(dtype="float32",
-                                                       temperature=0.0),
-                                params=params)
-        ref = dense.generate(np.asarray([prompt], np.int32),
-                             max_new_tokens=10)
-        assert out[0] == ref[0, len(prompt):].tolist(), (windows, out[0])
-
-    eng = RaggedInferenceEngine(_win_llama((128, 128)), _cfg(),
-                                rng=jax.random.PRNGKey(0))  # never binds
-    short = rng.integers(1, 128, (10,)).tolist()
-    out = eng.generate({0: list(short)}, max_new_tokens=8)
-    ref_eng = RaggedInferenceEngine(_llama(), _cfg(),
-                                    rng=jax.random.PRNGKey(0))
-    # same weights seed + window-free math at this length => same tokens
-    assert out[0] == ref_eng.generate({0: list(short)}, max_new_tokens=8)[0]
+    _assert_ragged_matches_dense(model, params, {1: list(range(1, 9))}, 6)
 
 
 def test_sampled_decode_chunk_invariant_and_seeded():
