@@ -34,6 +34,18 @@ def op_compatibility() -> List[Tuple[str, bool, str]]:
         ("int8 quantization kernels", True, "jnp path; pallas on TPU"),
         ("async checkpoint (orbax)", _has("orbax.checkpoint"), ""),
     ]
+    # genuinely-native (C++) ops: report per-op buildability like the
+    # reference's DS_BUILD matrix does for its extensions (absolute import
+    # so `python deepspeed_tpu/env_report.py` works script-style too)
+    try:
+        from deepspeed_tpu.ops.op_builder import op_report
+
+        for name, compatible, built in sorted(op_report()):
+            note = "prebuilt" if built else \
+                ("jit-builds on first use" if compatible else "sources missing")
+            rows.append((f"native {name} (C++)", compatible, note))
+    except Exception as e:  # report, never crash the report
+        rows.append(("native ops registry", False, str(e)[:60]))
     return rows
 
 
