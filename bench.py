@@ -105,8 +105,13 @@ def _child_main():
     tok_per_sec = tokens_per_step * steps / dt
     flops_per_token = model.config.flops_per_token(seq_len)
     mfu = tok_per_sec * flops_per_token / peak_flops_per_chip(jax.devices()[0].device_kind)
+    # CPU fallback rows get a distinct metric name so a consumer reading
+    # metric+value alone is never misled into comparing smoke-model CPU
+    # numbers against the TPU headline.
+    metric = ("llama_350m_train_tokens_per_sec_per_chip" if on_tpu
+              else "cpu_fallback_smoke_tokens_per_sec")
     print(json.dumps({
-        "metric": "llama_350m_train_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": round(tok_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
@@ -201,7 +206,7 @@ def main():
         return 0
     # last resort: still emit parseable JSON rather than crashing the driver
     print(json.dumps({
-        "metric": "llama_350m_train_tokens_per_sec_per_chip",
+        "metric": "bench_failed",
         "value": 0.0,
         "unit": "tokens/s",
         "vs_baseline": 0.0,

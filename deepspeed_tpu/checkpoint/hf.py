@@ -131,9 +131,10 @@ def hf_config(model_dir: str):
         # Mistral sliding window: the full position table stays usable
         # (decode past the window is exact); every layer attends the
         # trailing `window` positions. The core elides the window math —
-        # and keeps flash — whenever seq <= window; a BINDING window uses
-        # the masked O(s^2) jnp path, so cap non-cached forwards
-        # accordingly (see TransformerConfig.attn_windows)
+        # and keeps dense flash — whenever seq <= window; a BINDING
+        # uniform window dispatches the banded flash kernel at
+        # O(s*window); only per-layer-varying windows fall back to the
+        # masked O(s^2) jnp path (see TransformerConfig.attn_windows)
         windows = _uniform_windows(window, max_seq, n_layers)
         cfg = TransformerConfig(
             vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
@@ -787,6 +788,13 @@ def _map_bert(state, c) -> Dict[str, Any]:
         params["mlm_norm_w"] = state["cls.predictions.transform.LayerNorm.weight"]
         params["mlm_norm_b"] = state["cls.predictions.transform.LayerNorm.bias"]
         params["mlm_bias"] = state["cls.predictions.bias"]
+        # HF normally ties cls.predictions.decoder to the word embeddings,
+        # but a tie_word_embeddings=false fine-tune unties it; silently
+        # keeping the tie would load cleanly yet emit wrong MLM logits.
+        dec = state.get("cls.predictions.decoder.weight")
+        if dec is not None and (dec.shape != params["tok_embed"].shape
+                                or not np.array_equal(dec, params["tok_embed"])):
+            params["lm_head"] = dec.T  # untied decoder: [vocab, d] -> [d, vocab]
     if pre + "pooler.dense.weight" in state:
         params["pooler_w"] = state[pre + "pooler.dense.weight"].T
         params["pooler_b"] = state[pre + "pooler.dense.bias"]
@@ -828,6 +836,10 @@ def _map_distilbert(state, c) -> Dict[str, Any]:
         params["mlm_norm_w"] = state["vocab_layer_norm.weight"]
         params["mlm_norm_b"] = state["vocab_layer_norm.bias"]
         params["mlm_bias"] = state["vocab_projector.bias"]
+        proj = state.get("vocab_projector.weight")  # untied fine-tunes only
+        if proj is not None and (proj.shape != params["tok_embed"].shape
+                                 or not np.array_equal(proj, params["tok_embed"])):
+            params["lm_head"] = proj.T
     return params
 
 
@@ -937,6 +949,8 @@ def from_pretrained(model_dir: str, dtype=None, topology=None,
         # tree before the model is constructed
         cfg.mlm_head = "mlm_dense_w" in host_params
         cfg.pooler = "pooler_w" in host_params
+        # an untied MLM decoder was mapped to lm_head (see _map_bert)
+        cfg.tie_embeddings = "lm_head" not in host_params
     if family == "mixtral":
         from ..models.moe import MoETransformer
 

@@ -237,6 +237,24 @@ class RaggedInferenceEngine:
                 self.allocator.free(seq.blocks)
                 self._free_slots.append(seq.slot)
 
+    def trim(self, uid: int, length: int) -> None:
+        """Rewind ``uid`` to its first ``length`` tokens, freeing now-unused
+        KV blocks. Attention reads are position-bounded, so stale KV past
+        the trim point is never read; the next put()/decode overwrites it.
+        Use after observing EOS inside a ``decode_steps`` chunk when the
+        sequence will keep being served (post-EOS tokens were admitted by
+        that chunk and would otherwise pollute further continuations)."""
+        seq = self.seqs[uid]
+        if not 0 <= length <= seq.seen:
+            raise ValueError(
+                f"uid {uid}: trim length {length} outside [0, seen={seq.seen}]")
+        seq.tokens = seq.tokens[:length]
+        seq.seen = length
+        keep = -(-length // self.config.kv_block_size) if length else 0
+        if keep < len(seq.blocks):
+            self.allocator.free(seq.blocks[keep:])
+            del seq.blocks[keep:]
+
     # -- step ------------------------------------------------------------
     def put(self, uids: Sequence[int], tokens: Sequence[Sequence[int]]) -> np.ndarray:
         """Admit new tokens for ``uids`` and run one ragged step.
@@ -363,7 +381,14 @@ class RaggedInferenceEngine:
         tokens generated after it; the last one is returned un-processed —
         feed it as the next call's first token (exactly like the
         one-token-at-a-time put() contract). Every uid must be fully
-        prefilled (pending == 0)."""
+        prefilled (pending == 0).
+
+        EOS caveat: all k tokens are admitted to the sequence's context
+        (KV + token stream) before the caller can observe EOS inside the
+        chunk. ``generate()`` handles this by flushing finished uids; a
+        caller that keeps serving a uid via put()/decode_steps after an
+        in-chunk EOS must first ``trim(uid, ...)`` back to the EOS
+        position, or the post-EOS tokens become permanent context."""
         cfg = self.config
         if k < 1:
             raise ValueError(f"decode_steps needs k >= 1, got {k}")

@@ -123,6 +123,27 @@ def pipeline_apply(stage_fn: StageFn,
     )(stage_params, xs, consts, rng)
 
 
+def forward_tick_plan(micro_batches: int, stages: int):
+    """The executor's forward work map: ``plan[t]`` is the list of
+    ``(stage, micro_batch)`` pairs doing *real* work at clock tick ``t``.
+
+    Derived from the SAME predicate the compiled scan body uses
+    (``mb_here = t - stage``, valid iff ``0 <= mb_here < M`` — see ``tick``
+    above), so tests can assert this plan is equivalent to the reference-
+    shaped instruction schedules in ``pipe/schedule.py``: tick-for-step equal
+    to InferenceSchedule's ForwardPass stream, and per-stage order-equal to
+    TrainSchedule's forward stream (1F1B re-times backward, never forward
+    order). That assertion is what makes ``pipe/schedule.py`` a *wired*
+    specification of this executor rather than a standalone model.
+    """
+    n_mb, n_stages = micro_batches, stages
+    plan = []
+    for t in range(n_mb + n_stages - 1):
+        work = [(s, t - s) for s in range(n_stages) if 0 <= t - s < n_mb]
+        plan.append(work)
+    return plan
+
+
 def stack_stage_params(layer_params: Any, n_stages: int) -> Any:
     """Reshape stacked-layer params ``[n_layers, ...]`` into per-stage
     ``[n_stages, n_layers/n_stages, ...]``. A metadata-only reshape when the

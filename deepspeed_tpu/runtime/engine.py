@@ -701,6 +701,10 @@ class TrainEngine:
         self._acc_grads = None
         self._params_to_offload()
         self.global_steps += 1
+        # the compat fwd/bwd/step path drives global_steps without the
+        # throughput timer; keep the two counters aligned so a later
+        # train_batch's report boundary lands on steps_per_print multiples
+        self.tput.step_count = self.global_steps
         self._note_skipped(skipped)
         self._write_monitor({"loss": self._last_loss, "grad_norm": gnorm,
                              "loss_scale": self.scaler_state.scale, "skipped": skipped})
@@ -807,6 +811,10 @@ class TrainEngine:
             self.scaler_state = jax.device_put(
                 jax.tree_util.tree_map(jnp.asarray, state["scaler"]), repl)
         self.global_steps = int(state["step"])
+        # keep the throughput timer's step counter aligned with
+        # global_steps so the report boundary (will_report_next) stays on
+        # steps_per_print multiples of the *global* step across resumes
+        self.tput.step_count = self.global_steps
         self.rng = jax.device_put(jnp.asarray(state["rng"]), repl)
         client = result["meta"].get("client_state", {})
         self.micro_steps = int(client.get("micro_steps", self.global_steps * self.gradient_accumulation_steps))

@@ -23,19 +23,22 @@ def constant_lr(lr: float) -> Schedule:
 
 def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 1e-3,
               warmup_num_steps: int = 1000, warmup_type: str = "log") -> Schedule:
-    """WarmupLR (reference lr_schedules.py WarmupLR): ramp then hold."""
+    """WarmupLR (reference lr_schedules.py:636 WarmupLR): ramp then hold.
+
+    gamma = log(step+1) / log(warmup_num_steps) for the default "log" type
+    (warmup_num_steps floored at 2, per reference __init__), step/steps for
+    "linear"; gamma clamps to 1 once warmup completes.
+    """
+    steps = max(2, warmup_num_steps)
+    inverse_log_warm_up = 1.0 / math.log(steps)
 
     def sched(step):
         step = jnp.asarray(step, jnp.float32)
-        frac = jnp.clip(step / max(warmup_num_steps, 1), 0.0, 1.0)
         if warmup_type == "log":
-            # log-space ramp, matching the reference's default
-            frac = jnp.where(frac > 0, jnp.power(frac, 0.5), 0.0) if False else frac
-            # reference uses: min + (max-min) * log1p-style ramp; emulate with
-            # the same endpoints using a smooth log ramp
-            ramp = jnp.log1p(frac * (math.e - 1.0))
+            ramp = inverse_log_warm_up * jnp.log(step + 1.0)
         else:
-            ramp = frac
+            ramp = step / steps
+        ramp = jnp.where(step < steps, ramp, 1.0)
         return jnp.asarray(warmup_min_lr + (warmup_max_lr - warmup_min_lr) * ramp, jnp.float32)
 
     return sched

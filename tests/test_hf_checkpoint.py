@@ -96,6 +96,20 @@ def _save_tiny(tmp_path, family: str, safe: bool):
             num_attention_heads=4, intermediate_size=256,
             max_position_embeddings=128, type_vocab_size=2)
         m = transformers.BertForMaskedLM(hf_cfg)
+    elif family == "bert_untied":
+        # tie_word_embeddings=False fine-tune class: cls.predictions.decoder
+        # is a separate matrix — must map to lm_head, not silently re-tie
+        hf_cfg = transformers.BertConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=256,
+            max_position_embeddings=128, type_vocab_size=2,
+            tie_word_embeddings=False)
+        m = transformers.BertForMaskedLM(hf_cfg)
+        with torch.no_grad():  # make the decoder demonstrably distinct
+            m.cls.predictions.decoder.weight.add_(
+                torch.randn_like(m.cls.predictions.decoder.weight) * 0.02)
+        assert not torch.equal(m.cls.predictions.decoder.weight,
+                               m.bert.embeddings.word_embeddings.weight)
     elif family == "distilbert":
         hf_cfg = transformers.DistilBertConfig(
             vocab_size=256, dim=64, n_layers=2, n_heads=4, hidden_dim=256,
@@ -116,6 +130,7 @@ def _save_tiny(tmp_path, family: str, safe: bool):
                                          ("falcon", True),
                                          ("mixtral", True),
                                          ("bert", True),
+                                         ("bert_untied", True),
                                          ("distilbert", True),
                                          ("gpt_neo", True),
                                          ("qwen2", True)])

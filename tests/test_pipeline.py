@@ -14,6 +14,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import deepspeed_tpu as dst
 from deepspeed_tpu.parallel import mesh as mesh_mod
 from deepspeed_tpu.parallel.pipeline import (
+    forward_tick_plan,
     microbatch,
     pipeline_apply,
     stack_stage_params,
@@ -76,6 +77,41 @@ def test_train_schedule_1f1b_memory_bound():
             peak = max(peak, in_flight)
         assert peak <= stages - stage_id, (stage_id, peak)
         assert sched.num_pipe_buffers() <= min(stages - stage_id + 1, mbs)
+
+
+def test_executor_tick_plan_matches_schedules():
+    """The compiled executor's tick plan (forward_tick_plan, derived from the
+    same predicate as the scan body) IS the instruction schedules: tick-for-
+    step equal to InferenceSchedule's ForwardPass stream, and per-stage
+    order-equal to TrainSchedule's forward stream. This is what wires
+    pipe/schedule.py to parallel/pipeline.py as a checked specification."""
+    for stages, mbs in [(2, 4), (4, 8), (4, 4), (3, 5), (8, 8)]:
+        plan = forward_tick_plan(mbs, stages)
+        assert len(plan) == mbs + stages - 1
+
+        # tick-for-step: InferenceSchedule stage s runs ForwardPass(mb) at
+        # step t exactly when (s, mb) is in the executor's plan[t].
+        sched_steps = {
+            s: list(InferenceSchedule(micro_batches=mbs, stages=stages,
+                                      stage_id=s).steps())
+            for s in range(stages)
+        }
+        for t, work in enumerate(plan):
+            sched_work = []
+            for s in range(stages):
+                for cmd in sched_steps[s][t]:
+                    if isinstance(cmd, ForwardPass):
+                        sched_work.append((s, cmd.micro_batch))
+            assert sorted(sched_work) == sorted(work), (stages, mbs, t)
+
+        # per-stage forward order: 1F1B re-times backwards but never
+        # reorders a stage's forwards; both must be mb = 0..M-1 in order.
+        for s in range(stages):
+            exec_order = [mb for work in plan for (st, mb) in work if st == s]
+            train = TrainSchedule(micro_batches=mbs, stages=stages, stage_id=s)
+            train_order = [c.micro_batch for c in _flat(train)
+                           if isinstance(c, ForwardPass)]
+            assert exec_order == train_order == list(range(mbs))
 
 
 def test_inference_schedule_fill_drain():

@@ -101,6 +101,45 @@ def test_chunked_prefill_across_steps():
     np.testing.assert_array_equal(np.asarray(toks), expected)
 
 
+def test_trim_rewinds_context_exactly():
+    """trim(uid, n) after a decode_steps chunk must restore the sequence to
+    the same state as one that never generated past n: the continuation
+    tokens must match a fresh engine fed the trimmed prefix (the post-EOS
+    pollution fix for callers mixing decode_steps with further serving)."""
+    model = _llama()
+    params = model.init(jax.random.PRNGKey(8))
+    prompt = list(np.random.default_rng(4).integers(0, 128, 9))
+
+    eng = RaggedInferenceEngine(model, _cfg(), params=params)
+    logits = eng.put([3], [prompt])
+    first = int(np.argmax(logits[0]))
+    chain = eng.decode_steps({3: first}, 6)[3]   # admits first + chain[:-1]
+    # pretend chain[1] was EOS: rewind to prompt + first + chain[:2]
+    keep = len(prompt) + 3
+    blocks_before = len(eng.seqs[3].blocks)
+    eng.trim(3, keep)
+    assert eng.seqs[3].seen == keep and len(eng.seqs[3].tokens) == keep
+    assert len(eng.seqs[3].blocks) <= blocks_before
+
+    # continue the trimmed sequence one token at a time
+    cont = []
+    logits = eng.put([3], [[chain[2]]])
+    for _ in range(3):
+        t = int(np.argmax(logits[0]))
+        cont.append(t)
+        logits = eng.put([3], [[t]])
+
+    # oracle: a fresh engine that only ever saw the trimmed stream
+    ref = RaggedInferenceEngine(model, _cfg(), params=params)
+    logits = ref.put([5], [prompt + [first] + chain[:3]])
+    expected = []
+    for _ in range(3):
+        t = int(np.argmax(logits[0]))
+        expected.append(t)
+        logits = ref.put([5], [[t]])
+    assert cont == expected
+
+
 def test_flush_releases_resources():
     model = _llama()
     eng = RaggedInferenceEngine(model, _cfg())
