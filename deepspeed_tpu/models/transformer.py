@@ -90,12 +90,17 @@ class TransformerConfig:
     attn_windows: Optional[Tuple[int, ...]] = None
     attn_scale: Optional[float] = None
     qkv_bias: Optional[bool] = None   # None -> follow use_bias (Neo: False)
+    # InternLM: attention projections carry biases (incl. o_proj) while the
+    # gated MLP does not — reference module_inject/containers/internlm.py:20
+    attn_o_bias: Optional[bool] = None  # None -> follow use_bias
 
     def __post_init__(self):
         if self.n_kv_heads is None:
             self.n_kv_heads = self.n_heads
         if self.qkv_bias is None:
             self.qkv_bias = self.use_bias
+        if self.attn_o_bias is None:
+            self.attn_o_bias = self.use_bias
         if self.attn_windows is not None:
             self.attn_windows = tuple(int(w) for w in self.attn_windows)
             assert len(self.attn_windows) == self.n_layers, (
@@ -137,7 +142,7 @@ class TransformerConfig:
         attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd + self.n_heads * hd * d
         if self.qkv_bias:
             attn += self.n_heads * hd + 2 * self.n_kv_heads * hd
-        if self.use_bias:
+        if self.attn_o_bias:
             attn += d
         norms = (2 * d) * n + (d if self.prenorm else 0)
         if self.norm == "layer":
@@ -196,6 +201,7 @@ class Transformer:
         self._seq_size = topo.sequence_parallel_size
         self._tp_size = topo.model_parallel_size
         self._pipe_size = topo.pipe_parallel_size
+        self._batch_axes = topo.data_axes()
         if self._pipe_size > 1:
             assert self.config.n_layers % self._pipe_size == 0, (
                 f"n_layers={self.config.n_layers} not divisible by "
@@ -203,7 +209,10 @@ class Transformer:
         if self._seq_size > 1:
             impl = self.config.sp_attention
             if impl == "auto":
-                impl = "ulysses" if self.config.n_heads % self._seq_size == 0 else "ring"
+                # under TP the heads dim is already sharded over 'model', so
+                # ulysses scatters the LOCAL n_heads/tp heads over the seq axis
+                local_heads = self.config.n_heads // self._tp_size
+                impl = "ulysses" if local_heads % self._seq_size == 0 else "ring"
             self._sp_impl = impl
         return self
 
@@ -237,8 +246,9 @@ class Transformer:
             layers["bq"] = jnp.zeros((n, c.n_heads * hd), dtype)
             layers["bk"] = jnp.zeros((n, c.n_kv_heads * hd), dtype)
             layers["bv"] = jnp.zeros((n, c.n_kv_heads * hd), dtype)
-        if c.use_bias:
+        if c.attn_o_bias:
             layers["bo"] = jnp.zeros((n, c.d_model), dtype)
+        if c.use_bias:
             layers["b_up"] = jnp.zeros((n, c.d_ff), dtype)
             layers["b_down"] = jnp.zeros((n, c.d_model), dtype)
 
@@ -278,6 +288,8 @@ class Transformer:
 
     def _sp_attention(self, q, k, v, window=None, causal=True):
         """Sequence-parallel attention over the bound mesh's seq axis."""
+        batch_axes = getattr(self, "_batch_axes", None) or None
+        head_axes = "model" if self._tp_size > 1 else None
         if self._sp_impl == "ring":
             from ..parallel.ring import ring_attention_sharded
 
@@ -285,7 +297,9 @@ class Transformer:
                 and causal, \
                 "ring attention is causal-only, no window/scale — caller " \
                 "must reject"
-            return ring_attention_sharded(q, k, v, self._mesh, causal=True)
+            return ring_attention_sharded(q, k, v, self._mesh, causal=True,
+                                          batch_axes=batch_axes,
+                                          head_axes=head_axes)
         from ..parallel.ulysses import DistributedAttention
 
         # after the a2a each device holds FULL sequences for a head subset —
@@ -302,8 +316,10 @@ class Transformer:
             kw["scale"] = self.config.attn_scale
         if kw:
             local_attn = partial(local_attn, **kw)
-        return DistributedAttention(local_attn, self._mesh)(q, k, v,
-                                                            causal=causal)
+        return DistributedAttention(local_attn, self._mesh,
+                                    batch_axes=batch_axes,
+                                    head_axes=head_axes)(q, k, v,
+                                                         causal=causal)
 
     def _block(self, x, lp, angles, positions, kv_cache=None, rng=None, training=False,
                attn_mask=None, attn_window=None):
@@ -431,7 +447,7 @@ class Transformer:
                                          scale=c.attn_scale)
 
         attn = attn.reshape(b, s, c.n_heads * hd) @ lp["wo"]
-        if c.use_bias:
+        if c.attn_o_bias:
             attn = attn + lp["bo"]
 
         if c.parallel_residual:
@@ -883,10 +899,11 @@ class Transformer:
                 "bq": P(pipe, "model"), "bk": P(pipe, "model"),
                 "bv": P(pipe, "model"),
             })
+        if c.attn_o_bias:
+            layer_specs["bo"] = P(pipe, None)
         if c.use_bias:
             layer_specs.update({
-                "bo": P(pipe, None), "b_up": P(pipe, "model"),
-                "b_down": P(pipe, None),
+                "b_up": P(pipe, "model"), "b_down": P(pipe, None),
             })
         specs: Dict[str, Any] = {
             "tok_embed": P("model", None),

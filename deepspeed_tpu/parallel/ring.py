@@ -86,10 +86,13 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
 
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, *, axis_name: str = "seq",
-                           causal: bool = True):
+                           causal: bool = True, batch_axes=None,
+                           head_axes=None):
     """Global-array wrapper: q/k/v [b, s, h, d] sharded over ``axis_name``
-    on the seq dim; runs ring attention under shard_map."""
-    spec = P(None, axis_name, None, None)
+    on the seq dim; runs ring attention under shard_map. ``batch_axes`` /
+    ``head_axes`` must name the activations' existing batch/head sharding
+    so the shard_map boundary doesn't force a replicate-then-reshard."""
+    spec = P(batch_axes, axis_name, head_axes, None)
 
     def inner(q, k, v):
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal)

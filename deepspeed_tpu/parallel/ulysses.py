@@ -15,6 +15,7 @@ The reference's ``seq_parallel_communication_data_type`` knob
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Callable, Optional
 
@@ -46,6 +47,17 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
     orig_dtype = q.dtype
     if comm_dtype is not None:
         q, k, v = (t.astype(comm_dtype) for t in (q, k, v))
+    # GQA: when the local kv-head count doesn't divide the seq axis (e.g.
+    # TP already sharded kv heads down to 1), repeat each kv head just
+    # enough to scatter — numerics-identical, it's the GQA broadcast done
+    # before the a2a instead of inside attention (reference Ulysses does
+    # the same for GQA models, sequence/layer.py head-repeat path)
+    P_ = jax.lax.axis_size(axis_name)
+    kvh = k.shape[2]
+    if kvh % P_ != 0:
+        r = P_ // math.gcd(kvh, P_)
+        k = jnp.repeat(k, r, axis=2)
+        v = jnp.repeat(v, r, axis=2)
     # [b, s/P, h, d] -> [b, s, h/P, d]
     q, k, v = (_a2a(t, axis_name, split_axis=2, concat_axis=1) for t in (q, k, v))
     if comm_dtype is not None:
@@ -66,15 +78,22 @@ class DistributedAttention:
 
     def __init__(self, local_attention: Callable, mesh: Mesh,
                  scatter_idx: int = 2, gather_idx: int = 1,
-                 axis_name: str = "seq", comm_dtype=None):
+                 axis_name: str = "seq", comm_dtype=None,
+                 batch_axes=None, head_axes=None):
         self.local_attn = local_attention
         self.mesh = mesh
         self.axis_name = axis_name
         self.comm_dtype = comm_dtype
+        # batch/head axes must NAME the activations' existing sharding
+        # (batch over the data axes, heads over 'model' under TP) — a spec
+        # of None on a sharded dim forces GSPMD to replicate-then-reshard
+        # at the shard_map boundary ("involuntary full rematerialization")
+        self.batch_axes = batch_axes
+        self.head_axes = head_axes
         # scatter/gather idx kept for API parity; fixed [b, s, h, d] layout
 
     def __call__(self, q, k, v, causal: bool = True):
-        spec = P(None, self.axis_name, None, None)  # [b, s/P, h, d]
+        spec = P(self.batch_axes, self.axis_name, self.head_axes, None)
 
         def inner(q, k, v):
             return ulysses_attention(

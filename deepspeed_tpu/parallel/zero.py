@@ -68,11 +68,25 @@ def shard_leaf_spec(shape: Tuple[int, ...],
                     zero_axes: Tuple[str, ...],
                     base_spec: Optional[PartitionSpec] = None,
                     threshold: int = 0,
-                    axes_size: int = 1) -> PartitionSpec:
+                    axes_size: int = 1,
+                    axis_sizes: Optional[dict] = None) -> PartitionSpec:
     """Compute the PartitionSpec for one leaf: start from the tensor-parallel
-    spec (if any) and fold the ZeRO axes onto the largest still-unsharded,
-    divisible dimension. Falls back to replicated when nothing fits (tiny or
+    spec (if any) and fold the ZeRO axes onto still-unsharded, divisible
+    dimensions. Falls back to replicated when nothing fits (tiny or
     odd-shaped leaves — the analog of the reference's persistent params).
+
+    Multi-axis placement puts EACH zero axis on its OWN dimension (largest
+    axes first, largest dims first) and NEVER fuses several axes onto one
+    dim: XLA's SPMD partitioner cannot efficiently reshard an activation
+    tiled over two distinct dims (batch x seq) onto a tensor dim carrying
+    the fused product — it falls back to replicate-then-reshard
+    ("Involuntary full rematerialization", xla b/433785288), and the
+    hazard fires for fused 1-D vector grads just as for fused weight
+    grads (an [d] norm grad fused over (data,seq) pressures the [b,s,d]
+    cotangent into a feature-dim resharding). Axes that can't get their
+    own dim are simply dropped for that leaf (it stays replicated over
+    them) — for the 1-D leaves this costs a vector's worth of memory on
+    one axis, nothing at scale.
     """
     ndim = len(shape)
     spec = _spec_to_list(base_spec, ndim)
@@ -80,7 +94,23 @@ def shard_leaf_spec(shape: Tuple[int, ...],
         return PartitionSpec(*spec)
     if int(np.prod(shape)) < threshold:
         return PartitionSpec(*spec)
-    # candidate dims: unsharded, divisible by the zero-axes size
+    sizes = dict(axis_sizes or {})
+    # without per-axis sizes we can only do the fused placement
+    live = [] if axis_sizes is None else [a for a in zero_axes if sizes[a] > 1]
+    if len(live) > 1:
+        placed = 0
+        for a in sorted(live, key=lambda a: -sizes[a]):
+            n = sizes[a]
+            cands = [i for i in range(ndim)
+                     if spec[i] is None and shape[i] % n == 0 and shape[i] >= n]
+            if cands:
+                spec[max(cands, key=lambda i: shape[i])] = a
+                placed += 1
+        if placed:
+            return PartitionSpec(*spec)
+        # nothing placeable at all: replicated
+        return PartitionSpec(*_spec_to_list(base_spec, ndim))
+    # single axis / fused fallback: the product on one divisible dim
     candidates = [i for i in range(ndim) if spec[i] is None and shape[i] % axes_size == 0 and shape[i] >= axes_size]
     if not candidates:
         return PartitionSpec(*spec)
@@ -123,6 +153,9 @@ class ZeroShardingRules:
         self.secondary_axes = topo.zero_secondary_axes()
         self.secondary_size = _axes_size(topo, self.secondary_axes)
 
+    def _axis_sizes(self, axes: Tuple[str, ...]) -> dict:
+        return {a: self.topo.axis_size(a) for a in axes}
+
     # -- per-leaf specs -------------------------------------------------
     def param_spec(self, shape: Tuple[int, ...], base_spec: Optional[PartitionSpec] = None) -> PartitionSpec:
         if self.config.stage < 3:
@@ -130,14 +163,16 @@ class ZeroShardingRules:
         return shard_leaf_spec(
             shape, self.zero_axes, base_spec,
             threshold=self.config.stage3_param_persistence_threshold,
-            axes_size=self.zero_size,
+            axes_size=self.zero_size, axis_sizes=self._axis_sizes(self.zero_axes),
         )
 
     def state_spec(self, shape: Tuple[int, ...], base_spec: Optional[PartitionSpec] = None) -> PartitionSpec:
         """Optimizer-state / gradient-shard spec: sharded from stage 1 up."""
         if self.config.stage < 1:
             return base_spec if base_spec is not None else PartitionSpec()
-        return shard_leaf_spec(shape, self.zero_axes, base_spec, threshold=0, axes_size=self.zero_size)
+        return shard_leaf_spec(shape, self.zero_axes, base_spec, threshold=0,
+                               axes_size=self.zero_size,
+                               axis_sizes=self._axis_sizes(self.zero_axes))
 
     # -- pytree-level ---------------------------------------------------
     def _tree_specs(self, shapes: Any, tp_specs: Optional[Any], leaf_fn) -> Any:
@@ -152,6 +187,7 @@ class ZeroShardingRules:
             shape, self.secondary_axes, base_spec,
             threshold=self.config.stage3_param_persistence_threshold,
             axes_size=self.secondary_size,
+            axis_sizes=self._axis_sizes(self.secondary_axes),
         )
 
     def param_shardings(self, param_shapes: Any, tp_specs: Optional[Any] = None) -> Any:
