@@ -60,9 +60,11 @@ def _child_main():
     # backward FLOPs by saving matmul outputs)
     remat_env = os.environ.get("DST_BENCH_REMAT", "selective")
     remat = remat_env != "none"
-    # ~350M-param Llama sized for a single v5e chip with Adam fp32 state
-    # chunked CE bounds the fp32 logits transient to [chunk, vocab]
-    ce_chunk = int(os.environ.get("DST_BENCH_CE_CHUNK", "4096"))
+    # ~350M-param Llama sized for a single v5e chip with Adam fp32 state.
+    # Chunked CE bounds the fp32 logits transient to [chunk, vocab] but
+    # costs ~16 ms/step at bs8 post-async-dispatch-fixes (MFU_SWEEP_r04:
+    # 695.7 vs 711.6 ms) — off by default; the sweep still A/Bs it
+    ce_chunk = int(os.environ.get("DST_BENCH_CE_CHUNK", "0"))
     if on_tpu:
         model = Llama("tiny", d_model=1024, n_layers=24, n_heads=16, n_kv_heads=16,
                       d_ff=2816, vocab_size=32000, max_seq_len=2048, remat=remat,

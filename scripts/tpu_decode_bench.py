@@ -9,7 +9,7 @@ gather fallback) at serving shapes.
 Timing uses the chained-iteration + host-fetch methodology (see
 scripts/tpu_flash_check.py: through the axon relay only a host fetch is a
 real fence). Prints ONE JSON line; the committed copy lives at
-TPU_DECODE_BENCH_r03.json.
+TPU_DECODE_BENCH_r04.json.
 
 Usage: PYTHONPATH=$PWD python scripts/tpu_decode_bench.py
 """
@@ -20,7 +20,13 @@ import json
 import sys
 import time
 
+import os
+
 import numpy as np
+
+# runnable as `python scripts/<name>.py` from anywhere: the repo root
+# (one level up) must be importable for deepspeed_tpu
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _paged_ab(report):

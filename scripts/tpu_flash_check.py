@@ -3,7 +3,7 @@
 Run on a real TPU (default env, axon claim): numerics of the Pallas flash
 kernel (fwd + bwd) and the paged-attention decode kernel vs the jnp
 reference paths in bf16, then wall-clock A/Bs at training/decode shapes.
-Prints one JSON line; the committed copy lives at TPU_KERNEL_CHECK_r03.json.
+Prints one JSON line; the committed copy lives at TPU_KERNEL_CHECK_r04.json.
 
 Timing methodology: through the axon relay, dispatch is async and
 ``block_until_ready`` does not synchronize — the only reliable fence is a
@@ -22,7 +22,13 @@ import json
 import sys
 import time
 
+import os
+
 import numpy as np
+
+# runnable as `python scripts/<name>.py` from anywhere: the repo root
+# (one level up) must be importable for deepspeed_tpu
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _chain_ms(step, q, args, iters):

@@ -4,7 +4,7 @@ breakdown. This is the profile-driven pass for the MFU target: comparing
 configs isolates where the step time goes (attention kernel, backward
 recompute) without needing a profiler trace through the axon relay.
 
-Writes MFU_SWEEP_r03.json (one entry per config) and prints it.
+Writes MFU_SWEEP_r04.json (one entry per config) and prints it.
 
 Usage: python scripts/tpu_mfu_sweep.py   (TPU claimed per child, serially)
 """
@@ -17,25 +17,28 @@ import subprocess
 import sys
 
 CONFIGS = [
+    # r04 best-known defaults: flash + selective remat + ce_chunk 0 + bs8
     {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective"},
-    # batch is the biggest untried single-chip lever: larger per-step
-    # matmuls amortize dispatch + pad the MXU (HBM is the bound)
+    # A/B the CE chunking (it COSTS ~16 ms/step post-async-fixes)
     {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective",
-     "DST_BENCH_BS": "16"},
+     "DST_BENCH_CE_CHUNK": "4096"},
+    # batch: bs12/16 OOM at selective (r04 sweep); probe the edge at 10
     {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective",
-     "DST_BENCH_BS": "12"},
-    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective",
-     "DST_BENCH_CE_CHUNK": "0"},
+     "DST_BENCH_BS": "10"},
+    # remat policies: cheaper recompute (dots-only) and none-at-all
+    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "dots_with_no_batch_dims"},
+    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "none", "DST_BENCH_BS": "4"},
     {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "full",
      "DST_BENCH_BS": "16"},
-    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "full"},
-    {"DST_BENCH_FLASH": "0", "DST_BENCH_REMAT": "selective"},
+    # XLA-attention A/B (OOM'd at bs8 ce0 in r04 — run it at bs4)
+    {"DST_BENCH_FLASH": "0", "DST_BENCH_REMAT": "selective",
+     "DST_BENCH_BS": "4"},
 ]
 
 
 def main():
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    out = os.path.join(here, "MFU_SWEEP_r03.json")
+    out = os.path.join(here, "MFU_SWEEP_r04.json")
     results = []
     for cfg in CONFIGS:
         env = dict(os.environ, **cfg)

@@ -12,13 +12,22 @@ echo "== [1/4] compiled-kernel lane (flash incl. windowed, paged) =="
 DST_TPU_TESTS=1 python -m pytest tests/test_tpu_kernels.py -q || true
 
 echo "== [2/4] kernel numerics + perf report (TPU_KERNEL_CHECK) =="
-python scripts/tpu_flash_check.py || true
+python scripts/tpu_flash_check.py | tee /tmp/flash_check.out || true
+grep '^{' /tmp/flash_check.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp TPU_KERNEL_CHECK_r04.json || echo "[roundup] TPU_KERNEL_CHECK_r04.json NOT refreshed (stage produced no JSON)"
 
 echo "== [3/4] MFU sweep (flash x remat x ce-chunk x batch) =="
 python scripts/tpu_mfu_sweep.py || true
 
 echo "== [4/4] ragged decode benchmark (TPU_DECODE_BENCH) =="
-python scripts/tpu_decode_bench.py || true
+python scripts/tpu_decode_bench.py | tee /tmp/decode_bench.out || true
+grep '^{' /tmp/decode_bench.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp TPU_DECODE_BENCH_r04.json || echo "[roundup] TPU_DECODE_BENCH_r04.json NOT refreshed (stage produced no JSON)"
+
+echo "== [5] SLA serving benchmark (SERVE_BENCH) =="
+python scripts/tpu_serve_bench.py || true
+
+echo "== [6] quantized-collective pack-cost microbench (QUANT_COMM) =="
+python scripts/tpu_quant_comm_bench.py || true
 
 echo "== headline bench =="
-python bench.py || true
+python bench.py | tee /tmp/bench.out || true
+grep '^{' /tmp/bench.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp BENCH_r04_local.json || echo "[roundup] BENCH_r04_local.json NOT refreshed (stage produced no JSON)"

@@ -10,17 +10,22 @@ Must set env vars BEFORE jax is imported anywhere.
 
 import os
 
-if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                               " --xla_force_host_platform_device_count=8")
-os.environ["JAX_PLATFORMS"] = "cpu"
+_tpu_lane = os.environ.get("DST_TPU_TESTS") == "1"
+
+if not _tpu_lane:
+    if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8")
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
 # sitecustomize may have imported jax already (with JAX_PLATFORMS=axon baked
-# in), so the env var alone is not enough — force the config directly.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_default_matmul_precision", "highest")
+# in), so the env var alone is not enough — force the config directly. The
+# on-chip kernel lane (DST_TPU_TESTS=1) must keep the real TPU platform.
+if not _tpu_lane:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 
