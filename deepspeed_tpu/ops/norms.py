@@ -29,3 +29,23 @@ def layer_norm(x, weight, bias, eps: float = 1e-5):
     var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
     y = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
     return (y * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def group_norm(x, weight, bias, groups: int = 32, eps: float = 1e-5):
+    """GroupNorm over NHWC feature maps (diffusion UNet/VAE blocks — the
+    layout TPU convs prefer; the reference's spatial kernels operate NCHW,
+    csrc/spatial/csrc/opt_bias_add.cu). Normalizes each channel group over
+    (H, W, C/g) with fp32 accumulation."""
+    assert x.ndim == 4, (
+        f"group_norm expects NHWC rank-4 input, got shape {x.shape} — a "
+        "lower rank would silently mix statistics across the batch dim")
+    *lead, c = x.shape
+    assert c % groups == 0, (c, groups)
+    x32 = x.astype(jnp.float32).reshape(*lead[:-2], -1, groups, c // groups)
+    # reduce over all spatial positions and the within-group channels
+    red = tuple(range(x32.ndim - 3, x32.ndim - 2)) + (x32.ndim - 1,)
+    mean = jnp.mean(x32, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=red, keepdims=True)
+    y = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    y = y.reshape(x.shape)
+    return (y * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)

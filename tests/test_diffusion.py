@@ -1,0 +1,186 @@
+"""Diffusion surface: UNet/VAE numerics + diffusers ingestion + sampling.
+
+Parity is against a faithful torch implementation of the diffusers
+architecture (tests/torch_diffusion_ref.py — module names AND math follow
+UNet2DConditionModel / AutoencoderKL, the models the reference injects in
+module_inject/containers/{unet,vae}.py). The torch state_dict is in
+diffusers naming, so every parity test also exercises the
+checkpoint/diffusers.py name/layout mapping end to end.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from deepspeed_tpu.checkpoint.diffusers import (  # noqa: E402
+    map_diffusers_unet, map_diffusers_vae)
+from deepspeed_tpu.models.diffusion import (  # noqa: E402
+    AutoencoderKL, UNet2DCondition, UNetConfig, VAEConfig)
+from deepspeed_tpu.inference.diffusion import (  # noqa: E402
+    DDIMSchedule, StableDiffusionPipeline)
+
+from torch_diffusion_ref import AutoencoderKLRef, UNet2DConditionRef  # noqa: E402
+
+
+def _np_state(module):
+    return {k: v.detach().numpy() for k, v in module.state_dict().items()}
+
+
+TINY = dict(in_channels=4, out_channels=4, block_out_channels=(32, 64),
+            layers_per_block=1, cross_attention_dim=32, attention_head_dim=4,
+            down_block_types=("CrossAttnDownBlock2D", "DownBlock2D"),
+            up_block_types=("UpBlock2D", "CrossAttnUpBlock2D"))
+
+
+@pytest.fixture(scope="module")
+def tiny_unet():
+    torch.manual_seed(0)
+    ref = UNet2DConditionRef(groups=8, **TINY)
+    ref.eval()
+    cfg = UNetConfig(norm_num_groups=8, **TINY)
+    params = map_diffusers_unet(_np_state(ref))
+    return ref, UNet2DCondition(cfg), params
+
+
+def test_unet_forward_parity(tiny_unet):
+    ref, unet, params = tiny_unet
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 16, 16, 4)).astype(np.float32)
+    ctx = rng.standard_normal((2, 7, 32)).astype(np.float32)
+    t = np.array([3, 977], np.int64)
+    with torch.no_grad():
+        want = ref(torch.from_numpy(x).permute(0, 3, 1, 2),
+                   torch.from_numpy(t),
+                   torch.from_numpy(ctx)).permute(0, 2, 3, 1).numpy()
+    got = np.asarray(jax.jit(unet.apply)(
+        params, jnp.asarray(x), jnp.asarray(t), jnp.asarray(ctx)))
+    err = np.abs(want - got).max()
+    assert err < 2e-4, err
+
+
+def test_unet_timestep_broadcast(tiny_unet):
+    _, unet, params = tiny_unet
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 16, 16, 4)), jnp.float32)
+    ctx = jnp.asarray(rng.standard_normal((2, 7, 32)), jnp.float32)
+    a = unet.apply(params, x, jnp.asarray(5), ctx)
+    b = unet.apply(params, x, jnp.asarray([5, 5]), ctx)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+@pytest.fixture(scope="module")
+def tiny_vae():
+    torch.manual_seed(1)
+    kw = dict(in_channels=3, out_channels=3, latent_channels=4,
+              block_out_channels=(32, 64), layers_per_block=1)
+    ref = AutoencoderKLRef(groups=8, **kw)
+    ref.eval()
+    cfg = VAEConfig(norm_num_groups=8, **kw)
+    params = map_diffusers_vae(_np_state(ref))
+    return ref, AutoencoderKL(cfg), params
+
+
+def test_vae_encode_parity(tiny_vae):
+    ref, vae, params = tiny_vae
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        wm, wl = ref.encode(torch.from_numpy(x).permute(0, 3, 1, 2))
+    gm, gl = jax.jit(vae.encode)(params, jnp.asarray(x))
+    assert np.abs(wm.permute(0, 2, 3, 1).numpy() - np.asarray(gm)).max() < 2e-4
+    assert np.abs(wl.permute(0, 2, 3, 1).numpy() - np.asarray(gl)).max() < 2e-4
+
+
+def test_vae_decode_parity(tiny_vae):
+    ref, vae, params = tiny_vae
+    rng = np.random.default_rng(3)
+    z = rng.standard_normal((2, 8, 8, 4)).astype(np.float32)
+    with torch.no_grad():
+        want = ref.decode(torch.from_numpy(z).permute(0, 3, 1, 2)) \
+            .permute(0, 2, 3, 1).numpy()
+    got = np.asarray(jax.jit(vae.decode)(params, jnp.asarray(z)))
+    assert np.abs(want - got).max() < 2e-4
+
+
+def test_ddim_step_math():
+    """One denoise step against the closed-form DDIM update with a
+    constant-eps 'unet'."""
+
+    class ConstEps:
+        class config:
+            in_channels = 4
+
+        def apply(self, params, lat, t, ctx):
+            return jnp.full_like(lat, 0.25)
+
+    sched = DDIMSchedule(num_inference_steps=1)
+    pipe = StableDiffusionPipeline(ConstEps(), schedule=sched,
+                                   guidance_scale=7.5)
+    ctx = jnp.zeros((1, 2, 8))
+    lat = pipe.sample_latents(None, ctx, ctx, jax.random.PRNGKey(0),
+                              height=4, width=4)
+    # manual: x ~ N(0,1); eps const (guidance collapses: u==c); t=0 step
+    x0 = np.asarray(jax.random.normal(jax.random.PRNGKey(0), (1, 4, 4, 4),
+                                      jnp.float32))
+    at = sched.alphas_cumprod[sched.timesteps[0]]
+    eps = 0.25
+    pred_x0 = (x0 - np.sqrt(1 - at) * eps) / np.sqrt(at)
+    want = pred_x0  # alpha_prev = 1 at the final step
+    np.testing.assert_allclose(np.asarray(lat), want, rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_end_to_end(tiny_unet, tiny_vae):
+    """Full jitted text-to-image trajectory on the tiny UNet + VAE."""
+    _, unet, uparams = tiny_unet
+    _, vae, vparams = tiny_vae
+    sched = DDIMSchedule(num_inference_steps=3)
+    pipe = StableDiffusionPipeline(unet, vae=vae, schedule=sched,
+                                   guidance_scale=5.0)
+    rng = np.random.default_rng(4)
+    cond = jnp.asarray(rng.standard_normal((1, 7, 32)), jnp.float32)
+    uncond = jnp.zeros_like(cond)
+    img = pipe(uparams, cond, uncond, jax.random.PRNGKey(1),
+               vae_params=vparams, height=8, width=8)
+    assert img.shape == (1, 16, 16, 3)
+    assert bool(jnp.all(jnp.isfinite(img)))
+    # determinism: same seed, same image
+    img2 = pipe(uparams, cond, uncond, jax.random.PRNGKey(1),
+                vae_params=vparams, height=8, width=8)
+    np.testing.assert_allclose(np.asarray(img), np.asarray(img2), atol=0)
+
+
+def test_linear_projection_variant():
+    """SD2-style use_linear_projection checkpoints (proj_in/out are
+    Linear) map onto the same 1x1-conv forward."""
+    state = {
+        "proj_in.weight": np.eye(8, dtype=np.float32) * 2.0,
+        "proj_in.bias": np.zeros(8, np.float32),
+    }
+    tree = map_diffusers_unet(state)
+    k = tree["proj_in"]["kernel"]
+    assert k.shape == (1, 1, 8, 8)
+    np.testing.assert_allclose(k[0, 0], np.eye(8) * 2.0)
+
+
+def test_northstar_feasibility_artifact():
+    """BASELINE config 4 (Llama-2-7B ZeRO-3 on v5p-64): the committed
+    feasibility report must show the config compiling and fitting HBM.
+    Regenerate with scripts/northstar_feasibility.py."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "NORTHSTAR_r04.json")
+    assert os.path.exists(path), "run scripts/northstar_feasibility.py"
+    with open(path) as f:
+        rep = json.load(f)
+    ok = [c for c in rep["configs"] if c.get("feasible")]
+    assert ok, rep
+    best = min(ok, key=lambda c: c["hbm_per_chip_gb"])
+    assert best["hbm_per_chip_gb"] < rep["chip"]["hbm_bytes"] / 1e9
+    assert rep["n_devices"] == 64
+    # the ZeRO-3 schedule must actually be sharded: GSPMD emitted
+    # all-gathers (param fetch) and reduce-scatter/all-reduce (grads)
+    assert best["collectives"]["all-gather"] > 0

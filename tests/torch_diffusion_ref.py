@@ -1,0 +1,378 @@
+"""Torch reference implementation of the diffusers UNet/VAE architecture.
+
+``diffusers`` is not installed in this image, so parity for the diffusion
+surface is established the same way the encoder/CLIP families are tested
+against ``transformers``: a faithful torch implementation of the exact
+architecture (module names AND math follow diffusers'
+UNet2DConditionModel / AutoencoderKL as served by the reference's
+module_inject/containers/{unet,vae}.py), whose ``state_dict()`` is in
+diffusers format — so the same test exercises BOTH the numerics of
+models/diffusion.py and the name/layout mapping of checkpoint/diffusers.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+def timestep_embedding(t, dim, max_period=10000.0):
+    half = dim // 2
+    freqs = torch.exp(-math.log(max_period) *
+                      torch.arange(half, dtype=torch.float32) / half)
+    args = t.float()[:, None] * freqs[None, :]
+    return torch.cat([torch.cos(args), torch.sin(args)], dim=-1)
+
+
+class ResnetBlock2D(nn.Module):
+    def __init__(self, cin, cout, temb_dim=None, groups=32, eps=1e-5):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(groups, cin, eps=eps)
+        self.conv1 = nn.Conv2d(cin, cout, 3, padding=1)
+        if temb_dim is not None:
+            self.time_emb_proj = nn.Linear(temb_dim, cout)
+        self.norm2 = nn.GroupNorm(groups, cout, eps=eps)
+        self.conv2 = nn.Conv2d(cout, cout, 3, padding=1)
+        if cin != cout:
+            self.conv_shortcut = nn.Conv2d(cin, cout, 1)
+
+    def forward(self, x, temb=None):
+        h = self.conv1(F.silu(self.norm1(x)))
+        if temb is not None and hasattr(self, "time_emb_proj"):
+            h = h + self.time_emb_proj(F.silu(temb))[:, :, None, None]
+        h = self.conv2(F.silu(self.norm2(h)))
+        if hasattr(self, "conv_shortcut"):
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+class Attention(nn.Module):
+    def __init__(self, dim, kv_dim, heads, bias=False):
+        super().__init__()
+        self.heads = heads
+        self.to_q = nn.Linear(dim, dim, bias=bias)
+        self.to_k = nn.Linear(kv_dim, dim, bias=bias)
+        self.to_v = nn.Linear(kv_dim, dim, bias=bias)
+        self.to_out = nn.ModuleList([nn.Linear(dim, dim)])
+
+    def forward(self, x, ctx=None):
+        ctx = x if ctx is None else ctx
+        b, n, c = x.shape
+        h = self.heads
+        d = c // h
+        q = self.to_q(x).view(b, n, h, d).transpose(1, 2)
+        k = self.to_k(ctx).view(b, -1, h, d).transpose(1, 2)
+        v = self.to_v(ctx).view(b, -1, h, d).transpose(1, 2)
+        w = torch.softmax(q.float() @ k.float().transpose(-1, -2) / math.sqrt(d),
+                          dim=-1).to(v.dtype)
+        o = (w @ v).transpose(1, 2).reshape(b, n, c)
+        return self.to_out[0](o)
+
+
+class GEGLU(nn.Module):
+    def __init__(self, dim, inner):
+        super().__init__()
+        self.proj = nn.Linear(dim, 2 * inner)
+
+    def forward(self, x):
+        h, gate = self.proj(x).chunk(2, dim=-1)
+        return h * F.gelu(gate.float()).to(h.dtype)
+
+
+class FeedForward(nn.Module):
+    def __init__(self, dim):
+        super().__init__()
+        inner = 4 * dim
+        self.net = nn.ModuleList([GEGLU(dim, inner), nn.Identity(),
+                                  nn.Linear(inner, dim)])
+
+    def forward(self, x):
+        for m in self.net:
+            x = m(x)
+        return x
+
+
+class BasicTransformerBlock(nn.Module):
+    def __init__(self, dim, cross_dim, heads):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn1 = Attention(dim, dim, heads)
+        self.norm2 = nn.LayerNorm(dim)
+        self.attn2 = Attention(dim, cross_dim, heads)
+        self.norm3 = nn.LayerNorm(dim)
+        self.ff = FeedForward(dim)
+
+    def forward(self, x, ctx):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), ctx)
+        x = x + self.ff(self.norm3(x))
+        return x
+
+
+class Transformer2DModel(nn.Module):
+    def __init__(self, dim, cross_dim, heads, groups=32):
+        super().__init__()
+        self.norm = nn.GroupNorm(groups, dim, eps=1e-6)
+        self.proj_in = nn.Conv2d(dim, dim, 1)
+        self.transformer_blocks = nn.ModuleList(
+            [BasicTransformerBlock(dim, cross_dim, heads)])
+        self.proj_out = nn.Conv2d(dim, dim, 1)
+
+    def forward(self, x, ctx):
+        b, c, h, w = x.shape
+        res = x
+        y = self.proj_in(self.norm(x))
+        y = y.permute(0, 2, 3, 1).reshape(b, h * w, c)
+        for blk in self.transformer_blocks:
+            y = blk(y, ctx)
+        y = y.reshape(b, h, w, c).permute(0, 3, 1, 2)
+        return self.proj_out(y) + res
+
+
+class Downsample2D(nn.Module):
+    """UNet variant: symmetric padding=1. The VAE encoder uses padding=0
+    with diffusers' asymmetric F.pad((0,1,0,1)) — see DownsampleAsym."""
+
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2d(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class DownsampleAsym(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2d(ch, ch, 3, stride=2, padding=0)
+
+    def forward(self, x):
+        return self.conv(F.pad(x, (0, 1, 0, 1)))
+
+
+class Upsample2D(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2d(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2.0, mode="nearest"))
+
+
+class _Blk(nn.Module):
+    """down/up block container with diffusers child names."""
+
+    def __init__(self):
+        super().__init__()
+        self.resnets = nn.ModuleList()
+        self.attentions = nn.ModuleList()
+
+
+class UNet2DConditionRef(nn.Module):
+    def __init__(self, in_channels=4, out_channels=4,
+                 block_out_channels=(32, 64), layers_per_block=1,
+                 cross_attention_dim=32, attention_head_dim=4,
+                 down_block_types=("CrossAttnDownBlock2D", "DownBlock2D"),
+                 up_block_types=("UpBlock2D", "CrossAttnUpBlock2D"),
+                 groups=8):
+        super().__init__()
+        self.block_out_channels = block_out_channels
+        temb = 4 * block_out_channels[0]
+        self.conv_in = nn.Conv2d(in_channels, block_out_channels[0], 3,
+                                 padding=1)
+        self.time_embedding = nn.Module()
+        self.time_embedding.linear_1 = nn.Linear(block_out_channels[0], temb)
+        self.time_embedding.linear_2 = nn.Linear(temb, temb)
+
+        heads = attention_head_dim  # diffusers bug-compat: this IS n_heads
+        self.down_blocks = nn.ModuleList()
+        ch = block_out_channels[0]
+        for i, bt in enumerate(down_block_types):
+            cout = block_out_channels[i]
+            blk = _Blk()
+            for j in range(layers_per_block):
+                blk.resnets.append(ResnetBlock2D(ch if j == 0 else cout, cout,
+                                                 temb, groups=groups))
+            if bt == "CrossAttnDownBlock2D":
+                for _ in range(layers_per_block):
+                    blk.attentions.append(Transformer2DModel(
+                        cout, cross_attention_dim, heads, groups=groups))
+            if i < len(down_block_types) - 1:
+                blk.downsamplers = nn.ModuleList([Downsample2D(cout)])
+            self.down_blocks.append(blk)
+            ch = cout
+
+        mid = block_out_channels[-1]
+        self.mid_block = _Blk()
+        self.mid_block.resnets.append(ResnetBlock2D(mid, mid, temb,
+                                                    groups=groups))
+        self.mid_block.attentions.append(Transformer2DModel(
+            mid, cross_attention_dim, heads, groups=groups))
+        self.mid_block.resnets.append(ResnetBlock2D(mid, mid, temb,
+                                                    groups=groups))
+
+        rev = list(reversed(block_out_channels))
+        self.up_blocks = nn.ModuleList()
+        ch = rev[0]
+        for i, bt in enumerate(up_block_types):
+            cout = rev[i]
+            cskip_end = rev[min(i + 1, len(rev) - 1)]
+            blk = _Blk()
+            for j in range(layers_per_block + 1):
+                skip = cskip_end if j == layers_per_block else cout
+                cin = (ch if j == 0 else cout) + skip
+                blk.resnets.append(ResnetBlock2D(cin, cout, temb,
+                                                 groups=groups))
+            if bt == "CrossAttnUpBlock2D":
+                for _ in range(layers_per_block + 1):
+                    blk.attentions.append(Transformer2DModel(
+                        cout, cross_attention_dim, heads, groups=groups))
+            if i < len(up_block_types) - 1:
+                blk.upsamplers = nn.ModuleList([Upsample2D(cout)])
+            self.up_blocks.append(blk)
+            ch = cout
+
+        self.conv_norm_out = nn.GroupNorm(groups, block_out_channels[0],
+                                          eps=1e-5)
+        self.conv_out = nn.Conv2d(block_out_channels[0], out_channels, 3,
+                                  padding=1)
+
+    def forward(self, sample, t, ctx):
+        temb = timestep_embedding(t, self.block_out_channels[0])
+        temb = self.time_embedding.linear_2(
+            F.silu(self.time_embedding.linear_1(temb)))
+        x = self.conv_in(sample)
+        skips = [x]
+        for blk in self.down_blocks:
+            for j, rn in enumerate(blk.resnets):
+                x = rn(x, temb)
+                if len(blk.attentions):
+                    x = blk.attentions[j](x, ctx)
+                skips.append(x)
+            if hasattr(blk, "downsamplers"):
+                x = blk.downsamplers[0](x)
+                skips.append(x)
+        x = self.mid_block.resnets[0](x, temb)
+        x = self.mid_block.attentions[0](x, ctx)
+        x = self.mid_block.resnets[1](x, temb)
+        for blk in self.up_blocks:
+            for j, rn in enumerate(blk.resnets):
+                x = torch.cat([x, skips.pop()], dim=1)
+                x = rn(x, temb)
+                if len(blk.attentions):
+                    x = blk.attentions[j](x, ctx)
+            if hasattr(blk, "upsamplers"):
+                x = blk.upsamplers[0](x)
+        return self.conv_out(F.silu(self.conv_norm_out(x)))
+
+
+class VAEAttention(nn.Module):
+    """diffusers >=0.13 VAE mid-block attention (single head, linears)."""
+
+    def __init__(self, ch, groups=8):
+        super().__init__()
+        self.group_norm = nn.GroupNorm(groups, ch, eps=1e-6)
+        self.to_q = nn.Linear(ch, ch)
+        self.to_k = nn.Linear(ch, ch)
+        self.to_v = nn.Linear(ch, ch)
+        self.to_out = nn.ModuleList([nn.Linear(ch, ch)])
+
+    def forward(self, x):
+        b, c, h, w = x.shape
+        y = self.group_norm(x).permute(0, 2, 3, 1).reshape(b, h * w, c)
+        q, k, v = self.to_q(y), self.to_k(y), self.to_v(y)
+        wts = torch.softmax(q.float() @ k.float().transpose(-1, -2) /
+                            math.sqrt(c), dim=-1).to(v.dtype)
+        o = self.to_out[0](wts @ v)
+        return x + o.reshape(b, h, w, c).permute(0, 3, 1, 2)
+
+
+class AutoencoderKLRef(nn.Module):
+    def __init__(self, in_channels=3, out_channels=3, latent_channels=4,
+                 block_out_channels=(32, 64), layers_per_block=1, groups=8):
+        super().__init__()
+        enc = nn.Module()
+        enc.conv_in = nn.Conv2d(in_channels, block_out_channels[0], 3,
+                                padding=1)
+        enc.down_blocks = nn.ModuleList()
+        ch = block_out_channels[0]
+        for i, cout in enumerate(block_out_channels):
+            blk = nn.Module()
+            blk.resnets = nn.ModuleList(
+                [ResnetBlock2D(ch if j == 0 else cout, cout, None,
+                               groups=groups, eps=1e-6)
+                 for j in range(layers_per_block)])
+            if i < len(block_out_channels) - 1:
+                blk.downsamplers = nn.ModuleList([DownsampleAsym(cout)])
+            enc.down_blocks.append(blk)
+            ch = cout
+        mid = block_out_channels[-1]
+        enc.mid_block = nn.Module()
+        enc.mid_block.resnets = nn.ModuleList(
+            [ResnetBlock2D(mid, mid, None, groups=groups, eps=1e-6),
+             ResnetBlock2D(mid, mid, None, groups=groups, eps=1e-6)])
+        enc.mid_block.attentions = nn.ModuleList([VAEAttention(mid, groups)])
+        enc.conv_norm_out = nn.GroupNorm(groups, mid, eps=1e-6)
+        enc.conv_out = nn.Conv2d(mid, 2 * latent_channels, 3, padding=1)
+        self.encoder = enc
+        self.quant_conv = nn.Conv2d(2 * latent_channels, 2 * latent_channels, 1)
+        self.post_quant_conv = nn.Conv2d(latent_channels, latent_channels, 1)
+
+        dec = nn.Module()
+        rev = list(reversed(block_out_channels))
+        dec.conv_in = nn.Conv2d(latent_channels, rev[0], 3, padding=1)
+        dec.mid_block = nn.Module()
+        dec.mid_block.resnets = nn.ModuleList(
+            [ResnetBlock2D(rev[0], rev[0], None, groups=groups, eps=1e-6),
+             ResnetBlock2D(rev[0], rev[0], None, groups=groups, eps=1e-6)])
+        dec.mid_block.attentions = nn.ModuleList([VAEAttention(rev[0], groups)])
+        dec.up_blocks = nn.ModuleList()
+        ch = rev[0]
+        for i, cout in enumerate(rev):
+            blk = nn.Module()
+            blk.resnets = nn.ModuleList(
+                [ResnetBlock2D(ch if j == 0 else cout, cout, None,
+                               groups=groups, eps=1e-6)
+                 for j in range(layers_per_block + 1)])
+            if i < len(rev) - 1:
+                blk.upsamplers = nn.ModuleList([Upsample2D(cout)])
+            dec.up_blocks.append(blk)
+            ch = cout
+        dec.conv_norm_out = nn.GroupNorm(groups, block_out_channels[0],
+                                         eps=1e-6)
+        dec.conv_out = nn.Conv2d(block_out_channels[0], out_channels, 3,
+                                 padding=1)
+        self.decoder = dec
+
+    def encode(self, x):
+        e = self.encoder
+        h = e.conv_in(x)
+        for blk in e.down_blocks:
+            for rn in blk.resnets:
+                h = rn(h)
+            if hasattr(blk, "downsamplers"):
+                h = blk.downsamplers[0](h)
+        h = e.mid_block.resnets[0](h)
+        h = e.mid_block.attentions[0](h)
+        h = e.mid_block.resnets[1](h)
+        h = e.conv_out(F.silu(e.conv_norm_out(h)))
+        h = self.quant_conv(h)
+        mean, logvar = h.chunk(2, dim=1)
+        return mean, torch.clamp(logvar, -30.0, 20.0)
+
+    def decode(self, z, scaling_factor=0.18215):
+        d = self.decoder
+        h = self.post_quant_conv(z / scaling_factor)
+        h = d.conv_in(h)
+        h = d.mid_block.resnets[0](h)
+        h = d.mid_block.attentions[0](h)
+        h = d.mid_block.resnets[1](h)
+        for blk in d.up_blocks:
+            for rn in blk.resnets:
+                h = rn(h)
+            if hasattr(blk, "upsamplers"):
+                h = blk.upsamplers[0](h)
+        return d.conv_out(F.silu(d.conv_norm_out(h)))
