@@ -1,4 +1,7 @@
 """Checkpoint tooling (reference ``deepspeed/checkpoint/``): HF pretrained
-ingestion, universal-checkpoint conversion surface."""
+ingestion, Megatron-LM GPT ingestion, diffusers UNet/VAE ingestion,
+universal-checkpoint conversion surface."""
 
 from .hf import from_pretrained, hf_config, map_hf_params, read_hf_state  # noqa: F401
+from .megatron import from_megatron  # noqa: F401
+from .diffusers import load_unet, load_vae  # noqa: F401

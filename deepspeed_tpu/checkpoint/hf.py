@@ -19,8 +19,10 @@ positions with the +2 offset, ReLU), Bloom (ALiBi + embed-norm), GPT-J
 (rotary_pct, dual-norm parallel residual), GPT-Neo (alternating
 global/local attention, unscaled logits), Falcon-7B-style (multi-query,
 parallel attention), Mixtral (routed experts over the MoE transformer),
-BERT/DistilBERT (post-LN encoders, MLM head), and CLIP (two-tower
-contrastive).
+BERT/DistilBERT (post-LN encoders, MLM head), CLIP (two-tower
+contrastive), and InternLM (llama layout with biased attention
+projections). Megatron-LM GPT checkpoints load via checkpoint/megatron.py;
+diffusers UNet/VAE via checkpoint/diffusers.py.
 
 Formats: ``*.safetensors`` (single or index-sharded) and
 ``pytorch_model.bin`` (torch pickle, single or index-sharded).
@@ -146,6 +148,26 @@ def hf_config(model_dir: str):
             rope_theta=hc.get("rope_theta", 10000.0),
             tie_embeddings=hc.get("tie_word_embeddings", False),
             use_bias=False, norm_eps=hc.get("rms_norm_eps", 1e-6))
+    elif family == "internlm":
+        # reference module_inject/containers/internlm.py:20 — llama-shaped
+        # (RMSNorm + RoPE + gated SiLU) with biases on ALL four attention
+        # projections (config "bias": true) and a bias-free MLP
+        if hc.get("rope_scaling"):
+            raise NotImplementedError("internlm rope_scaling not supported")
+        bias = bool(hc.get("bias", True))
+        cfg = TransformerConfig(
+            vocab_size=hc["vocab_size"], d_model=hc["hidden_size"],
+            n_layers=hc["num_hidden_layers"],
+            n_heads=hc["num_attention_heads"],
+            n_kv_heads=hc.get("num_key_value_heads",
+                              hc["num_attention_heads"]),
+            d_ff=hc["intermediate_size"],
+            max_seq_len=hc.get("max_position_embeddings", 2048),
+            norm="rms", activation="silu_glu", position="rope",
+            rope_theta=hc.get("rope_theta", 10000.0),
+            tie_embeddings=hc.get("tie_word_embeddings", False),
+            use_bias=False, qkv_bias=bias, attn_o_bias=bias,
+            norm_eps=hc.get("rms_norm_eps", 1e-6))
     elif family == "qwen2":
         if hc.get("rope_scaling"):
             raise NotImplementedError("qwen2 rope_scaling not supported")
@@ -432,6 +454,8 @@ def _map_llama(state, c) -> Dict[str, Any]:
         layers["bq"] = _stack(state, L + "self_attn.q_proj.bias", n)
         layers["bk"] = _stack(state, L + "self_attn.k_proj.bias", n)
         layers["bv"] = _stack(state, L + "self_attn.v_proj.bias", n)
+    if getattr(c, "attn_o_bias", False):  # InternLM: o_proj bias too
+        layers["bo"] = _stack(state, L + "self_attn.o_proj.bias", n)
     params = {
         "tok_embed": state[pre + "embed_tokens.weight"],
         "layers": layers,
@@ -900,6 +924,7 @@ def _map_clip(state, c) -> Dict[str, Any]:
 
 _MAPPERS: Dict[str, Callable] = {
     "llama": _map_llama, "mistral": _map_llama, "qwen2": _map_llama,
+    "internlm": _map_llama,
     "gpt2": _map_gpt2, "opt": _map_opt,
     "bloom": _map_bloom, "gptj": _map_gptj, "gpt_neox": _map_gpt_neox,
     "gpt_neo": _map_gpt_neo,

@@ -1,0 +1,173 @@
+"""Megatron-LM GPT checkpoint ingestion.
+
+Parity target: the reference's Megatron policy + container
+(``module_inject/containers/megatron_gpt.py:1``,
+``containers/features/megatron.py:27`` — the megatron_v2 fused-qkv
+re-interleave) and its checkpoint loader surface
+(``module_inject/load_checkpoint.py`` megatron branch). Megatron's GPT is
+architecturally GPT-2 (pre-LN, learned positions, gelu, fused qkv, tied
+head), so ingestion lands on the same native stacked layout the GPT-2
+family uses — only the checkpoint format differs:
+
+* file: ``<dir>/mp_rank_00/model_optim_rng.pt`` (or ``model_rng.pt``) —
+  a torch pickle ``{"model": {"language_model": ...}, "args",
+  "checkpoint_version"}``.
+* fused qkv ordering: checkpoint_version >= 2 stores rows as
+  [heads, (q|k|v), head_dim] ("megatron_v2"); v1 stores [(q|k|v), heads,
+  head_dim]. The native layout wants the v1 (flat q|k|v) order — v2
+  checkpoints are de-interleaved exactly like the reference's
+  ``_align_qkv_transposed``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["read_megatron_state", "megatron_config", "map_megatron_gpt",
+           "from_megatron"]
+
+
+def _flatten(prefix: str, tree: Any, out: Dict[str, np.ndarray]) -> None:
+    import torch
+
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+    elif isinstance(tree, torch.Tensor):
+        from .hf import _to_numpy
+
+        out[prefix] = _to_numpy(tree.detach().cpu())
+
+
+def read_megatron_state(ckpt_dir: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any], float]:
+    """Read a Megatron-LM checkpoint directory (single mp rank).
+
+    Returns (flat state, args dict, checkpoint_version)."""
+    import torch
+
+    d = str(ckpt_dir)
+    candidates = [d]
+    for sub in ("mp_rank_00",):
+        candidates.append(os.path.join(d, sub))
+    path = None
+    for c in candidates:
+        for name in ("model_optim_rng.pt", "model_rng.pt", "model.pt"):
+            p = os.path.join(c, name)
+            if os.path.exists(p):
+                path = p
+                break
+        if path:
+            break
+    if path is None:
+        raise FileNotFoundError(f"no Megatron checkpoint under {d}")
+    blob = torch.load(path, map_location="cpu", weights_only=False)
+    model = blob.get("model", blob)
+    lm = model.get("language_model", model)
+    flat: Dict[str, np.ndarray] = {}
+    _flatten("", lm, flat)
+    args = blob.get("args")
+    args = vars(args) if args is not None and not isinstance(args, dict) else (args or {})
+    version = float(blob.get("checkpoint_version", 0))
+    return flat, args, version
+
+
+def megatron_config(args: Dict[str, Any]):
+    """Megatron args -> native TransformerConfig (GPT-2 architecture)."""
+    from ..models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=args["padded_vocab_size"],
+        d_model=args["hidden_size"],
+        n_layers=args["num_layers"],
+        n_heads=args["num_attention_heads"],
+        n_kv_heads=args["num_attention_heads"],
+        d_ff=args.get("ffn_hidden_size", 4 * args["hidden_size"]),
+        max_seq_len=args["max_position_embeddings"],
+        norm="layer", activation="gelu", position="learned",
+        tie_embeddings=True, use_bias=True,
+        norm_eps=args.get("layernorm_epsilon", 1e-5))
+
+
+def _deinterleave_qkv(x: np.ndarray, n_heads: int) -> np.ndarray:
+    """megatron_v2 fused qkv rows [heads, 3, hd] -> flat [3, heads, hd]
+    (reference features/megatron.py:16 _align_qkv_transposed, numpy form).
+    Works for [3h, ...] weights and [3h] biases."""
+    three_h = x.shape[0]
+    hd = three_h // n_heads // 3
+    grouped = x.reshape(n_heads, 3, hd, *x.shape[1:])
+    return np.concatenate([grouped[:, i] for i in range(3)],
+                          axis=0).reshape(x.shape)
+
+
+def map_megatron_gpt(state: Dict[str, np.ndarray], c,
+                     checkpoint_version: float = 3.0) -> Dict[str, Any]:
+    """Flat Megatron language_model state -> native stacked pytree."""
+    n = c.n_layers
+    # keys may carry the 'transformer.' (classic) or 'encoder.' prefix
+    pre = "transformer."
+    if not any(k.startswith(pre) for k in state):
+        pre = "encoder."
+    L = pre + "layers.{}."
+
+    def qkv(fmt, is_bias):
+        arrs = []
+        for i in range(n):
+            x = state.pop(fmt.format(i))
+            if checkpoint_version >= 2.0:
+                x = _deinterleave_qkv(x, c.n_heads)
+            arrs.append(x if is_bias else x.T)  # Linear [out,in] -> [in,out]
+        return np.stack(arrs)
+
+    qkv_w = qkv(L + "attention.query_key_value.weight", False)
+    qkv_b = qkv(L + "attention.query_key_value.bias", True)
+    d = c.d_model
+    wq, wk, wv = qkv_w[:, :, :d], qkv_w[:, :, d:2 * d], qkv_w[:, :, 2 * d:]
+    bq, bk, bv = qkv_b[:, :d], qkv_b[:, d:2 * d], qkv_b[:, 2 * d:]
+
+    def stack(fmt, transpose=False):
+        arrs = [state.pop(fmt.format(i)) for i in range(n)]
+        return np.stack([a.T for a in arrs] if transpose else arrs)
+
+    layers = {
+        "attn_norm_w": stack(L + "input_layernorm.weight"),
+        "attn_norm_b": stack(L + "input_layernorm.bias"),
+        "wq": wq, "wk": wk, "wv": wv, "bq": bq, "bk": bk, "bv": bv,
+        "wo": stack(L + "attention.dense.weight", transpose=True),
+        "bo": stack(L + "attention.dense.bias"),
+        "mlp_norm_w": stack(L + "post_attention_layernorm.weight"),
+        "mlp_norm_b": stack(L + "post_attention_layernorm.bias"),
+        "w_up": stack(L + "mlp.dense_h_to_4h.weight", transpose=True),
+        "b_up": stack(L + "mlp.dense_h_to_4h.bias"),
+        "w_down": stack(L + "mlp.dense_4h_to_h.weight", transpose=True),
+        "b_down": stack(L + "mlp.dense_4h_to_h.bias"),
+    }
+    return {
+        "tok_embed": state["embedding.word_embeddings.weight"],
+        "pos_embed": state["embedding.position_embeddings.weight"],
+        "layers": layers,
+        "final_norm_w": state[pre + "final_layernorm.weight"],
+        "final_norm_b": state[pre + "final_layernorm.bias"],
+    }
+
+
+def from_megatron(ckpt_dir: str, dtype=None, topology=None):
+    """(model, params) from a Megatron-LM GPT checkpoint directory —
+    the Megatron analog of checkpoint.from_pretrained."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.transformer import Transformer
+
+    state, args, version = read_megatron_state(ckpt_dir)
+    cfg = megatron_config(args)
+    model = Transformer(cfg)
+    params = map_megatron_gpt(state, cfg, checkpoint_version=version)
+    dtype = dtype or jnp.float32
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, dtype), params)
+    if topology is not None:
+        model.bind_topology(topology)
+    return model, params

@@ -110,6 +110,22 @@ def _save_tiny(tmp_path, family: str, safe: bool):
                 torch.randn_like(m.cls.predictions.decoder.weight) * 0.02)
         assert not torch.equal(m.cls.predictions.decoder.weight,
                                m.bert.embeddings.word_embeddings.weight)
+    elif family == "internlm":
+        # InternLM-7B is llama-shaped with biases on all four attention
+        # projections: transformers' LlamaForCausalLM(attention_bias=True)
+        # produces the exact key set; relabel model_type to drive the
+        # internlm config path (reference containers/internlm.py)
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=176,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+            max_position_embeddings=128, rms_norm_eps=1e-6,
+            attention_bias=True, tie_word_embeddings=False)
+        m = transformers.LlamaForCausalLM(hf_cfg)
+        with torch.no_grad():  # make the biases demonstrably non-zero
+            for layer in m.model.layers:
+                for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                             layer.self_attn.v_proj, layer.self_attn.o_proj):
+                    proj.bias.add_(torch.randn_like(proj.bias) * 0.05)
     elif family == "distilbert":
         hf_cfg = transformers.DistilBertConfig(
             vocab_size=256, dim=64, n_layers=2, n_heads=4, hidden_dim=256,
@@ -120,6 +136,13 @@ def _save_tiny(tmp_path, family: str, safe: bool):
     m = m.eval()
     d = tmp_path / family
     m.save_pretrained(str(d), safe_serialization=safe)
+    if family == "internlm":
+        import json
+        cfg_path = d / "config.json"
+        hc = json.loads(cfg_path.read_text())
+        hc["model_type"] = "internlm"
+        hc["bias"] = True
+        cfg_path.write_text(json.dumps(hc))
     return m, str(d)
 
 
@@ -133,7 +156,8 @@ def _save_tiny(tmp_path, family: str, safe: bool):
                                          ("bert_untied", True),
                                          ("distilbert", True),
                                          ("gpt_neo", True),
-                                         ("qwen2", True)])
+                                         ("qwen2", True),
+                                         ("internlm", True)])
 def test_hf_logits_parity(tmp_path, family, safe):
     """Native forward on ingested weights == torch forward (fp32)."""
     hf_model, d = _save_tiny(tmp_path, family, safe)
@@ -286,3 +310,81 @@ def test_hf_config_errors(tmp_path):
     (tmp_path / "config.json").write_text('{"model_type": "mamba"}')
     with pytest.raises(ValueError, match="unsupported HF model_type"):
         hf_config(str(tmp_path))
+
+
+# ----------------------------------------------------------------------
+# Megatron-LM GPT checkpoints (reference module_inject/containers/
+# megatron_gpt.py + features/megatron.py megatron_v2 qkv re-interleave)
+
+def _gpt2_to_megatron(m, d_model, n_heads, version):
+    """Serialize a transformers GPT-2 as a Megatron-LM checkpoint blob —
+    the inverse of map_megatron_gpt, including the v2 qkv interleave."""
+    sd = {k: v.detach().clone() for k, v in m.state_dict().items()}
+    hd = d_model // n_heads
+    layers = {}
+    n = m.config.n_layer
+    for i in range(n):
+        pre = f"transformer.h.{i}."
+        # Conv1D [in, out] -> Linear [out, in]
+        qkv_w = sd[pre + "attn.c_attn.weight"].T.contiguous()  # [3d, d]
+        qkv_b = sd[pre + "attn.c_attn.bias"].contiguous()      # [3d]
+        if version >= 2.0:
+            # flat [3, heads, hd] rows -> interleaved [heads, 3, hd]
+            qkv_w = qkv_w.reshape(3, n_heads, hd, d_model) \
+                .permute(1, 0, 2, 3).reshape(3 * d_model, d_model)
+            qkv_b = qkv_b.reshape(3, n_heads, hd).permute(1, 0, 2).reshape(-1)
+        L = f"layers.{i}."
+        layers.update({
+            L + "input_layernorm.weight": sd[pre + "ln_1.weight"],
+            L + "input_layernorm.bias": sd[pre + "ln_1.bias"],
+            L + "attention.query_key_value.weight": qkv_w,
+            L + "attention.query_key_value.bias": qkv_b,
+            L + "attention.dense.weight": sd[pre + "attn.c_proj.weight"].T.contiguous(),
+            L + "attention.dense.bias": sd[pre + "attn.c_proj.bias"],
+            L + "post_attention_layernorm.weight": sd[pre + "ln_2.weight"],
+            L + "post_attention_layernorm.bias": sd[pre + "ln_2.bias"],
+            L + "mlp.dense_h_to_4h.weight": sd[pre + "mlp.c_fc.weight"].T.contiguous(),
+            L + "mlp.dense_h_to_4h.bias": sd[pre + "mlp.c_fc.bias"],
+            L + "mlp.dense_4h_to_h.weight": sd[pre + "mlp.c_proj.weight"].T.contiguous(),
+            L + "mlp.dense_4h_to_h.bias": sd[pre + "mlp.c_proj.bias"],
+        })
+    layers["final_layernorm.weight"] = sd["transformer.ln_f.weight"]
+    layers["final_layernorm.bias"] = sd["transformer.ln_f.bias"]
+    lm = {
+        "embedding": {
+            "word_embeddings": {"weight": sd["transformer.wte.weight"]},
+            "position_embeddings": {"weight": sd["transformer.wpe.weight"]},
+        },
+        "transformer": layers,
+    }
+    args = {"padded_vocab_size": m.config.vocab_size,
+            "hidden_size": d_model, "num_layers": n,
+            "num_attention_heads": n_heads,
+            "ffn_hidden_size": 4 * d_model,
+            "max_position_embeddings": m.config.n_positions,
+            "layernorm_epsilon": m.config.layer_norm_epsilon}
+    return {"model": {"language_model": lm}, "args": args,
+            "checkpoint_version": version}
+
+
+@pytest.mark.parametrize("version", [3.0, 1.0])
+def test_megatron_gpt_logits_parity(tmp_path, version):
+    """Megatron checkpoint (v2 interleaved and v1 flat qkv) ingests to
+    logits parity with the equivalent torch GPT-2."""
+    from deepspeed_tpu.checkpoint.megatron import from_megatron
+
+    torch.manual_seed(0)
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_positions=128)
+    m = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    blob = _gpt2_to_megatron(m, 64, 4, version)
+    d = tmp_path / "megatron" / "mp_rank_00"
+    d.mkdir(parents=True)
+    torch.save(blob, str(d / "model_optim_rng.pt"))
+
+    model, params = from_megatron(str(tmp_path / "megatron"))
+    tokens = np.random.default_rng(0).integers(1, 250, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
