@@ -1,0 +1,214 @@
+"""North-star feasibility: Llama-2-7B ZeRO-3 bf16 on a v5p-64 mesh.
+
+BASELINE.json config 4 ("Llama-2-7B pretrain, ZeRO-3 + param offload
+disabled, bf16, v5p-64") is the 45%-MFU north star. Real v5p-64 hardware
+isn't available, but feasibility is a compile-time property: this script
+AOT-compiles the full fused train step (bf16 compute, fp32 master AdamW,
+ZeRO-3 param/grad/opt sharding, remat) over a VIRTUAL 64-device mesh on
+CPU — no parameter is ever materialized (ShapeDtypeStructs end to end,
+same path as deepspeed_tpu.autotuning) — and records XLA's own
+``memory_analysis()`` / ``cost_analysis()`` against the v5p chip budget
+(95 GB HBM, 459 TFLOP/s bf16, 2765 GB/s HBM).
+
+Writes NORTHSTAR_r04.json:
+  per-config: peak HBM bytes/chip vs budget, argument/temp split,
+  whole-step FLOPs, roofline step time, predicted MFU, collective
+  counts from the compiled HLO (all-gather / reduce-scatter / all-reduce
+  — the ZeRO-3 schedule GSPMD emitted), and the remat plan.
+
+Usage: python scripts/northstar_feasibility.py   (runs itself on CPU with
+64 virtual devices; the axon TPU plugin is disarmed in the child).
+"""
+
+from __future__ import annotations
+
+import json
+import numpy as np
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+_CHILD = "_DST_NORTHSTAR_CHILD"
+
+# v5p chip: bf16 peak FLOP/s, HBM bytes, HBM GB/s  (autotuner CHIP_SPECS)
+V5P_PEAK = 459e12
+V5P_HBM = 95e9
+V5P_BW = 2765e9
+
+CONFIGS = [
+    # (name, micro_batch_per_chip, seq, remat)
+    ("mb1_s4096_remat", 1, 4096, "full"),
+    ("mb2_s4096_remat", 2, 4096, "full"),
+    ("mb1_s4096_selective", 1, 4096, "selective"),
+]
+
+
+def _run_child():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import Llama
+    from deepspeed_tpu.parallel.mesh import Topology, reset_topology
+    from deepspeed_tpu.parallel.zero import ZeroShardingRules
+    from deepspeed_tpu.config import Config, MeshConfig
+
+    n = 64
+    assert len(jax.devices()) >= n, len(jax.devices())
+    report = {"target": "Llama-2-7B ZeRO-3 bf16 on v5p-64 (BASELINE config 4)",
+              "chip": {"name": "v5p", "hbm_bytes": V5P_HBM,
+                       "peak_bf16_flops": V5P_PEAK, "hbm_gbps": V5P_BW / 1e9},
+              "n_devices": n, "configs": []}
+
+    for name, mb, seq, remat in CONFIGS:
+        reset_topology()
+        model = Llama("7b", use_flash=False, remat=True, remat_policy=remat)
+        topo = Topology.build(MeshConfig(data=n), devices=jax.devices()[:n])
+        cfg = Config.from_any({
+            "train_batch_size": mb * n,
+            "zero_optimization": {"stage": 3,
+                                  "stage3_param_persistence_threshold": 0},
+            "bf16": {"enabled": True},
+        })
+        rules = ZeroShardingRules(topo, cfg.zero)
+        if hasattr(model, "bind_topology"):
+            model.bind_topology(topo)
+
+        param_struct = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+        tp_specs = (model.partition_specs(param_struct, topo)
+                    if hasattr(model, "partition_specs") else None)
+        p32 = jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, jnp.float32), param_struct)
+        param_sh = rules.param_shardings(p32, tp_specs)
+        grad_sh = rules.grad_shardings(p32, tp_specs)
+        opt_sh = rules.opt_state_shardings(p32)
+        batch_struct = {"input_ids": jax.ShapeDtypeStruct((mb * n, seq),
+                                                          jnp.int32)}
+        batch_sh = {"input_ids": topo.batch_sharding(2)}
+
+        def step(params, mu, nu, batch, rng):
+            def loss_fn(p):
+                pc = jax.tree_util.tree_map(
+                    lambda x: x.astype(jnp.bfloat16)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+                return model.loss(pc, batch, rng)
+
+            grads = jax.grad(loss_fn)(params)
+            grads = jax.lax.with_sharding_constraint(grads, grad_sh)
+            t = jax.tree_util.tree_map
+            mu = t(lambda m, g: 0.9 * m + 0.1 * g, mu, grads)
+            nu = t(lambda v, g: 0.99 * v + 0.01 * g * g, nu, grads)
+            params = t(lambda p, m, v: p - 1e-4 * m / (jnp.sqrt(v) + 1e-8),
+                       params, mu, nu)
+            return (jax.lax.with_sharding_constraint(params, param_sh),
+                    mu, nu)
+
+        entry = {"name": name, "micro_batch_per_chip": mb, "seq_len": seq,
+                 "global_batch": mb * n, "remat": remat}
+        try:
+            lowered = jax.jit(
+                step,
+                in_shardings=(param_sh, opt_sh, opt_sh, batch_sh, None),
+                out_shardings=(param_sh, opt_sh, opt_sh),
+            ).lower(p32, p32, p32, batch_struct,
+                    jax.ShapeDtypeStruct((2,), jnp.uint32))
+            compiled = lowered.compile()
+        except Exception as e:  # noqa: BLE001 - recorded, not fatal
+            entry.update(feasible=False, error=f"{type(e).__name__}: {e}")
+            report["configs"].append(entry)
+            continue
+
+        mem = compiled.memory_analysis()
+        args_b = float(getattr(mem, "argument_size_in_bytes", 0.0) or 0.0)
+        temp_b = float(getattr(mem, "temp_size_in_bytes", 0.0) or 0.0)
+        out_b = float(getattr(mem, "output_size_in_bytes", 0.0) or 0.0)
+        # outputs alias donated inputs in the real engine (donate_argnums) —
+        # count max(args, outputs), not both
+        peak = max(args_b, out_b) + temp_b
+        peak_per_dev = peak / n
+
+        cost = compiled.cost_analysis() or {}
+        if isinstance(cost, list):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        bytes_acc = float(cost.get("bytes accessed", 0.0))
+
+        # Roofline prediction. Compute term: ANALYTIC model FLOPs (6ND +
+        # attention — XLA's CPU-backend counters are not trustworthy for
+        # fused dots). Comm term: ZeRO-3 moves the full bf16 parameter set
+        # through all-gathers twice per step (fwd + bwd re-gather) and the
+        # grads once through reduce-scatter — modeled against v5p ICI
+        # (~600 GB/s/chip aggregate, ~300 GB/s effective per direction).
+        # GSPMD overlaps these with compute, so the honest prediction is
+        #   step >= max(compute, comm)   (perfect overlap)
+        #   step <= compute + comm       (no overlap)
+        # and MFU_pred is quoted for the overlapped bound.
+        tokens = mb * n * seq
+        model_flops = model.config.flops_per_token(seq) * tokens
+        compute_s = model_flops / n / V5P_PEAK
+        param_bytes = sum(int(np.prod(s.shape)) * 2  # bf16 compute copy
+                          for s in jax.tree_util.tree_leaves(p32))
+        ici_eff = 300e9
+        comm_s = 3 * param_bytes * (n - 1) / n / ici_eff
+        bw_s = bytes_acc / n / V5P_BW if bytes_acc > 0 else 0.0
+        est_step = max(compute_s, comm_s, bw_s)
+        mfu_pred = compute_s / max(est_step, 1e-12)
+
+        # the ZeRO-3 collective schedule GSPMD emitted
+        hlo = compiled.as_text()
+        colls = {c: hlo.count(f" {c}(")
+                 for c in ("all-gather", "reduce-scatter", "all-reduce",
+                           "all-to-all", "collective-permute")}
+
+        entry.update(
+            feasible=peak_per_dev <= V5P_HBM,
+            hbm_per_chip_gb=round(peak_per_dev / 1e9, 2),
+            hbm_budget_gb=V5P_HBM / 1e9,
+            hbm_utilization=round(peak_per_dev / V5P_HBM, 4),
+            argument_gb_per_chip=round(args_b / n / 1e9, 2),
+            temp_gb_per_chip=round(temp_b / n / 1e9, 2),
+            step_flops_total=flops,
+            compute_s=round(compute_s, 4),
+            zero3_comm_s_if_serial=round(comm_s, 4),
+            zero3_comm_gb_per_step=round(3 * param_bytes * (n - 1) / n / 1e9, 1),
+            roofline_step_s=round(est_step, 4),
+            tokens_per_step=tokens,
+            pred_tokens_per_sec_per_chip=round(tokens / n / est_step, 1),
+            model_flops_per_step=model_flops,
+            pred_mfu=round(mfu_pred, 4),
+            collectives=colls,
+        )
+        report["configs"].append(entry)
+        print(f"[northstar] {name}: hbm {entry['hbm_per_chip_gb']} GB/chip "
+              f"(budget {V5P_HBM / 1e9:.0f}), pred_mfu {entry['pred_mfu']}",
+              flush=True)
+
+    ok = [c for c in report["configs"] if c.get("feasible")]
+    report["feasible_count"] = len(ok)
+    report["verdict"] = (
+        "FITS: ZeRO-3 Llama-2-7B compiles and fits v5p-64 HBM with "
+        "headroom; pred_mfu is a roofline CEILING (compute vs HBM-bytes "
+        "only — collective latency not modeled), not a measurement"
+        if ok else "DOES NOT FIT")
+    with open(os.path.join(HERE, "NORTHSTAR_r04.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({"feasible": len(ok), "total": len(report["configs"])}))
+
+
+def main():
+    if os.environ.get(_CHILD) == "1":
+        _run_child()
+        return 0
+    from __graft_entry__ import cpu_child_env
+    env = cpu_child_env(64)
+    env[_CHILD] = "1"
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, cwd=HERE, timeout=3600)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
