@@ -44,9 +44,14 @@ def _use_pallas_paged(head_dim: int, block: int, dtype,
                       scalar_ints: int = 0) -> bool:
     """Pallas paged kernel eligibility: real TPU + tileable page shape +
     prefetched scalars (per-seq tables, slots, positions) fitting in SMEM
-    (1 MB/core; keep them under half)."""
+    (1 MB/core; keep them under half). DST_RAGGED_FORCE_GATHER=1 pins the
+    XLA gather path (serve-bench A/B lever)."""
+    import os
+
     from ..ops.attention import _on_tpu
 
+    if os.environ.get("DST_RAGGED_FORCE_GATHER") == "1":
+        return False
     if not _on_tpu():
         return False
     if scalar_ints * 4 > 512 * 1024:

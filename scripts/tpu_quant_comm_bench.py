@@ -1,0 +1,127 @@
+"""On-chip microbench for the quantized-collective (qwZ/qgZ) math.
+
+The ZeRO++ claim is comm-volume savings: int8 weight gathers (qwZ, 4x
+fewer wire bytes than bf16... 2x vs bf16, 4x vs fp32) and two-hop int8
+gradient reduction (qgZ). On a single chip the wire is not measurable,
+but the COST side of the tradeoff is: the quantize/dequantize pack-unpack
+that brackets every collective. This driver times, compiled on the real
+chip at realistic ZeRO shard sizes:
+
+  * quantize_blockwise int8 + dequantize (qwZ pack/unpack)
+  * int8_pmean's quant+dequant stages run WITHOUT the psum (qgZ pack cost)
+  * the dense bf16 copy baseline (what the unquantized path pays)
+
+and reports the break-even link bandwidth per shape: quantization wins
+whenever wire_time_saved > pack_cost, i.e. when the effective per-chip
+link bandwidth is BELOW  bytes_saved / pack_s. v5e ICI (~400 GB/s/chip
+class) vs DCN (~25 GB/s class) then says where qwZ/qgZ belong — the
+reference positions them the same way (hpZ keeps gathers inside the
+node; qwZ/qgZ earn their keep across slower links,
+blogs/zeropp/README.md).
+
+Writes QUANT_COMM_r04.json. Usage: python scripts/tpu_quant_comm_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+# realistic per-step payloads: a 7B layer's bf16 shard at dp=64, a fused
+# grad bucket, a full transformer block
+SHAPES = [(1 << 20,), (1 << 22,), (1 << 24,)]   # 1M / 4M / 16M elements
+
+
+def _chain_ms(fn, x, iters=30):
+    """Data-dependent chained timing with a null-loop floor (the axon-relay
+    methodology from tpu_flash_check.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chained(x):
+        def body(i, acc):
+            y = fn(acc)
+            # fold the result back so iterations are data-dependent
+            return acc + 0.0 * y.astype(acc.dtype).reshape(acc.shape)
+
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    @jax.jit
+    def null(x):
+        def body(i, acc):
+            return acc + 0.0 * acc
+
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    for f in (chained, null):
+        float(jnp.sum(f(x)))  # compile + warm
+    t0 = time.perf_counter()
+    float(jnp.sum(chained(x)))
+    t_full = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(jnp.sum(null(x)))
+    t_null = time.perf_counter() - t0
+    ms = (t_full - t_null) / iters * 1e3
+    if ms <= 0:
+        raise RuntimeError(f"workload too small to resolve ({ms} ms)")
+    return ms
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.quantizer import dequantize_blockwise, quantize_blockwise
+
+    assert jax.devices()[0].platform == "tpu", "requires a real TPU"
+    report = {"metric": "quantized_collective_pack_cost",
+              "device": jax.devices()[0].device_kind, "rows": []}
+    rng = np.random.default_rng(0)
+    for (numel,) in SHAPES:
+        x = jnp.asarray(rng.standard_normal(numel), jnp.bfloat16)
+
+        def pack_unpack(v):
+            q, s = quantize_blockwise(v.astype(jnp.float32), bits=8, block=256)
+            return dequantize_blockwise(q, s, v.shape).astype(jnp.bfloat16)
+
+        def dense_copy(v):
+            return (v.astype(jnp.float32) * 1.0000001).astype(jnp.bfloat16)
+
+        pack_ms = _chain_ms(pack_unpack, x)
+        dense_ms = _chain_ms(dense_copy, x)
+        bf16_bytes = numel * 2
+        int8_bytes = numel * 1 + (numel // 256) * 4   # payload + scales
+        saved = bf16_bytes - int8_bytes
+        # quantization wins when wire_bytes_saved / link_bw > pack_overhead
+        overhead_s = max(pack_ms - dense_ms, 1e-6) / 1e3
+        breakeven_gbps = saved / overhead_s / 1e9
+        report["rows"].append({
+            "numel": numel,
+            "pack_unpack_ms": round(pack_ms, 4),
+            "dense_baseline_ms": round(dense_ms, 4),
+            "wire_bytes_saved": saved,
+            "breakeven_link_gbps": round(breakeven_gbps, 1),
+            "wins_on_ici_400gbps": bool(breakeven_gbps > 400),
+            "wins_on_dcn_25gbps": bool(breakeven_gbps > 25),
+        })
+        print(f"[quant-comm] {report['rows'][-1]}", flush=True)
+    report["verdict"] = (
+        "int8 collectives pay off below the break-even link bandwidth; "
+        "rows where wins_on_ici_400gbps is false are DCN/cross-host "
+        "features (the reference's qwZ/qgZ positioning), not v5e-ICI wins")
+    with open(os.path.join(HERE, "QUANT_COMM_r04.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({"rows": len(report["rows"])}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,240 @@
+"""SLA-constrained serving benchmark over the ragged engine.
+
+FastGen's headline metric is throughput under a latency SLA with a live
+arrival process, not fixed-batch decode
+(``/root/reference/blogs/deepspeed-fastgen/README.md:28,139`` — requests
+arrive, prefill and decode share the token budget via Dynamic SplitFuse,
+and the system is judged by qps sustained at a p95 per-token latency).
+This driver reproduces that methodology on TPU:
+
+* Poisson arrivals at each swept rate; prompt lengths drawn from a mixed
+  pool (short chat / medium / long context), fixed output length.
+* One ``put()`` call per engine tick serves every live sequence (decodes
+  + one prefill chunk — the engine's SplitFuse schedule); greedy token
+  appended per sequence; per-token latencies attributed per tick.
+* Reported per rate: achieved qps, generation tok/s, p50/p95 per-token
+  latency (decode ticks), p95 TTFT, and whether the p95 token latency
+  meets the SLA. The qps-vs-SLA curve is the committed artifact.
+* A/B: the Pallas paged-attention path vs DST_RAGGED_FORCE_GATHER=1 in a
+  child process (one chip claim per run through the axon relay).
+
+Writes SERVE_BENCH_r04.json. Usage: python scripts/tpu_serve_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+_CHILD = "_DST_SERVE_CHILD"
+
+_SMOKE = os.environ.get("DST_SERVE_SMOKE") == "1"   # CPU logic check
+
+SLA_MS = 50.0 if not _SMOKE else 10000.0   # p95 per-token latency target
+PROMPT_POOL = (128, 512, 1200) if not _SMOKE else (16, 32)
+PROMPT_MIX = (0.5, 0.35, 0.15) if not _SMOKE else (0.5, 0.5)
+OUT_TOKENS = 64 if not _SMOKE else 4
+DURATION_S = 20.0 if not _SMOKE else 2.0   # per-rate measurement window
+RATES = (1.0, 2.0, 4.0, 8.0, 12.0) if not _SMOKE else (2.0,)
+
+
+def _build_engine():
+    import jax
+
+    from deepspeed_tpu.inference.ragged import RaggedConfig, RaggedInferenceEngine
+    from deepspeed_tpu.models import Llama
+
+    if _SMOKE:
+        model = Llama("tiny", d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=4, vocab_size=256, max_seq_len=128,
+                      use_flash=False, remat=False)
+        cfg = RaggedConfig(token_budget=128, max_seqs=8, kv_block_size=16,
+                           n_kv_blocks=64, max_context=128)
+    else:
+        model = Llama("tiny", d_model=1024, n_layers=16, n_heads=16,
+                      n_kv_heads=16, d_ff=2816, vocab_size=32000,
+                      max_seq_len=2048, use_flash=False, remat=False)
+        cfg = RaggedConfig(token_budget=2048, max_seqs=64, kv_block_size=16,
+                           n_kv_blocks=6144, max_context=2048)
+    return RaggedInferenceEngine(model, cfg, rng=jax.random.PRNGKey(0)), model
+
+
+def _run_rate(eng, rate: float, rng: np.random.Generator):
+    """Serve a Poisson arrival stream at ``rate`` req/s for DURATION_S."""
+    # pre-draw the arrival schedule
+    arrivals = []
+    t = 0.0
+    uid = 0
+    while t < DURATION_S:
+        t += rng.exponential(1.0 / rate)
+        plen = int(rng.choice(PROMPT_POOL, p=PROMPT_MIX))
+        arrivals.append((t, uid, plen))
+        uid += 1
+    live: dict = {}          # uid -> {"generated": int, "t_arrive", "t_first"}
+    waiting: list = []       # admission queue (FIFO): overload -> TTFT grows
+    token_lat, ttft, done = [], [], 0
+    t0 = time.perf_counter()
+    i_arr = 0
+    while True:
+        now = time.perf_counter() - t0
+        if now > DURATION_S + 60.0:   # drain cap: overloaded system
+            break
+        # arrivals whose time has come join the admission queue; admit
+        # from the FIFO while capacity allows (queue wait shows up in TTFT)
+        while i_arr < len(arrivals) and arrivals[i_arr][0] <= now:
+            waiting.append(arrivals[i_arr])
+            i_arr += 1
+        new_uids, new_toks = [], []
+        while waiting:
+            t_arr, u, plen = waiting[0]
+            if len(eng.seqs) + len(new_uids) >= eng.config.max_seqs or \
+                    not eng.can_schedule([u], [plen + OUT_TOKENS]):
+                break
+            waiting.pop(0)
+            new_uids.append(u)
+            new_toks.append(rng.integers(1, 32000, (plen,)).tolist())
+            live[u] = {"generated": 0, "t_arrive": t_arr,
+                       "t_first": None, "last": None}
+        # schedule decode continuations (one sampled token) and drive
+        # still-prefilling sequences with put(uid, []) — they must appear
+        # in EVERY tick so the completing tick's logits are observed
+        for u, st in live.items():
+            if u in new_uids:
+                continue
+            if st["last"] is not None:
+                new_uids.append(u)
+                new_toks.append([st["last"]])
+                st["last"] = None
+            elif st["t_first"] is None:
+                new_uids.append(u)
+                new_toks.append([])
+        if not new_uids or not any(
+                t or eng.seqs[u].pending for u, t in zip(new_uids, new_toks)):
+            if i_arr >= len(arrivals) and not live and not waiting:
+                break
+            time.sleep(0.001)
+            continue
+        tick0 = time.perf_counter()
+        logits = eng.put(new_uids, new_toks)
+        tick_ms = (time.perf_counter() - tick0) * 1e3
+        now = time.perf_counter() - t0
+        finished = []
+        n_decoded = 0
+        for row, u in zip(logits, new_uids):
+            if np.isnan(row[0]):
+                continue                      # still mid-prefill
+            st = live[u]
+            tok = int(np.argmax(row))
+            if st["t_first"] is None:
+                st["t_first"] = now
+                ttft.append((now - st["t_arrive"]) * 1e3)
+            else:
+                n_decoded += 1
+            st["generated"] += 1
+            if st["generated"] >= OUT_TOKENS:
+                finished.append(u)
+            else:
+                st["last"] = tok
+        token_lat.extend([tick_ms] * max(n_decoded, 0))
+        if finished:
+            eng.flush(finished)
+            for u in finished:
+                live.pop(u)
+                done += 1
+        if i_arr >= len(arrivals) and not live and not waiting:
+            break
+    wall = time.perf_counter() - t0
+    gen_tokens = done * OUT_TOKENS + sum(st["generated"] for st in live.values())
+    # drop any drained-but-unfinished sequences so the next swept rate
+    # starts from an empty engine
+    leftover = [u for u in live if u in eng.seqs]
+    if leftover:
+        eng.flush(leftover)
+    lat = np.asarray(token_lat) if token_lat else np.asarray([float("inf")])
+    undrained = len(live) + len(waiting) + (len(arrivals) - i_arr)
+    return {
+        "offered_qps": rate,
+        "completed": done,
+        "undrained": undrained,
+        "achieved_qps": round(done / wall, 2),
+        "gen_tokens_per_s": round(gen_tokens / wall, 1),
+        "p50_token_ms": round(float(np.percentile(lat, 50)), 2),
+        "p95_token_ms": round(float(np.percentile(lat, 95)), 2),
+        "p95_ttft_ms": round(float(np.percentile(np.asarray(ttft), 95)), 1)
+        if ttft else None,
+        # the SLA verdict: per-token p95 within budget AND the offered
+        # load fully drained (an overloaded system never catches up)
+        "meets_sla": bool(np.percentile(lat, 95) <= SLA_MS and undrained == 0),
+    }
+
+
+def _run_child():
+    import jax
+
+    assert _SMOKE or jax.devices()[0].platform == "tpu", "requires a real TPU"
+    eng, model = _build_engine()
+    rng = np.random.default_rng(0)
+    # warmup: compile prefill buckets + decode tick shapes
+    warm = {90000 + i: rng.integers(1, 32000, (p,)).tolist()
+            for i, p in enumerate(PROMPT_POOL)}
+    eng.generate(warm, max_new_tokens=4)
+
+    rows = []
+    for rate in RATES:
+        rows.append(_run_rate(eng, rate, np.random.default_rng(int(rate * 10))))
+        print(f"[serve] {rows[-1]}", flush=True)
+        if not rows[-1]["meets_sla"] and rows[-1]["p95_token_ms"] > 4 * SLA_MS:
+            break                     # far past saturation; stop the sweep
+    best = max((r["achieved_qps"] for r in rows if r["meets_sla"]), default=0.0)
+    print(json.dumps({
+        "mode": os.environ.get("DST_RAGGED_FORCE_GATHER") == "1"
+        and "gather" or "pallas",
+        "sla_ms": SLA_MS, "out_tokens": OUT_TOKENS,
+        "prompt_pool": PROMPT_POOL, "params": model.config.param_count(),
+        "qps_at_sla": best, "curve": rows}), flush=True)
+
+
+def main():
+    if os.environ.get(_CHILD) == "1":
+        _run_child()
+        return 0
+    report = {"metric": "serve_qps_at_p95_token_sla", "unit": "req/s",
+              "sla_ms": SLA_MS}
+    for mode, env_extra in (("pallas", {}),
+                            ("gather", {"DST_RAGGED_FORCE_GATHER": "1"})):
+        env = dict(os.environ, **env_extra)
+        env[_CHILD] = "1"
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, cwd=HERE, capture_output=True,
+                              text=True, timeout=3600)
+        sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
+        row = None
+        for ln in (proc.stdout or "").splitlines():
+            ln = ln.strip()
+            if ln.startswith("{") and '"curve"' in ln:
+                row = json.loads(ln)
+        report[mode] = row
+        print(f"== {mode}: qps_at_sla="
+              f"{(row or {}).get('qps_at_sla')}", flush=True)
+    if report.get("pallas"):
+        report["value"] = report["pallas"]["qps_at_sla"]
+        g = (report.get("gather") or {}).get("qps_at_sla") or 0
+        if g:
+            report["pallas_vs_gather"] = round(report["value"] / g, 2)
+    with open(os.path.join(HERE, "SERVE_BENCH_r04.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({k: report.get(k) for k in
+                      ("metric", "value", "pallas_vs_gather")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

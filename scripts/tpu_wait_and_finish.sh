@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Poll for the axon TPU tunnel to return, then run the remaining r04
+# evidence stages (kernel check, decode bench, serve bench, quant-comm).
+# Probe is a short-lived child; stages run serially (one chip claim).
+set -u
+cd "$(dirname "$0")/.."
+
+while true; do
+  if timeout 180 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
+    echo "[wait] TPU back at $(date -u +%H:%M:%S)"
+    break
+  fi
+  echo "[wait] tunnel still down at $(date -u +%H:%M:%S); retry in 10 min"
+  sleep 600
+done
+
+echo "== kernel numerics + perf (TPU_KERNEL_CHECK) =="
+python scripts/tpu_flash_check.py | tee /tmp/flash_check.out || true
+grep '^{' /tmp/flash_check.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp TPU_KERNEL_CHECK_r04.json || echo "[roundup] TPU_KERNEL_CHECK_r04.json NOT refreshed (stage produced no JSON)"
+
+echo "== ragged decode benchmark (TPU_DECODE_BENCH) =="
+python scripts/tpu_decode_bench.py | tee /tmp/decode_bench.out || true
+grep '^{' /tmp/decode_bench.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp TPU_DECODE_BENCH_r04.json || echo "[roundup] TPU_DECODE_BENCH_r04.json NOT refreshed (stage produced no JSON)"
+
+echo "== SLA serving benchmark (SERVE_BENCH) =="
+python scripts/tpu_serve_bench.py || true
+
+echo "== quantized-collective pack-cost microbench (QUANT_COMM) =="
+python scripts/tpu_quant_comm_bench.py || true
+
+echo "[wait] all stages done"
