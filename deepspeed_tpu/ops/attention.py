@@ -17,6 +17,7 @@ TPU-first surface:
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -78,6 +79,10 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = No
     window at O(s*window) compute)."""
     if window > 0 and not causal:
         raise ValueError("window > 0 requires causal attention")
+    # kernel-tuning lever for the on-chip sweeps: override the tile shape
+    # without touching call sites (traced once per shape, zero step cost)
+    block_q = int(os.environ.get("DST_FLASH_BLOCK_Q", block_q))
+    block_k = int(os.environ.get("DST_FLASH_BLOCK_K", block_k))
     if _use_pallas(q, k, block_q, block_k):
         from .pallas.flash_attention import flash_attention as _pallas_flash
 

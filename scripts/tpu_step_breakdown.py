@@ -1,0 +1,180 @@
+"""Train-step time breakdown on the bench model — the trace-free profile.
+
+jax.profiler traces don't survive the axon relay, so the MFU hunt
+triangulates instead: time nested subsets of the step with the chained
+data-dependent methodology (null-loop floor subtracted) and difference
+them:
+
+    logits-only        -> embedding + blocks + head matmul
+    loss (fwd)         -> + softmax-CE           (CE cost = fwd - logits)
+    value_and_grad     -> + backward             (bwd cost = vag - fwd)
+    engine.train_batch -> + optimizer/constraints(opt cost = full - vag)
+
+plus the flash-attention share measured directly at the bench shape
+(fwd and fwd+bwd), and an optional block-size sweep via
+DST_FLASH_BLOCK_Q/K. Writes STEP_BREAKDOWN_r04.json.
+
+Usage: python scripts/tpu_step_breakdown.py     (claims the chip)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+BS = int(os.environ.get("DST_BENCH_BS", "8"))
+SEQ = 2048
+ITERS = 12
+
+
+def _chain_ms(loss_like, params, args, iters=ITERS):
+    """Time ``loss_like(params, *args) -> scalar`` chained data-dependently."""
+    import jax
+    import jax.numpy as jnp
+
+    def perturbed(carry):
+        return jax.tree_util.tree_map(
+            lambda p: p + (0.0 * carry).astype(p.dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+
+    @jax.jit
+    def chained(params, *args):
+        def body(i, carry):
+            out = loss_like(perturbed(carry), *args)
+            return carry + 0.0 * out.astype(jnp.float32)
+
+        return jax.lax.fori_loop(0, iters, body, jnp.zeros((), jnp.float32))
+
+    @jax.jit
+    def null(params, *args):
+        def body(i, carry):
+            return carry + 0.0
+
+        return jax.lax.fori_loop(0, iters, body, jnp.zeros((), jnp.float32))
+
+    for f in (chained, null):
+        float(f(params, *args))
+    t0 = time.perf_counter()
+    float(chained(params, *args))
+    t_full = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(null(params, *args))
+    t_null = time.perf_counter() - t0
+    ms = (t_full - t_null) / iters * 1e3
+    if ms <= 0:
+        raise RuntimeError(f"workload too small to resolve ({ms:.3f} ms)")
+    return ms
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.models import Llama
+
+    assert jax.devices()[0].platform == "tpu", "requires a real TPU"
+    report = {"device": jax.devices()[0].device_kind, "bs": BS, "seq": SEQ,
+              "flash_blocks": {
+                  "q": os.environ.get("DST_FLASH_BLOCK_Q", "1024"),
+                  "k": os.environ.get("DST_FLASH_BLOCK_K", "1024")}}
+
+    model = Llama("tiny", d_model=1024, n_layers=24, n_heads=16,
+                  n_kv_heads=16, d_ff=2816, vocab_size=32000,
+                  max_seq_len=SEQ, remat=True, remat_policy="selective",
+                  use_flash=True, loss_chunk_size=0)
+    params = model.init(jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, 32000, (BS, SEQ)), jnp.int32)
+    batch = {"input_ids": tokens}
+
+    # 1) logits-only forward (no CE)
+    def logits_sum(p, t):
+        return jnp.sum(model.apply(p, t).astype(jnp.float32) * 1e-9)
+
+    report["logits_fwd_ms"] = round(_chain_ms(logits_sum, params, (tokens,)), 2)
+
+    # 2) full forward loss (CE included)
+    def loss_fn(p, b):
+        return model.loss(p, b)
+
+    report["loss_fwd_ms"] = round(_chain_ms(loss_fn, params, (batch,)), 2)
+
+    # 3) forward + backward
+    def vag(p, b):
+        loss, grads = jax.value_and_grad(lambda pp: model.loss(pp, b))(p)
+        leaves = jax.tree_util.tree_leaves(grads)
+        return loss + sum(jnp.sum(g).astype(jnp.float32) * 0.0 for g in leaves)
+
+    report["fwd_bwd_ms"] = round(_chain_ms(vag, params, (batch,)), 2)
+
+    # 4) full engine step (optimizer + constraints + loss-scale machinery),
+    # measured across train_batch calls (host-driven, so wall-clock pairs
+    # with a warmup; the engine itself is the donated jitted step)
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    mesh_mod.reset_topology()
+    engine, _, _, _ = dst.initialize(
+        model=model,
+        config={"train_batch_size": BS,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True}, "gradient_clipping": 1.0,
+                "steps_per_print": 10 ** 9},
+        rng=jax.random.PRNGKey(0))
+    from deepspeed_tpu.runtime.dataloader import shard_batch
+
+    placed = shard_batch({"input_ids": np.asarray(tokens)}, engine.topo)
+    for _ in range(3):
+        engine.train_batch(placed)     # warm + settle
+    float(engine.train_batch(placed)["loss"])
+    n = 10
+    t0 = time.perf_counter()
+    for _ in range(n):
+        m = engine.train_batch(placed)
+    float(m["loss"])
+    report["engine_step_ms"] = round((time.perf_counter() - t0) / n * 1e3, 2)
+
+    # 5) attention share at the bench shape, fwd and fwd+bwd
+    from deepspeed_tpu.ops.attention import flash_attention
+
+    hd = 1024 // 16
+    qkv = jnp.asarray(np.random.default_rng(1).standard_normal(
+        (BS, SEQ, 16, hd)), jnp.bfloat16)
+
+    def attn_fwd(p, q):
+        return jnp.sum(flash_attention(q, q, q, causal=True)
+                       .astype(jnp.float32) * 1e-9)
+
+    def attn_fwd_bwd(p, q):
+        g = jax.grad(lambda qq: jnp.sum(
+            flash_attention(qq, qq, qq, causal=True).astype(jnp.float32)))(q)
+        return jnp.sum(g.astype(jnp.float32) * 1e-9)
+
+    dummy = {"x": jnp.zeros((1,), jnp.float32)}
+    one_layer_fwd = _chain_ms(attn_fwd, dummy, (qkv,))
+    one_layer_fb = _chain_ms(attn_fwd_bwd, dummy, (qkv,))
+    report["attn_fwd_ms_per_layer"] = round(one_layer_fwd, 3)
+    report["attn_fwd_bwd_ms_per_layer"] = round(one_layer_fb, 3)
+    report["attn_fwd_bwd_ms_24layers"] = round(one_layer_fb * 24, 1)
+
+    # derived decomposition
+    report["derived"] = {
+        "ce_ms": round(report["loss_fwd_ms"] - report["logits_fwd_ms"], 2),
+        "bwd_ms": round(report["fwd_bwd_ms"] - report["loss_fwd_ms"], 2),
+        "optimizer_ms": round(report["engine_step_ms"] - report["fwd_bwd_ms"], 2),
+    }
+    print(json.dumps(report), flush=True)
+    with open(os.path.join(HERE, "STEP_BREAKDOWN_r04.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

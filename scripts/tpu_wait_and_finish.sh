@@ -28,4 +28,14 @@ python scripts/tpu_serve_bench.py || true
 echo "== quantized-collective pack-cost microbench (QUANT_COMM) =="
 python scripts/tpu_quant_comm_bench.py || true
 
+echo "== step-time breakdown (STEP_BREAKDOWN) =="
+python scripts/tpu_step_breakdown.py || true
+
+echo "== refreshed MFU sweep (new configs) =="
+python scripts/tpu_mfu_sweep.py || true
+
+echo "== headline bench =="
+python bench.py | tee /tmp/bench.out || true
+grep '^{' /tmp/bench.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp BENCH_r04_local.json || echo "[roundup] BENCH_r04_local.json NOT refreshed"
+
 echo "[wait] all stages done"
