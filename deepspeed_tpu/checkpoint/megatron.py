@@ -27,7 +27,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 __all__ = ["read_megatron_state", "megatron_config", "map_megatron_gpt",
-           "from_megatron"]
+           "from_megatron", "map_megatron_gpt_moe", "from_megatron_moe"]
 
 
 def _flatten(prefix: str, tree: Any, out: Dict[str, np.ndarray]) -> None:
@@ -103,8 +103,12 @@ def _deinterleave_qkv(x: np.ndarray, n_heads: int) -> np.ndarray:
 
 
 def map_megatron_gpt(state: Dict[str, np.ndarray], c,
-                     checkpoint_version: float = 3.0) -> Dict[str, Any]:
-    """Flat Megatron language_model state -> native stacked pytree."""
+                     checkpoint_version: float = 3.0,
+                     skip_dense_mlp: bool = False) -> Dict[str, Any]:
+    """Flat Megatron language_model state -> native stacked pytree.
+
+    ``skip_dense_mlp``: MoE checkpoints have no per-layer dense FFN keys
+    (map_megatron_gpt_moe fills the expert bank instead)."""
     n = c.n_layers
     # keys may carry the 'transformer.' (classic) or 'encoder.' prefix
     pre = "transformer."
@@ -139,11 +143,14 @@ def map_megatron_gpt(state: Dict[str, np.ndarray], c,
         "bo": stack(L + "attention.dense.bias"),
         "mlp_norm_w": stack(L + "post_attention_layernorm.weight"),
         "mlp_norm_b": stack(L + "post_attention_layernorm.bias"),
-        "w_up": stack(L + "mlp.dense_h_to_4h.weight", transpose=True),
-        "b_up": stack(L + "mlp.dense_h_to_4h.bias"),
-        "w_down": stack(L + "mlp.dense_4h_to_h.weight", transpose=True),
-        "b_down": stack(L + "mlp.dense_4h_to_h.bias"),
     }
+    if not skip_dense_mlp:
+        layers.update({
+            "w_up": stack(L + "mlp.dense_h_to_4h.weight", transpose=True),
+            "b_up": stack(L + "mlp.dense_h_to_4h.bias"),
+            "w_down": stack(L + "mlp.dense_4h_to_h.weight", transpose=True),
+            "b_down": stack(L + "mlp.dense_4h_to_h.bias"),
+        })
     return {
         "tok_embed": state["embedding.word_embeddings.weight"],
         "pos_embed": state["embedding.position_embeddings.weight"],
@@ -168,6 +175,98 @@ def from_megatron(ckpt_dir: str, dtype=None, topology=None):
     dtype = dtype or jnp.float32
     params = jax.tree_util.tree_map(
         lambda x: jnp.asarray(x, dtype), params)
+    if topology is not None:
+        model.bind_topology(topology)
+    return model, params
+
+
+# ----------------------------------------------------------------------
+# Megatron-DeepSpeed MoE (reference module_inject/containers/
+# megatron_gpt_moe.py — experts live at
+# mlp.deepspeed_moe.experts.deepspeed_experts.<e>.dense_{h_to_4h,4h_to_h})
+
+def _moe_layer_experts(state, L, i):
+    pre = L.format(i) + "mlp.deepspeed_moe."
+    es = []
+    e = 0
+    while f"{pre}experts.deepspeed_experts.{e}.dense_h_to_4h.weight" in state:
+        es.append(e)
+        e += 1
+    return pre, es
+
+
+def map_megatron_gpt_moe(state: Dict[str, np.ndarray], c,
+                         checkpoint_version: float = 3.0) -> Dict[str, Any]:
+    """Megatron-DeepSpeed MoE GPT -> native MoETransformer pytree.
+
+    Requires every layer to carry a deepspeed_moe FFN (the uniform-MoE
+    configuration); mixed dense/MoE stacks raise loudly rather than
+    silently mis-mapping."""
+    params = map_megatron_gpt(state, c, checkpoint_version,
+                              skip_dense_mlp=True)
+    n = c.n_layers
+    pre = "transformer." if any(k.startswith("transformer.") for k in state) \
+        else "encoder."
+    L = pre + "layers.{}."
+
+    wg, w_up, b_up, w_down, b_down = [], [], [], [], []
+    for i in range(n):
+        moe_pre, experts = _moe_layer_experts(state, L, i)
+        if not experts:
+            raise NotImplementedError(
+                f"layer {i} has no deepspeed_moe experts — mixed dense/MoE "
+                "Megatron stacks are not supported (uniform MoE only)")
+        wg.append(state.pop(moe_pre + "gate.wg.weight").T)
+        ups, bus, downs, bds = [], [], [], []
+        for e in experts:
+            ep = f"{moe_pre}experts.deepspeed_experts.{e}."
+            ups.append(state.pop(ep + "dense_h_to_4h.weight").T)
+            bus.append(state.pop(ep + "dense_h_to_4h.bias"))
+            downs.append(state.pop(ep + "dense_4h_to_h.weight").T)
+            bds.append(state.pop(ep + "dense_4h_to_h.bias"))
+        w_up.append(np.stack(ups))
+        b_up.append(np.stack(bus))
+        w_down.append(np.stack(downs))
+        b_down.append(np.stack(bds))
+    layers = params["layers"]
+    # the dense FFN slots are replaced by the expert bank
+    for k in ("w_up", "b_up", "w_down", "b_down"):
+        layers.pop(k, None)
+    layers.update({"wg": np.stack(wg), "w_up": np.stack(w_up),
+                   "b_up": np.stack(b_up), "w_down": np.stack(w_down),
+                   "b_down": np.stack(b_down)})
+    return params
+
+
+def from_megatron_moe(ckpt_dir: str, dtype=None, topology=None):
+    """(MoETransformer, params) from a Megatron-DeepSpeed MoE checkpoint."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.moe import MoETransformer, MoETransformerConfig
+
+    state, args, version = read_megatron_state(ckpt_dir)
+    base = megatron_config(args)
+    pre = "transformer." if any(k.startswith("transformer.") for k in state) \
+        else "encoder."
+    _, experts = _moe_layer_experts(state, pre + "layers.{}.", 0)
+    if not experts:
+        raise ValueError(f"no deepspeed_moe experts found under {ckpt_dir}")
+    cfg = MoETransformerConfig(
+        vocab_size=base.vocab_size, d_model=base.d_model,
+        n_layers=base.n_layers, n_heads=base.n_heads,
+        n_kv_heads=base.n_kv_heads, d_ff=base.d_ff,
+        max_seq_len=base.max_seq_len, norm="layer", activation="gelu",
+        position="learned", tie_embeddings=True, use_bias=True,
+        norm_eps=base.norm_eps,
+        n_experts=int(args.get("num_experts", len(experts))
+                      if not isinstance(args.get("num_experts"), list)
+                      else args["num_experts"][0]),
+        top_k=int(args.get("topk", 1)))
+    model = MoETransformer(cfg)
+    params = map_megatron_gpt_moe(state, cfg, checkpoint_version=version)
+    dtype = dtype or jnp.float32
+    params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), params)
     if topology is not None:
         model.bind_topology(topology)
     return model, params

@@ -65,7 +65,8 @@ class MoETransformer(Transformer):
     def __init__(self, config: MoETransformerConfig):
         super().__init__(config)
         self.moe = MoELayer(config.d_model, config.d_ff, config.gate_config(),
-                            activation=config.activation)
+                            activation=config.activation,
+                            use_bias=config.use_bias)
 
     def init(self, rng, dtype=jnp.float32) -> Dict[str, Any]:
         k_dense, k_moe = jax.random.split(rng)
@@ -78,7 +79,8 @@ class MoETransformer(Transformer):
         return params
 
     def _mlp(self, h, lp, rng=None, training=False):
-        moe_params = {k: lp[k] for k in ("wg", "w_up", "w_down", "w_gate") if k in lp}
+        moe_params = {k: lp[k] for k in ("wg", "w_up", "w_down", "w_gate",
+                                         "b_up", "b_down") if k in lp}
         out, aux = self.moe.apply(moe_params, h, rng=rng, training=training)
         return out, aux * self.config.aux_loss_weight
 

@@ -136,12 +136,18 @@ def no_drop_moe(x_flat: jnp.ndarray, probs: jnp.ndarray, idx: jnp.ndarray,
     xs = x_flat[tok]                                  # moe_gather
     group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
 
+    e_sorted = flat_e[order]                          # expert id per row
     if activation == "silu_glu":
         h = jax.nn.silu(jax.lax.ragged_dot(xs, params["w_gate"], group_sizes)) \
             * jax.lax.ragged_dot(xs, params["w_up"], group_sizes)
     else:
-        h = jax.nn.gelu(jax.lax.ragged_dot(xs, params["w_up"], group_sizes))
+        h = jax.lax.ragged_dot(xs, params["w_up"], group_sizes)
+        if "b_up" in params:
+            h = h + params["b_up"][e_sorted].astype(h.dtype)
+        h = jax.nn.gelu(h)
     ys = jax.lax.ragged_dot(h, params["w_down"], group_sizes)  # [S*k, d]
+    if "b_down" in params:
+        ys = ys + params["b_down"][e_sorted].astype(ys.dtype)
     w = probs.reshape(-1)[order][:, None].astype(ys.dtype)
     return jnp.zeros_like(x_flat).at[tok].add((ys * w).astype(x_flat.dtype))
 
@@ -158,8 +164,11 @@ class MoELayer:
     """
 
     def __init__(self, d_model: int, d_ff: int, gate: GateConfig,
-                 activation: str = "silu_glu"):
+                 activation: str = "silu_glu", use_bias: bool = False):
         self.d_model, self.d_ff, self.gate, self.activation = d_model, d_ff, gate, activation
+        # per-expert biases (Megatron-DeepSpeed MoE experts carry
+        # dense_h_to_4h/dense_4h_to_h biases; glu llama-style experts don't)
+        self.use_bias = use_bias
 
     def init(self, rng, dtype=jnp.float32, n_layers: Optional[int] = None) -> Dict[str, Any]:
         E, d, f = self.gate.n_experts, self.d_model, self.d_ff
@@ -176,6 +185,9 @@ class MoELayer:
         }
         if self.activation == "silu_glu":
             p["w_gate"] = dense(k4, (E, d, f), d)
+        if self.use_bias:
+            p["b_up"] = jnp.zeros(lead + (E, f), dtype)
+            p["b_down"] = jnp.zeros(lead + (E, d), dtype)
         return p
 
     def apply(self, params: Dict[str, Any], x: jnp.ndarray,
@@ -223,8 +235,13 @@ class MoELayer:
             h = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in, params["w_gate"])) * \
                 jnp.einsum("ebcd,edf->ebcf", expert_in, params["w_up"])
         else:
-            h = jax.nn.gelu(jnp.einsum("ebcd,edf->ebcf", expert_in, params["w_up"]))
+            h = jnp.einsum("ebcd,edf->ebcf", expert_in, params["w_up"])
+            if "b_up" in params:
+                h = h + params["b_up"][:, None, None, :].astype(h.dtype)
+            h = jax.nn.gelu(h)
         expert_out = jnp.einsum("ebcf,efd->ebcd", h, params["w_down"])
+        if "b_down" in params:
+            expert_out = expert_out + params["b_down"][:, None, None, :].astype(expert_out.dtype)
         out = jnp.einsum("bsec,ebcd->bsd", combine.astype(x.dtype), expert_out)
         return out, aux
 
@@ -243,4 +260,7 @@ class MoELayer:
         }
         if self.activation == "silu_glu":
             specs["w_gate"] = P(*lead, "expert", None, "model")
+        if self.use_bias:
+            specs["b_up"] = P(*lead, "expert", "model")
+            specs["b_down"] = P(*lead, "expert", None)
         return specs
