@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _reshape_blocks(x: jnp.ndarray, block: int) -> Tuple[jnp.ndarray, Tuple]:
@@ -37,6 +38,11 @@ def quantize_blockwise(x: jnp.ndarray, bits: int = 8, block: int = 256,
     nibbles per byte is a serialization concern, not a compute one.
     """
     assert bits in (4, 8)
+    if symmetric:
+        from .pallas.quant import quantize_blockwise_pallas, use_pallas_quant
+
+        if use_pallas_quant(int(np.prod(x.shape)), block):
+            return quantize_blockwise_pallas(x, bits=bits, block=block)
     blocks, shape = _reshape_blocks(x.astype(jnp.float32), block)
     if symmetric:
         qmax = 2.0 ** (bits - 1) - 1
@@ -56,6 +62,12 @@ def quantize_blockwise(x: jnp.ndarray, bits: int = 8, block: int = 256,
 def dequantize_blockwise(q: jnp.ndarray, scale: jnp.ndarray,
                          zero: Optional[jnp.ndarray] = None,
                          block: int = 256, dtype=jnp.float32) -> jnp.ndarray:
+    if zero is None:
+        from .pallas.quant import dequantize_blockwise_pallas, use_pallas_quant
+
+        if use_pallas_quant(int(np.prod(q.shape)), block):
+            return dequantize_blockwise_pallas(q, scale, block=block,
+                                               dtype=dtype)
     blocks, shape = _reshape_blocks(q.astype(jnp.float32), block)
     if zero is None:
         out = blocks * scale[:, None]

@@ -95,7 +95,12 @@ def main():
         def dense_copy(v):
             return (v.astype(jnp.float32) * 1.0000001).astype(jnp.bfloat16)
 
-        pack_ms = _chain_ms(pack_unpack, x)
+        pack_ms = _chain_ms(pack_unpack, x)          # pallas (default on TPU)
+        os.environ["DST_NO_PALLAS_QUANT"] = "1"
+        try:
+            xla_pack_ms = _chain_ms(pack_unpack, x)  # XLA fallback path
+        finally:
+            os.environ.pop("DST_NO_PALLAS_QUANT", None)
         dense_ms = _chain_ms(dense_copy, x)
         bf16_bytes = numel * 2
         int8_bytes = numel * 1 + (numel // 256) * 4   # payload + scales
@@ -106,6 +111,8 @@ def main():
         report["rows"].append({
             "numel": numel,
             "pack_unpack_ms": round(pack_ms, 4),
+            "xla_pack_unpack_ms": round(xla_pack_ms, 4),
+            "pallas_vs_xla": round(xla_pack_ms / pack_ms, 2),
             "dense_baseline_ms": round(dense_ms, 4),
             "wire_bytes_saved": saved,
             "breakeven_link_gbps": round(breakeven_gbps, 1),

@@ -197,3 +197,24 @@ def test_zero_one_adam_local_steps_and_convergence():
     # local stepping really reduced sync frequency
     assert opt.sync_steps < opt.steps * 0.7
     assert opt.sync_steps >= 5
+
+
+def test_pallas_quant_interpret_parity():
+    """The fused Pallas quant/dequant kernels match the jnp reference
+    bit-exactly in interpret mode (the compiled check lives in the
+    on-chip lane, test_tpu_kernels.py)."""
+    from deepspeed_tpu.ops.pallas.quant import (dequantize_blockwise_pallas,
+                                                quantize_blockwise_pallas)
+    from deepspeed_tpu.ops.quantizer import (dequantize_blockwise,
+                                             quantize_blockwise)
+
+    rng = np.random.default_rng(3)
+    for rows in (32, 96, 288):
+        x = jnp.asarray(rng.standard_normal(rows * 256), jnp.float32)
+        qr, sr, _ = quantize_blockwise(x, block=256)
+        qp, sp, _ = quantize_blockwise_pallas(x, block=256, interpret=True)
+        np.testing.assert_array_equal(np.asarray(qr), np.asarray(qp))
+        np.testing.assert_allclose(np.asarray(sr), np.asarray(sp), rtol=1e-6)
+        dr = dequantize_blockwise(qr, sr, block=256)
+        dp = dequantize_blockwise_pallas(qp, sp, block=256, interpret=True)
+        np.testing.assert_allclose(np.asarray(dr), np.asarray(dp), rtol=1e-6)
