@@ -89,8 +89,9 @@ def main():
         x = jnp.asarray(rng.standard_normal(numel), jnp.bfloat16)
 
         def pack_unpack(v):
-            q, s = quantize_blockwise(v.astype(jnp.float32), bits=8, block=256)
-            return dequantize_blockwise(q, s, v.shape).astype(jnp.bfloat16)
+            q, s, _ = quantize_blockwise(v.astype(jnp.float32), bits=8,
+                                         block=256)
+            return dequantize_blockwise(q, s, block=256).astype(jnp.bfloat16)
 
         def dense_copy(v):
             return (v.astype(jnp.float32) * 1.0000001).astype(jnp.bfloat16)
