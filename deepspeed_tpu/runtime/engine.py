@@ -600,6 +600,13 @@ class TrainEngine:
         self._write_monitor(metrics, log_step=report_boundary)
         self._note_skipped(metrics["skipped"])
         self._last_loss = metrics["loss"]
+        if self.config.memory_breakdown and report_boundary:
+            # reference see_memory_usage at engine phase boundaries
+            # (runtime/utils.py); boundary-only so it never adds a host
+            # sync to the steady-state step
+            from ..utils.memory import see_memory_usage
+
+            see_memory_usage(f"step {self.global_steps}")
         return metrics
 
     def register_param_transform(self, fn: Optional[Callable[[Any], Any]]) -> None:

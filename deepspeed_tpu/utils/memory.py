@@ -1,0 +1,61 @@
+"""Memory introspection (reference ``runtime/utils.py`` see_memory_usage /
+``memory_breakdown`` config).
+
+The reference prints torch.cuda allocator stats at every engine phase
+boundary. TPU-native form: per-device HBM stats from the PJRT allocator
+(``Device.memory_stats()`` — bytes_in_use / peak_bytes_in_use /
+bytes_limit) plus host RSS from /proc, logged through the shared
+log_dist channel. ``TrainEngine`` calls :func:`see_memory_usage` at the
+train-step boundary when ``memory_breakdown: true`` (config.py:548).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from .logging import log_dist
+
+
+def device_memory_stats(device=None) -> Dict[str, float]:
+    """HBM stats for one device in GB; empty when the backend has no
+    allocator stats (CPU test meshes)."""
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    stats = {}
+    try:
+        raw = dev.memory_stats() or {}
+    except Exception:
+        return stats
+    for key, out in (("bytes_in_use", "hbm_in_use_gb"),
+                     ("peak_bytes_in_use", "hbm_peak_gb"),
+                     ("bytes_limit", "hbm_limit_gb"),
+                     ("largest_free_block_bytes", "hbm_largest_free_gb")):
+        if key in raw:
+            stats[out] = round(raw[key] / 1e9, 3)
+    return stats
+
+
+def host_rss_gb() -> Optional[float]:
+    try:
+        with open(f"/proc/{os.getpid()}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1e6, 3)  # kB -> GB
+    except OSError:
+        pass
+    return None
+
+
+def see_memory_usage(tag: str, force: bool = False, ranks=(0,)) -> Dict[str, float]:
+    """Log (and return) current device + host memory. ``force`` mirrors the
+    reference's signature: callers gate on config themselves or pass
+    force=True for unconditional output."""
+    stats = device_memory_stats()
+    rss = host_rss_gb()
+    if rss is not None:
+        stats["host_rss_gb"] = rss
+    pretty = ", ".join(f"{k}={v}" for k, v in stats.items()) or "no allocator stats"
+    log_dist(f"MEM {tag}: {pretty}", ranks=list(ranks))
+    return stats

@@ -325,3 +325,13 @@ def test_hybrid_engine_train_and_generate():
     # weights moved -> generation changes (live-weight sharing works)
     assert gen0.shape == gen1.shape == (2, 8)
     assert not np.array_equal(gen0, gen1)
+
+
+def test_see_memory_usage_runs():
+    """memory_breakdown analog (reference runtime/utils.py
+    see_memory_usage): returns host RSS always; device stats when the
+    backend exposes an allocator."""
+    from deepspeed_tpu.utils.memory import see_memory_usage
+
+    stats = see_memory_usage("unit-test", force=True)
+    assert stats.get("host_rss_gb", 0) > 0
