@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec
 
 from ..ops.norms import layer_norm, rms_norm
 from ..ops.ragged_host import build_batch, fill_tables
@@ -130,10 +131,17 @@ class RaggedInferenceEngine:
     """
 
     def __init__(self, model, config: Optional[RaggedConfig] = None,
-                 params: Any = None, rng: Any = None):
+                 params: Any = None, rng: Any = None, topology=None):
         self.config = config or RaggedConfig()
         self.model = model
+        self.topo = topology
         c = model.config
+        tp = topology.model_parallel_size if topology is not None else 1
+        if tp > 1 and c.n_kv_heads % tp:
+            raise ValueError(
+                f"n_kv_heads {c.n_kv_heads} not divisible by the model "
+                f"axis {tp} — TP serving shards the KV pool by head")
+        self._tp_size = tp
         if self.config.max_context > c.max_seq_len:
             raise ValueError(
                 f"max_context {self.config.max_context} exceeds model "
@@ -164,6 +172,19 @@ class RaggedInferenceEngine:
             lambda x: x.astype(self.config.dtype)
             if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) else x,
             self.params)
+        if tp > 1:
+            # tensor-parallel serving (FastGen v2's TP configuration): place
+            # params under the model's partition specs; GSPMD shards every
+            # projection + the vocab head and inserts the o-proj/logits
+            # collectives. The KV pool shards by head below.
+            from jax.sharding import NamedSharding
+
+            specs = model.partition_specs(self.params, topology)
+            self.params = jax.device_put(
+                self.params,
+                jax.tree_util.tree_map(
+                    lambda sp: NamedSharding(topology.mesh, sp), specs,
+                    is_leaf=lambda x: isinstance(x, PartitionSpec)))
         cfg = self.config
         self.allocator = BlockedAllocator(cfg.n_kv_blocks)
         self.seqs: Dict[int, SequenceDescriptor] = {}
@@ -183,9 +204,21 @@ class RaggedInferenceEngine:
         # each page is a native VMEM tile for the Pallas kernel
         leaf_shape = (cfg.n_kv_blocks + 1, c.n_kv_heads,
                       cfg.kv_block_size, c.head_dim)
+        if tp > 1:
+            from jax.sharding import NamedSharding
+
+            pool_sh = NamedSharding(topology.mesh,
+                                    PartitionSpec(None, "model", None, None))
+
+            def _zeros(_):
+                return jax.device_put(jnp.zeros(leaf_shape, cfg.dtype),
+                                      pool_sh)
+        else:
+            def _zeros(_):
+                return jnp.zeros(leaf_shape, cfg.dtype)
         self.kv_pool = (
-            tuple(jnp.zeros(leaf_shape, cfg.dtype) for _ in range(c.n_layers)),
-            tuple(jnp.zeros(leaf_shape, cfg.dtype) for _ in range(c.n_layers)))
+            tuple(_zeros(i) for i in range(c.n_layers)),
+            tuple(_zeros(i) for i in range(c.n_layers)))
         self._step_fn = None
         self._core_fn = None
         self._decode_fn = None
@@ -536,10 +569,14 @@ class RaggedInferenceEngine:
         windows = tuple(int(w) if 0 < int(w) < cfg.max_context else 0
                         for w in aw) if aw is not None \
             else (0,) * c.n_layers
+        # TP shards the pool/heads; the Pallas kernel is single-device
+        # (GSPMD cannot partition a pallas_call) — TP serving runs the
+        # gather path, which XLA partitions head-wise with zero collectives
+        # inside attention. shard_map-wrapping the kernel is the follow-up.
         use_pallas = _use_pallas_paged(
             c.head_dim, bs, self.config.dtype,
             scalar_ints=cfg.max_seqs * self.max_pages + 2 * cfg.token_budget) \
-            and not any(windows)
+            and not any(windows) and self._tp_size == 1
 
         def norm(x, w, b=None):
             return rms_norm(x, w, c.norm_eps) if c.norm == "rms" \

@@ -335,3 +335,42 @@ def test_chunked_decode_eos_and_k_guard():
         eng3.decode_steps({8: 5, 7: 5}, ctx - len(prompt) + 1)
     assert eng3.allocator.free_blocks == free_before
     assert {u: list(eng3.seqs[u].blocks) for u in (7, 8)} == blocks_before
+
+
+def test_ragged_tp_serving_matches_single_device():
+    """TP serving (FastGen v2's tensor-parallel configuration): params +
+    KV pool sharded over the 'model' axis, GSPMD partitions the ragged
+    step — greedy output must be token-exact vs the unsharded engine."""
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    model = Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=4,
+                  vocab_size=256, max_seq_len=128, use_flash=False,
+                  remat=False)
+    cfg = RaggedConfig(token_budget=64, max_seqs=4, kv_block_size=16,
+                       n_kv_blocks=64, max_context=128)
+    rng = np.random.default_rng(11)
+    prompts = {1: rng.integers(1, 256, (9,)).tolist(),
+               2: rng.integers(1, 256, (17,)).tolist()}
+
+    eng = RaggedInferenceEngine(model, cfg, rng=jax.random.PRNGKey(3))
+    want = eng.generate(dict(prompts), max_new_tokens=8)
+
+    mesh_mod.reset_topology()
+    topo = mesh_mod.Topology.build_virtual({"model": 2})
+    eng_tp = RaggedInferenceEngine(model, cfg, rng=jax.random.PRNGKey(3),
+                                   topology=topo)
+    got = eng_tp.generate(dict(prompts), max_new_tokens=8)
+    assert got == want, (got, want)
+
+
+def test_ragged_tp_rejects_indivisible_heads():
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    model = Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=1,
+                  vocab_size=256, max_seq_len=128, use_flash=False,
+                  remat=False)
+    mesh_mod.reset_topology()
+    topo = mesh_mod.Topology.build_virtual({"model": 2})
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        RaggedInferenceEngine(model, RaggedConfig(max_context=128),
+                              topology=topo)
