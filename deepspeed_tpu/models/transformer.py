@@ -286,6 +286,37 @@ class Transformer:
             return rms_norm(x, w, self.config.norm_eps)
         return layer_norm(x, w, b, self.config.norm_eps)
 
+    def _local_flash(self, q, k, v, *, causal, scale=None, window=0):
+        """Flash attention that stays device-local on multi-device meshes.
+
+        GSPMD cannot partition a ``pallas_call`` — with batch/head-sharded
+        operands it would replicate the kernel (silent pod-scale perf
+        cliff). Standard practice: run the kernel INSIDE a shard_map whose
+        specs name the operands' existing sharding (batch over the data
+        axes, heads over 'model'), so each device runs the kernel on its
+        local shard with zero collectives. Single-device (the bench) and
+        unbound-mesh paths call the dispatcher directly."""
+        from ..ops.attention import flash_attention as fa
+
+        kw = {"causal": causal, "scale": scale}
+        if window:
+            kw["window"] = window
+        mesh = self._mesh
+        multi = mesh is not None and any(
+            mesh.shape[a] > 1 for a in ("data", "zshard", "model")
+            if a in mesh.shape)
+        if not multi:
+            return fa(q, k, v, **kw)
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P_
+
+        batch_axes = getattr(self, "_batch_axes", None) or None
+        head_axes = "model" if self._tp_size > 1 else None
+        spec = P_(batch_axes, None, head_axes, None)
+        return shard_map(lambda q, k, v: fa(q, k, v, **kw), mesh=mesh,
+                         in_specs=(spec, spec, spec), out_specs=spec,
+                         check_rep=False)(q, k, v)
+
     def _sp_attention(self, q, k, v, window=None, causal=True):
         """Sequence-parallel attention over the bound mesh's seq axis."""
         batch_axes = getattr(self, "_batch_axes", None) or None
@@ -427,9 +458,14 @@ class Transformer:
         elif attn_window is not None and isinstance(attn_window, int):
             # uniform static window (Mistral/Mixtral): banded flash kernel
             # on TPU (tiles below the band skipped), banded jnp otherwise
-            fn = flash_attention if c.use_flash else dot_product_attention
-            attn = fn(q, kk, vv, causal=True, scale=c.attn_scale,
-                      window=attn_window)
+            if c.use_flash:
+                attn = self._local_flash(q, kk, vv, causal=True,
+                                         scale=c.attn_scale,
+                                         window=attn_window)
+            else:
+                attn = dot_product_attention(q, kk, vv, causal=True,
+                                             scale=c.attn_scale,
+                                             window=attn_window)
         elif attn_window is not None:
             # per-layer-varying (traced) windows — alternating global/local
             # causal attention (GPT-Neo): numeric banded mask
@@ -440,8 +476,8 @@ class Transformer:
             attn = dot_product_attention(q, kk, vv, causal=False,
                                          mask=m[None, None], scale=c.attn_scale)
         elif c.use_flash:
-            attn = flash_attention(q, kk, vv, causal=c.causal,
-                                   scale=c.attn_scale)
+            attn = self._local_flash(q, kk, vv, causal=c.causal,
+                                     scale=c.attn_scale)
         else:
             attn = dot_product_attention(q, kk, vv, causal=c.causal,
                                          scale=c.attn_scale)

@@ -166,3 +166,33 @@ def test_ring_grads_flow():
     g = jax.grad(f)(qs, ks, vs)
     g_ref = jax.grad(f_ref)(q, k, v)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-3, atol=1e-3)
+
+
+def test_flash_wrapper_shards_on_dp_tp_mesh():
+    """use_flash on a multi-device mesh routes through the shard_map
+    wrapper (_local_flash) — GSPMD would otherwise replicate the opaque
+    pallas_call. On the CPU mesh the wrapper wraps the jnp fallback, so
+    the loss must match the plain dot-product path exactly."""
+    from deepspeed_tpu.models import Llama
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.runtime.dataloader import shard_batch
+
+    losses = {}
+    for use_flash in (False, True):
+        mesh_mod.reset_topology()
+        model = Llama("tiny", n_layers=2, d_model=64, n_heads=4,
+                      n_kv_heads=2, vocab_size=256, max_seq_len=64,
+                      use_flash=use_flash, remat=False)
+        config = {"train_batch_size": 4,
+                  "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                  "bf16": {"enabled": True}, "gradient_clipping": 1.0,
+                  "mesh": {"data": 4, "model": 2}, "steps_per_print": 1000}
+        engine, _, _, _ = dst.initialize(model=model, config=config,
+                                         rng=jax.random.PRNGKey(0))
+        tokens = np.random.default_rng(0).integers(
+            0, 256, (4, 64)).astype(np.int32)
+        losses[use_flash] = float(engine.train_batch(
+            shard_batch({"input_ids": tokens}, engine.topo))["loss"])
+    assert np.isfinite(losses[True])
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
