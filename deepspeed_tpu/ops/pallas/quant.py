@@ -119,7 +119,13 @@ def dequantize_blockwise_pallas(q: jnp.ndarray, scale: jnp.ndarray,
 
 def use_pallas_quant(numel: int, block: int) -> bool:
     """Dispatch guard: TPU + lane-aligned block + whole row tiles.
-    DST_NO_PALLAS_QUANT=1 pins the XLA path (microbench A/B lever)."""
+    DST_NO_PALLAS_QUANT=1 pins the XLA path (microbench A/B lever).
+
+    Multi-device topologies fall back to the jnp path: the qwZ/qgZ call
+    sites run under GSPMD-auto tracing where a pallas_call would be
+    replicated, not partitioned (same hazard as flash attention —
+    transformer._local_flash). Single-chip serving/benching keeps the
+    fused kernel."""
     import os
 
     from ..attention import _on_tpu
@@ -128,6 +134,14 @@ def use_pallas_quant(numel: int, block: int) -> bool:
         return False
     if not _on_tpu():
         return False
+    try:
+        from ...parallel import mesh as mesh_mod
+
+        topo = mesh_mod._TOPOLOGY   # raw singleton: get_topology() would
+        if topo is not None and topo.world_size > 1:  # SIDE-EFFECT build one
+            return False
+    except Exception:
+        pass
     if block % LANES or numel % block:
         return False
     rows = numel // block
