@@ -31,7 +31,7 @@ def _reshape_blocks(x: jnp.ndarray, block: int) -> Tuple[jnp.ndarray, Tuple]:
 
 
 def quantize_blockwise(x: jnp.ndarray, bits: int = 8, block: int = 256,
-                       symmetric: bool = True):
+                       symmetric: bool = True, manual_sharding: bool = False):
     """-> (q int8, scale f32[blocks], zero f32[blocks] | None).
 
     int4 values live in int8 storage in [-8, 7] / [0, 15] — packing two
@@ -41,7 +41,8 @@ def quantize_blockwise(x: jnp.ndarray, bits: int = 8, block: int = 256,
     if symmetric:
         from .pallas.quant import quantize_blockwise_pallas, use_pallas_quant
 
-        if use_pallas_quant(int(np.prod(x.shape)), block):
+        if use_pallas_quant(int(np.prod(x.shape)), block,
+                            manual_sharding=manual_sharding):
             return quantize_blockwise_pallas(x, bits=bits, block=block)
     blocks, shape = _reshape_blocks(x.astype(jnp.float32), block)
     if symmetric:
@@ -61,11 +62,13 @@ def quantize_blockwise(x: jnp.ndarray, bits: int = 8, block: int = 256,
 
 def dequantize_blockwise(q: jnp.ndarray, scale: jnp.ndarray,
                          zero: Optional[jnp.ndarray] = None,
-                         block: int = 256, dtype=jnp.float32) -> jnp.ndarray:
+                         block: int = 256, dtype=jnp.float32,
+                         manual_sharding: bool = False) -> jnp.ndarray:
     if zero is None:
         from .pallas.quant import dequantize_blockwise_pallas, use_pallas_quant
 
-        if use_pallas_quant(int(np.prod(q.shape)), block):
+        if use_pallas_quant(int(np.prod(q.shape)), block,
+                            manual_sharding=manual_sharding):
             return dequantize_blockwise_pallas(q, scale, block=block,
                                                dtype=dtype)
     blocks, shape = _reshape_blocks(q.astype(jnp.float32), block)

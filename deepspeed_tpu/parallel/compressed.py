@@ -92,8 +92,9 @@ def int8_allreduce(x: jnp.ndarray, worker_error: jnp.ndarray,
         f"({world}*{block}) — pad the input or use tree_onebit_allreduce's "
         f"dense fallback for small tensors")
     corrected = x + worker_error
-    q, s, _ = quantize_blockwise(corrected, bits=8, block=block)
-    deq = dequantize_blockwise(q, s, block=block)
+    q, s, _ = quantize_blockwise(corrected, bits=8, block=block,
+                                 manual_sharding=True)
+    deq = dequantize_blockwise(q, s, block=block, manual_sharding=True)
     new_error = corrected - deq
     # chunk exchange of the int8 payload, dequantized server-side
     chunks = q.reshape(world, -1)
@@ -119,17 +120,20 @@ def int8_pmean(x: jnp.ndarray, axis_name: str, block: int = 512) -> jnp.ndarray:
     from ..ops.quantizer import dequantize_blockwise, quantize_blockwise
 
     world = jax.lax.psum(1, axis_name)
-    q, s, _ = quantize_blockwise(x, bits=8, block=block)
+    q, s, _ = quantize_blockwise(x, bits=8, block=block,
+                                 manual_sharding=True)
     q_recv = jax.lax.all_to_all(q.reshape(world, -1), axis_name, 0, 0,
                                 tiled=False).reshape(world, -1, block)
     s_recv = jax.lax.all_to_all(s.reshape(world, -1), axis_name, 0, 0,
                                 tiled=False).reshape(world, -1)
     chunk = jnp.mean(q_recv.astype(jnp.float32) * s_recv[..., None],
                      axis=0).reshape(-1)
-    q2, s2, _ = quantize_blockwise(chunk, bits=8, block=block)
+    q2, s2, _ = quantize_blockwise(chunk, bits=8, block=block,
+                                     manual_sharding=True)
     q_all = jax.lax.all_gather(q2, axis_name).reshape(-1)
     s_all = jax.lax.all_gather(s2, axis_name).reshape(-1)
-    return dequantize_blockwise(q_all, s_all, block=block).reshape(x.shape)
+    return dequantize_blockwise(q_all, s_all, block=block,
+                                manual_sharding=True).reshape(x.shape)
 
 
 def tree_int8_pmean(grads: Any, axis_name: str, world: int,
