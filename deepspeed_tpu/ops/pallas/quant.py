@@ -117,15 +117,19 @@ def dequantize_blockwise_pallas(q: jnp.ndarray, scale: jnp.ndarray,
     return out.reshape(q.shape).astype(dtype)
 
 
-def use_pallas_quant(numel: int, block: int) -> bool:
+def use_pallas_quant(numel: int, block: int,
+                     manual_sharding: bool = False) -> bool:
     """Dispatch guard: TPU + lane-aligned block + whole row tiles.
     DST_NO_PALLAS_QUANT=1 pins the XLA path (microbench A/B lever).
 
-    Multi-device topologies fall back to the jnp path: the qwZ/qgZ call
-    sites run under GSPMD-auto tracing where a pallas_call would be
-    replicated, not partitioned (same hazard as flash attention —
-    transformer._local_flash). Single-chip serving/benching keeps the
-    fused kernel."""
+    On multi-device PROCESSES the auto path yields to jnp: GSPMD-auto
+    call sites (engine ste_quant, inference weight loads) would bake a
+    replicated pallas_call into the trace (the flash-attention hazard —
+    transformer._local_flash). ``manual_sharding=True`` is the opt-in for
+    callers already inside a shard_map manual region (compressed.py
+    collectives), where the kernel is device-local and safe. The check
+    uses jax.devices() (not the topology singleton) so it cannot be
+    defeated by trace-before-initialize ordering."""
     import os
 
     from ..attention import _on_tpu
@@ -134,14 +138,11 @@ def use_pallas_quant(numel: int, block: int) -> bool:
         return False
     if not _on_tpu():
         return False
-    try:
-        from ...parallel import mesh as mesh_mod
+    if not manual_sharding:
+        import jax
 
-        topo = mesh_mod._TOPOLOGY   # raw singleton: get_topology() would
-        if topo is not None and topo.world_size > 1:  # SIDE-EFFECT build one
+        if len(jax.devices()) > 1:
             return False
-    except Exception:
-        pass
     if block % LANES or numel % block:
         return False
     rows = numel // block
