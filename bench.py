@@ -17,6 +17,7 @@ valid JSON line on stdout, exit 0.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import subprocess
@@ -106,7 +107,43 @@ def _child_main():
     tokens_per_step = batch_size * (seq_len - 1)
     tok_per_sec = tokens_per_step * steps / dt
     flops_per_token = model.config.flops_per_token(seq_len)
-    mfu = tok_per_sec * flops_per_token / peak_flops_per_chip(jax.devices()[0].device_kind)
+    peak = peak_flops_per_chip(jax.devices()[0].device_kind)
+    mfu = tok_per_sec * flops_per_token / peak
+
+    # Steady-state rate: K engine steps inside ONE compiled lax.scan — no
+    # per-step host dispatch at all. Through the axon relay each
+    # train_batch call pays a host->device round trip that a co-located
+    # production host doesn't; the delta between this and the per-call
+    # number above IS that dispatch tax. Both are reported.
+    scan_ms = scan_mfu = None
+    scan_flag = os.environ.get("DST_BENCH_SCAN", "1")
+    if (on_tpu and scan_flag == "1") or scan_flag == "force":
+        step_fn = engine._train_step_fn
+        K = 10
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def k_steps(params, opt, scaler, rng, batch):
+            def body(carry, _):
+                p, o, s, r = carry
+                p, o, s, r, metrics = step_fn(p, o, s, r, batch)
+                return (p, o, s, r), metrics["loss"]
+
+            carry, losses = jax.lax.scan(
+                body, (params, opt, scaler, rng), None, length=K)
+            return carry, losses
+
+        carry = (engine.params, engine.opt_state, engine.scaler_state,
+                 engine.rng)
+        carry, losses = k_steps(*carry, batch)          # compile + warm
+        float(losses[-1])
+        t0 = time.perf_counter()
+        carry, losses = k_steps(*carry, batch)
+        float(losses[-1])
+        scan_dt = time.perf_counter() - t0
+        (engine.params, engine.opt_state, engine.scaler_state,
+         engine.rng) = carry
+        scan_ms = scan_dt / K * 1e3
+        scan_mfu = tokens_per_step * K / scan_dt * flops_per_token / peak
     # CPU fallback rows get a distinct metric name so a consumer reading
     # metric+value alone is never misled into comparing smoke-model CPU
     # numbers against the TPU headline.
@@ -126,6 +163,9 @@ def _child_main():
             "remat": remat_env,
             "ce_chunk": ce_chunk if on_tpu else 0,
             "step_ms": round(dt / steps * 1e3, 1),
+            **({"compiled_loop_step_ms": round(scan_ms, 1),
+                "compiled_loop_mfu": round(scan_mfu, 4)}
+               if scan_ms is not None else {}),
         },
     }), flush=True)
 
