@@ -87,6 +87,38 @@ def zero_to_fp32(ckpt_dir: str, out_file: str, tag: Optional[str] = None) -> str
     return out_file
 
 
+def megatron_to_universal(megatron_dir: str, out_dir: str) -> str:
+    """Megatron-LM GPT checkpoint -> universal layout (the reference's
+    ds_to_universal path also reshapes Megatron checkpoints). Dense and
+    deepspeed_moe checkpoints both supported; the exploded params use the
+    NATIVE stacked naming, so any mesh/stage can consume them."""
+    import jax
+
+    from .megatron import from_megatron, from_megatron_moe, read_megatron_state
+
+    state, _, _ = read_megatron_state(megatron_dir)
+    moe = any(".deepspeed_moe." in k for k in state)
+    del state
+    loader = from_megatron_moe if moe else from_megatron
+    _, params = loader(megatron_dir)
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = ".".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                       for k in path)
+        flat[key] = np.asarray(leaf)
+    os.makedirs(out_dir, exist_ok=True)
+    index = {}
+    for key, arr in flat.items():
+        fname = f"{key}.npy"
+        np.save(os.path.join(out_dir, fname), arr)
+        index[key] = {"file": fname, "shape": list(arr.shape),
+                      "dtype": str(arr.dtype)}
+    with open(os.path.join(out_dir, "universal_index.json"), "w") as f:
+        json.dump({"version": 1, "source": "megatron", "params": index}, f,
+                  indent=2)
+    return out_dir
+
+
 def load_universal(universal_dir: str) -> Dict[str, np.ndarray]:
     """Read a to-universal directory back into a flat {key: array} dict."""
     with open(os.path.join(universal_dir, "universal_index.json")) as f:
@@ -107,9 +139,14 @@ def main(argv=None) -> int:
     pf.add_argument("ckpt_dir")
     pf.add_argument("out_file")
     pf.add_argument("--tag", default=None)
+    pm = sub.add_parser("from-megatron")
+    pm.add_argument("megatron_dir")
+    pm.add_argument("out_dir")
     args = p.parse_args(argv)
     if args.cmd == "to-universal":
         out = to_universal(args.ckpt_dir, args.out_dir, args.tag)
+    elif args.cmd == "from-megatron":
+        out = megatron_to_universal(args.megatron_dir, args.out_dir)
     else:
         out = zero_to_fp32(args.ckpt_dir, args.out_file, args.tag)
     print(out)

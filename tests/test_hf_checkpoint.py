@@ -458,3 +458,27 @@ def test_megatron_moe_ingestion(tmp_path):
     p0["layers"]["b_up"] = jnp.zeros_like(lay["b_up"])
     logits0 = np.asarray(model.apply(p0, jnp.asarray(tokens)))
     assert np.abs(logits - logits0).max() > 1e-4
+
+
+def test_megatron_to_universal_cli(tmp_path):
+    """from-megatron CLI: Megatron checkpoint -> universal per-param
+    layout readable by load_universal (the reference ds_to_universal
+    megatron reshape path)."""
+    from deepspeed_tpu.checkpoint.universal import load_universal, main
+
+    torch.manual_seed(0)
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_positions=128)
+    m = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    blob = _gpt2_to_megatron(m, 64, 4, 3.0)
+    d = tmp_path / "meg" / "mp_rank_00"
+    d.mkdir(parents=True)
+    torch.save(blob, str(d / "model_optim_rng.pt"))
+
+    out = tmp_path / "universal"
+    assert main(["from-megatron", str(tmp_path / "meg"), str(out)]) == 0
+    flat = load_universal(str(out))
+    assert flat["tok_embed"].shape == (256, 64)
+    assert flat["layers.wq"].shape == (2, 64, 64)
+    np.testing.assert_array_equal(
+        flat["tok_embed"], m.transformer.wte.weight.detach().numpy())
