@@ -157,11 +157,8 @@ class RaggedInferenceEngine:
                 "RaggedInferenceEngine does not support attention-scale "
                 "overrides (GPT-Neo); use InferenceEngine (dense KV cache)")
         if c.window_binds(self.config.max_context):
-            # sliding windows that bind within max_context (Mistral/Qwen2
-            # long-context serving) run on the banded gather path — the
-            # Pallas kernel's trimmed page walk is a later optimization
             log_dist("RaggedInferenceEngine: binding sliding window — "
-                     "using the banded gather attention path")
+                     "banded paged kernel on TPU, banded gather elsewhere")
         if self.config.max_context % self.config.kv_block_size != 0:
             raise ValueError(
                 f"max_context {self.config.max_context} must be a multiple of "
@@ -573,10 +570,13 @@ class RaggedInferenceEngine:
         # (GSPMD cannot partition a pallas_call) — TP serving runs the
         # gather path, which XLA partitions head-wise with zero collectives
         # inside attention. shard_map-wrapping the kernel is the follow-up.
+        # Binding sliding windows ride the kernel too: the per-layer window
+        # is STATIC (the python layer loop is unrolled), and the kernel
+        # skips + DMA-dedups chunks below the band (O(window) traffic).
         use_pallas = _use_pallas_paged(
             c.head_dim, bs, self.config.dtype,
             scalar_ints=cfg.max_seqs * self.max_pages + 2 * cfg.token_budget) \
-            and not any(windows) and self._tp_size == 1
+            and self._tp_size == 1
 
         def norm(x, w, b=None):
             return rms_norm(x, w, c.norm_eps) if c.norm == "rms" \
@@ -637,7 +637,8 @@ class RaggedInferenceEngine:
                 if use_pallas:
                     attn = paged_attention(q, kp, vp, block_tables,
                                            positions, seq_slots=safe_slot,
-                                           live_pages=live_pages)
+                                           live_pages=live_pages,
+                                           window=windows[li])
                 else:
                     attn = paged_attention_reference(q, kp, vp, tables,
                                                      positions,

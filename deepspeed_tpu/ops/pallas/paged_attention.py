@@ -53,7 +53,7 @@ LANES = 128
 
 def _kernel(*refs,
             scale: float, block: int, hkv: int, group: int, ppc: int,
-            num_scalars: int):
+            num_scalars: int, window: int = 0):
     # scalar-prefetch refs lead; positions is always the last of them
     pos_ref = refs[num_scalars - 1]
     q_ref, *rest = refs[num_scalars:]
@@ -72,6 +72,10 @@ def _kernel(*refs,
 
     pos = pos_ref[t]
     run = c * span <= pos  # chunk holds at least one visible row
+    if window > 0:
+        # banded: rows <= pos - window are invisible; skip chunks whose
+        # whole span lies below the band
+        run = jnp.logical_and(run, (c + 1) * span - 1 > pos - window)
 
     @pl.when(run)
     def _step():
@@ -83,7 +87,10 @@ def _kernel(*refs,
                                 preferred_element_type=jnp.float32) * scale
         s = s.reshape(hkv * group, span)
         row_pos = c * span + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(row_pos <= pos, s, NEG_INF)
+        visible = row_pos <= pos
+        if window > 0:
+            visible = jnp.logical_and(visible, row_pos > pos - window)
+        s = jnp.where(visible, s, NEG_INF)
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         pr = jnp.exp(s - m_new)                      # [hkv*group, span]
@@ -110,6 +117,7 @@ def paged_attention(q, k_pool, v_pool, tables, positions, *,
                     seq_slots=None, scale=None,
                     pages_per_chunk: int | None = None,
                     live_pages: int | None = None,
+                    window: int = 0,
                     interpret: bool = False):
     """Decode attention over a paged KV pool. See module docstring for the
     layout contract. Causal by construction: token t sees pool rows with
@@ -126,7 +134,13 @@ def paged_attention(q, k_pool, v_pool, tables, positions, *,
     ceil(live_pages / ppc) chunks per token. Dead chunks are pl.when-skipped
     anyway, but their ~us of grid overhead dominates short-context decode
     over a long max_context table (caller guarantees every
-    positions[t] < live_pages * block; rows beyond are silently ignored)."""
+    positions[t] < live_pages * block; rows beyond are silently ignored).
+
+    ``window`` > 0 (static) bands attention to the trailing ``window``
+    positions (Mistral/Qwen2 sliding-window serving): chunks wholly below
+    the band are pl.when-skipped AND their page DMA indices clamp to the
+    band's first live page, so repeated block indices dedup the copies —
+    compute and traffic are O(window), not O(context)."""
     T, hq, hd = q.shape
     n_pages, hkv, block, _ = k_pool.shape
     max_pages = tables.shape[1]
@@ -158,11 +172,16 @@ def paged_attention(q, k_pool, v_pool, tables, positions, *,
         def index(t, c, *s):
             # past-the-end slots re-use the last live page's index: Pallas
             # skips the copy when the block index repeats, so dead chunks
-            # cost no DMA — and the table read never strays off the row
+            # cost no DMA — and the table read never strays off the row.
+            # With a window, below-band slots clamp UP to the band's first
+            # live page for the same dedup effect.
             tbl, pos = s[0], s[-1]
             j = jnp.minimum(c * ppc + i, max_pages - 1)
-            return (tbl[row_of(t, s), jnp.minimum(j, pos[t] // block)],
-                    0, 0, 0)
+            j = jnp.minimum(j, pos[t] // block)
+            if window > 0:
+                lo = jnp.maximum(pos[t] - (window - 1), 0) // block
+                j = jnp.maximum(j, lo)
+            return (tbl[row_of(t, s), j], 0, 0, 0)
         return index
 
     page_spec = lambda i: pl.BlockSpec((1, hkv, block, hd), page_index(i))
@@ -180,7 +199,8 @@ def paged_attention(q, k_pool, v_pool, tables, positions, *,
     )
     out = pl.pallas_call(
         functools.partial(_kernel, scale=scale, block=block, hkv=hkv,
-                          group=group, ppc=ppc, num_scalars=len(scalars)),
+                          group=group, ppc=ppc, num_scalars=len(scalars),
+                          window=int(window)),
         out_shape=jax.ShapeDtypeStruct((T, hkv, group, hd), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,

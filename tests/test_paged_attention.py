@@ -131,3 +131,23 @@ def test_paged_seq_slots_indirection():
                                interpret=True)
     np.testing.assert_allclose(np.asarray(via_slots), np.asarray(expanded),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_paged_kernel_windowed_interpret():
+    """Banded (sliding-window) paged kernel vs the banded gather
+    reference, interpret mode — below-band chunks must be skipped without
+    perturbing the online softmax."""
+    rng = np.random.default_rng(7)
+    T, hq, hkv, hd, blk, mp = 6, 8, 4, 64, 16, 8
+    n_pages = T * mp + 1
+    q = jnp.asarray(rng.standard_normal((T, hq, hd)), jnp.float32)
+    kpool = jnp.asarray(rng.standard_normal((n_pages, hkv, blk, hd)), jnp.float32)
+    vpool = jnp.asarray(rng.standard_normal((n_pages, hkv, blk, hd)), jnp.float32)
+    tbl = jnp.asarray(rng.permutation(T * mp).reshape(T, mp), jnp.int32)
+    pos = jnp.asarray([3, 17, 40, 63, 100, 127], jnp.int32)
+    for w in (16, 33, 128):
+        got = paged_attention(q, kpool, vpool, tbl, pos, window=w,
+                              interpret=True)
+        want = paged_attention_reference(q, kpool, vpool, tbl, pos, window=w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
