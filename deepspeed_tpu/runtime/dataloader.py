@@ -138,3 +138,29 @@ class RepeatingLoader:
                 self.loader.set_epoch(getattr(self.loader, "epoch", 0) + 1)
             self._it = iter(self.loader)
             return next(self._it)
+
+
+def prefetch(iterator: Iterable, size: int = 2) -> Iterator[Any]:
+    """Device-prefetching wrapper: keeps ``size`` batches in flight so host
+    collate/placement of batch N+1 overlaps device compute on batch N
+    (the TPU analog of the reference loaders' pin_memory + non_blocking
+    copies; flax.jax_utils.prefetch_to_device pattern). ``jax.device_put``
+    is async — the queue holds device arrays whose uploads are already
+    enqueued, so the training loop never waits on host->device transfer
+    of the current batch."""
+    import collections
+
+    queue: collections.deque = collections.deque()
+    it = iter(iterator)
+
+    def enqueue(n):
+        for _ in range(n):
+            try:
+                queue.append(next(it))
+            except StopIteration:
+                return
+
+    enqueue(size)
+    while queue:
+        yield queue.popleft()
+        enqueue(1)

@@ -80,3 +80,11 @@ def test_data_analyzer_map_reduce(tmp_path):
     assert all(len(seqs[i]) <= 10 for i in easy)
     everything = samples_up_to_difficulty(info["metric_to_sample"], 40)
     assert len(everything) == len(seqs)
+
+
+def test_prefetch_preserves_order_and_count():
+    from deepspeed_tpu.runtime.dataloader import prefetch
+
+    out = list(prefetch(iter(range(7)), size=3))
+    assert out == list(range(7))
+    assert list(prefetch(iter([]), size=2)) == []
