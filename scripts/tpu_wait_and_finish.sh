@@ -15,27 +15,27 @@ while true; do
 done
 
 echo "== kernel numerics + perf (TPU_KERNEL_CHECK) =="
-python scripts/tpu_flash_check.py | tee /tmp/flash_check.out || true
+timeout 2400 python scripts/tpu_flash_check.py | tee /tmp/flash_check.out || true
 grep '^{' /tmp/flash_check.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp TPU_KERNEL_CHECK_r04.json || echo "[roundup] TPU_KERNEL_CHECK_r04.json NOT refreshed (stage produced no JSON)"
 
 echo "== ragged decode benchmark (TPU_DECODE_BENCH) =="
-python scripts/tpu_decode_bench.py | tee /tmp/decode_bench.out || true
+timeout 2400 python scripts/tpu_decode_bench.py | tee /tmp/decode_bench.out || true
 grep '^{' /tmp/decode_bench.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp TPU_DECODE_BENCH_r04.json || echo "[roundup] TPU_DECODE_BENCH_r04.json NOT refreshed (stage produced no JSON)"
 
 echo "== SLA serving benchmark (SERVE_BENCH) =="
-python scripts/tpu_serve_bench.py || true
+timeout 2400 python scripts/tpu_serve_bench.py || true
 
 echo "== quantized-collective pack-cost microbench (QUANT_COMM) =="
-python scripts/tpu_quant_comm_bench.py || true
+timeout 2400 python scripts/tpu_quant_comm_bench.py || true
 
 echo "== step-time breakdown (STEP_BREAKDOWN) =="
-python scripts/tpu_step_breakdown.py || true
+timeout 2400 python scripts/tpu_step_breakdown.py || true
 
 echo "== refreshed MFU sweep (new configs) =="
-python scripts/tpu_mfu_sweep.py || true
+timeout 2400 python scripts/tpu_mfu_sweep.py || true
 
 echo "== headline bench =="
-python bench.py | tee /tmp/bench.out || true
+timeout 2400 python bench.py | tee /tmp/bench.out || true
 grep '^{' /tmp/bench.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp BENCH_r04_local.json || echo "[roundup] BENCH_r04_local.json NOT refreshed"
 
 echo "[wait] all stages done"
