@@ -5,3 +5,5 @@ from .bert import Bert, DistilBert, bert_config, distilbert_config  # noqa: F401
 from .clip import CLIP, CLIPConfig, CLIPVision, clip_text_config, clip_vision_config  # noqa: F401
 from .moe import GPTMoE, MoETransformer, MoETransformerConfig, gpt_moe_config  # noqa: F401
 from .api import FromFlax, from_flax  # noqa: F401
+from .diffusion import (AutoencoderKL, UNet2DCondition, UNetConfig,  # noqa: F401
+                        VAEConfig)
