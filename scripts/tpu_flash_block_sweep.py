@@ -1,0 +1,69 @@
+"""Flash-kernel tile-shape sweep on the headline bench.
+
+The kernel defaults to 1024x1024 tiles; VMEM pressure vs pipeline depth
+is shape-dependent, so A/B the bench across block_q x block_k via the
+DST_FLASH_BLOCK_Q/K env knobs (ops/attention.py). One bench child per
+config (serial chip claims). Writes FLASH_BLOCK_SWEEP_r04.json.
+
+Usage: python scripts/tpu_flash_block_sweep.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIGS = [
+    {},                                                   # 1024x1024 default
+    {"DST_FLASH_BLOCK_Q": "512", "DST_FLASH_BLOCK_K": "1024"},
+    {"DST_FLASH_BLOCK_Q": "1024", "DST_FLASH_BLOCK_K": "512"},
+    {"DST_FLASH_BLOCK_Q": "512", "DST_FLASH_BLOCK_K": "512"},
+    {"DST_FLASH_BLOCK_Q": "2048", "DST_FLASH_BLOCK_K": "1024"},
+    {"DST_FLASH_BLOCK_Q": "256", "DST_FLASH_BLOCK_K": "1024"},
+]
+
+
+def main():
+    results = []
+    for cfg in CONFIGS:
+        env = dict(os.environ, **cfg)
+        entry = {"config": cfg or {"DST_FLASH_BLOCK_Q": "1024",
+                                   "DST_FLASH_BLOCK_K": "1024"},
+                 "result": None, "rc": None}
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(HERE, "bench.py")], env=env,
+                capture_output=True, text=True, timeout=2400, cwd=HERE)
+            entry["rc"] = proc.returncode
+            for ln in (proc.stdout or "").splitlines():
+                ln = ln.strip()
+                if ln.startswith("{") and '"metric"' in ln:
+                    try:
+                        entry["result"] = json.loads(ln)
+                    except json.JSONDecodeError:
+                        pass
+            plat = ((entry["result"] or {}).get("extra") or {}).get("platform", "")
+            if entry["result"] is not None and "TPU" not in plat:
+                entry["result"] = None
+                entry["tpu_config_failed"] = True
+        except subprocess.TimeoutExpired:
+            entry["rc"] = "timeout"
+        results.append(entry)
+        mfu = ((entry["result"] or {}).get("extra") or {}).get("mfu")
+        print(f"[block-sweep] {entry['config']} -> mfu={mfu}", flush=True)
+    with open(os.path.join(HERE, "FLASH_BLOCK_SWEEP_r04.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    best = max((r for r in results if r["result"]),
+               key=lambda r: r["result"]["extra"].get("mfu", 0), default=None)
+    if best:
+        print("BEST:", best["config"], "mfu =",
+              best["result"]["extra"].get("mfu"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
