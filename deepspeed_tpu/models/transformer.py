@@ -310,12 +310,26 @@ class Transformer:
         from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P_
 
-        batch_axes = getattr(self, "_batch_axes", None) or None
-        head_axes = "model" if self._tp_size > 1 else None
-        spec = P_(batch_axes, None, head_axes, None)
+        batch_axes = getattr(self, "_batch_axes", None) or ()
+        tp = self._tp_size
+        dp = 1
+        for a in batch_axes:
+            dp *= mesh.shape.get(a, 1)
+        # the wrapper needs every named dim to divide its axes; GQA counts
+        # must shard TOGETHER (sharding q but replicating kv would invert
+        # the local q:kv ratio). Unwrappable corners (kv heads < tp, odd
+        # batch) fall back to the jnp path, which GSPMD partitions fine —
+        # correctness kept, and still no opaque pallas_call in the graph.
+        heads_ok = tp == 1 or (q.shape[2] % tp == 0 and k.shape[2] % tp == 0)
+        batch_ok = dp == 1 or q.shape[0] % dp == 0
+        if not (heads_ok and batch_ok):
+            return dot_product_attention(
+                q, k, v, causal=causal, scale=scale, window=window)
+        ha = "model" if tp > 1 else None
+        spec = P_(tuple(batch_axes) or None, None, ha, None)
         return shard_map(lambda q, k, v: fa(q, k, v, **kw), mesh=mesh,
-                         in_specs=(spec, spec, spec), out_specs=spec,
-                         check_rep=False)(q, k, v)
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec, check_rep=False)(q, k, v)
 
     def _sp_attention(self, q, k, v, window=None, causal=True):
         """Sequence-parallel attention over the bound mesh's seq axis."""
