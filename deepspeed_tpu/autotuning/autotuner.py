@@ -59,6 +59,9 @@ class TuningConstraints:
     micro_batches: List[int] = field(default_factory=lambda: [1, 2, 4, 8])
     zero_stages: List[int] = field(default_factory=lambda: [3])
     tp_sizes: Optional[List[int]] = None       # default: divisors of n_devices
+    # Ulysses sequence-parallel degrees to try (long-context configs where
+    # activations, not params, bound memory); 1 = off
+    sp_sizes: List[int] = field(default_factory=lambda: [1])
     remat_options: List[bool] = field(default_factory=lambda: [True, False])
 
 
@@ -103,15 +106,19 @@ class Autotuner:
         n = self.n_devices
         tps = self.c.tp_sizes or [t for t in (1, 2, 4, 8) if n % t == 0 and t <= n]
         out = []
-        for tp, mb, stage, remat in itertools.product(
-                tps, self.c.micro_batches, self.c.zero_stages,
-                self.c.remat_options):
-            dp = n // tp
+        for tp, sp, mb, stage, remat in itertools.product(
+                tps, self.c.sp_sizes, self.c.micro_batches,
+                self.c.zero_stages, self.c.remat_options):
+            if n % (tp * sp):
+                continue
+            dp = n // (tp * sp)
             if self.c.global_batch % (dp * mb):
                 continue
-            out.append({"mesh": {"data": dp, "model": tp},
-                        "micro_batch": mb, "zero_stage": stage,
-                        "remat": remat})
+            mesh = {"data": dp, "model": tp}
+            if sp > 1:
+                mesh["seq"] = sp
+            out.append({"mesh": mesh, "micro_batch": mb,
+                        "zero_stage": stage, "remat": remat})
         return out
 
     # -- per-candidate compile + analysis ------------------------------

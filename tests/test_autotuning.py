@@ -65,3 +65,28 @@ def test_remat_reduces_peak_memory():
                 for c in report["candidates"] if c["feasible"]}
     if len(by_remat) == 2:  # both compiled
         assert by_remat[True] <= by_remat[False] * 1.1
+
+
+def test_autotune_sp_candidates():
+    """sp_sizes adds Ulysses seq-axis candidates; infeasible tp*sp combos
+    are skipped."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner, TuningConstraints
+    from deepspeed_tpu.models import Llama
+
+    tuner = Autotuner(
+        lambda remat: Llama("tiny", n_layers=2, d_model=64, n_heads=4,
+                            n_kv_heads=4, vocab_size=256, max_seq_len=64,
+                            use_flash=False, remat=remat),
+        TuningConstraints(n_devices=8, global_batch=8, seq_len=64,
+                          micro_batches=[1], zero_stages=[2],
+                          tp_sizes=[1, 2], sp_sizes=[1, 2],
+                          remat_options=[False]))
+    cands = tuner.candidates()
+    meshes = [c["mesh"] for c in cands]
+    assert {"data": 4, "model": 1, "seq": 2} in meshes
+    assert {"data": 8, "model": 1} in meshes
+    # and an sp candidate actually compiles + evaluates
+    sp_cand = next(c for c in cands if c["mesh"].get("seq") == 2)
+    r = tuner.evaluate(sp_cand)
+    assert r.error is None, r.error
+    assert r.feasible
