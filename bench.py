@@ -117,7 +117,8 @@ def _child_main():
     # number above IS that dispatch tax. Both are reported.
     scan_ms = scan_mfu = None
     scan_flag = os.environ.get("DST_BENCH_SCAN", "1")
-    if (on_tpu and scan_flag == "1") or scan_flag == "force":
+    try:
+      if (on_tpu and scan_flag == "1") or scan_flag == "force":
         step_fn = engine._train_step_fn
         K = 10
 
@@ -144,6 +145,11 @@ def _child_main():
          engine.rng) = carry
         scan_ms = scan_dt / K * 1e3
         scan_mfu = tokens_per_step * K / scan_dt * flops_per_token / peak
+    except Exception as e:  # noqa: BLE001 — optional metric must never
+        # destroy the headline JSON (e.g. scan-compile OOM)
+        print(f"[bench] compiled-loop leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        scan_ms = scan_mfu = None
     # CPU fallback rows get a distinct metric name so a consumer reading
     # metric+value alone is never misled into comparing smoke-model CPU
     # numbers against the TPU headline.

@@ -61,10 +61,8 @@ def _flat_params(state: Dict[str, Any]) -> Dict[str, np.ndarray]:
     return out
 
 
-def to_universal(ckpt_dir: str, out_dir: str, tag: Optional[str] = None) -> str:
-    """Explode a checkpoint into per-param .npy files + an index
-    (reference ds_to_universal.py:286 main)."""
-    flat = _flat_params(_load_state(ckpt_dir, tag))
+def _write_universal(flat, out_dir: str, source: Optional[str] = None) -> str:
+    """Shared explode-to-universal writer (per-param .npy + index)."""
     os.makedirs(out_dir, exist_ok=True)
     index = {}
     for key, arr in flat.items():
@@ -72,9 +70,18 @@ def to_universal(ckpt_dir: str, out_dir: str, tag: Optional[str] = None) -> str:
         np.save(os.path.join(out_dir, fname), arr)
         index[key] = {"file": fname, "shape": list(arr.shape),
                       "dtype": str(arr.dtype)}
+    meta = {"version": 1, "params": index}
+    if source:
+        meta["source"] = source
     with open(os.path.join(out_dir, "universal_index.json"), "w") as f:
-        json.dump({"version": 1, "params": index}, f, indent=2)
+        json.dump(meta, f, indent=2)
     return out_dir
+
+
+def to_universal(ckpt_dir: str, out_dir: str, tag: Optional[str] = None) -> str:
+    """Explode a checkpoint into per-param .npy files + an index
+    (reference ds_to_universal.py:286 main)."""
+    return _write_universal(_flat_params(_load_state(ckpt_dir, tag)), out_dir)
 
 
 def zero_to_fp32(ckpt_dir: str, out_file: str, tag: Optional[str] = None) -> str:
@@ -91,32 +98,35 @@ def megatron_to_universal(megatron_dir: str, out_dir: str) -> str:
     """Megatron-LM GPT checkpoint -> universal layout (the reference's
     ds_to_universal path also reshapes Megatron checkpoints). Dense and
     deepspeed_moe checkpoints both supported; the exploded params use the
-    NATIVE stacked naming, so any mesh/stage can consume them."""
-    import jax
+    NATIVE stacked naming, so any mesh/stage can consume them. One
+    checkpoint read: the blob is loaded once and mapped directly."""
+    from .megatron import (map_megatron_gpt, map_megatron_gpt_moe,
+                           megatron_config, read_megatron_state)
 
-    from .megatron import from_megatron, from_megatron_moe, read_megatron_state
-
-    state, _, _ = read_megatron_state(megatron_dir)
+    state, args, version = read_megatron_state(megatron_dir)
     moe = any(".deepspeed_moe." in k for k in state)
-    del state
-    loader = from_megatron_moe if moe else from_megatron
-    _, params = loader(megatron_dir)
-    flat = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
-        key = ".".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                       for k in path)
-        flat[key] = np.asarray(leaf)
-    os.makedirs(out_dir, exist_ok=True)
-    index = {}
-    for key, arr in flat.items():
-        fname = f"{key}.npy"
-        np.save(os.path.join(out_dir, fname), arr)
-        index[key] = {"file": fname, "shape": list(arr.shape),
-                      "dtype": str(arr.dtype)}
-    with open(os.path.join(out_dir, "universal_index.json"), "w") as f:
-        json.dump({"version": 1, "source": "megatron", "params": index}, f,
-                  indent=2)
-    return out_dir
+    if moe:
+        from ..models.moe import MoETransformerConfig
+
+        base = megatron_config(args)
+        n_exp = args.get("num_experts", 0)
+        n_exp = n_exp[0] if isinstance(n_exp, list) else n_exp
+        cfg = MoETransformerConfig(
+            vocab_size=base.vocab_size, d_model=base.d_model,
+            n_layers=base.n_layers, n_heads=base.n_heads,
+            n_kv_heads=base.n_kv_heads, d_ff=base.d_ff,
+            max_seq_len=base.max_seq_len, norm="layer", activation="gelu",
+            position="learned", tie_embeddings=True, use_bias=True,
+            norm_eps=base.norm_eps, n_experts=int(n_exp) or 1,
+            top_k=int(args.get("topk", 1)))
+        params = map_megatron_gpt_moe(state, cfg, checkpoint_version=version)
+    else:
+        params = map_megatron_gpt(state, megatron_config(args),
+                                  checkpoint_version=version)
+    flat = _flat_params({"params": params})
+    flat = {k[len("params."):] if k.startswith("params.") else k: v
+            for k, v in flat.items()}
+    return _write_universal(flat, out_dir, source="megatron")
 
 
 def load_universal(universal_dir: str) -> Dict[str, np.ndarray]:

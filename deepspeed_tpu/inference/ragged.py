@@ -560,8 +560,8 @@ class RaggedInferenceEngine:
         cfg = self.config
         bs = cfg.kv_block_size
         # per-layer sliding windows (static tuple; 0 = global causal);
-        # a binding window forces the gather path for ALL layers — mixed
-        # kernel/gather would duplicate the table plumbing for no win
+        # binding windows ride the banded Pallas kernel per layer on TPU
+        # (window passed statically below) and the banded gather elsewhere
         aw = getattr(c, "attn_windows", None)
         windows = tuple(int(w) if 0 < int(w) < cfg.max_context else 0
                         for w in aw) if aw is not None \

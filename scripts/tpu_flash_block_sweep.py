@@ -30,7 +30,11 @@ CONFIGS = [
 def main():
     results = []
     for cfg in CONFIGS:
-        env = dict(os.environ, **cfg)
+        env = dict(os.environ)
+        # inherited knobs would silently mislabel the baseline row
+        env.pop("DST_FLASH_BLOCK_Q", None)
+        env.pop("DST_FLASH_BLOCK_K", None)
+        env.update(cfg)
         entry = {"config": cfg or {"DST_FLASH_BLOCK_Q": "1024",
                                    "DST_FLASH_BLOCK_K": "1024"},
                  "result": None, "rc": None}
