@@ -169,11 +169,13 @@ class RaggedInferenceEngine:
             lambda x: x.astype(self.config.dtype)
             if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) else x,
             self.params)
-        if tp > 1:
-            # tensor-parallel serving (FastGen v2's TP configuration): place
-            # params under the model's partition specs; GSPMD shards every
-            # projection + the vocab head and inserts the o-proj/logits
-            # collectives. The KV pool shards by head below.
+        if topology is not None and topology.world_size > 1:
+            # sharded serving (FastGen v2's TP configuration, plus expert
+            # parallelism for MoE): place params under the model's
+            # partition specs; GSPMD shards every projection + the vocab
+            # head (and routes expert dispatch over the 'expert' axis) and
+            # inserts the collectives. The KV pool shards by head below
+            # when a 'model' axis is present.
             from jax.sharding import NamedSharding
 
             specs = model.partition_specs(self.params, topology)

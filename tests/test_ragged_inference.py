@@ -374,3 +374,30 @@ def test_ragged_tp_rejects_indivisible_heads():
     with pytest.raises(ValueError, match="n_kv_heads"):
         RaggedInferenceEngine(model, RaggedConfig(max_context=128),
                               topology=topo)
+
+
+def test_ragged_expert_parallel_serving():
+    """MoE serving over the 'expert' mesh axis: the expert bank shards
+    per partition_specs and GSPMD routes dispatch — greedy output stays
+    token-exact vs the unsharded engine."""
+    from deepspeed_tpu.models import GPTMoE
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    model = GPTMoE("tiny", n_experts=4, n_layers=2, d_model=64, n_heads=4,
+                   n_kv_heads=4, vocab_size=256, max_seq_len=128,
+                   use_flash=False, remat=False)
+    cfg = RaggedConfig(token_budget=64, max_seqs=4, kv_block_size=16,
+                       n_kv_blocks=64, max_context=128)
+    rng = np.random.default_rng(13)
+    prompts = {5: rng.integers(1, 256, (11,)).tolist(),
+               6: rng.integers(1, 256, (20,)).tolist()}
+
+    eng = RaggedInferenceEngine(model, cfg, rng=jax.random.PRNGKey(4))
+    want = eng.generate(dict(prompts), max_new_tokens=6)
+
+    mesh_mod.reset_topology()
+    topo = mesh_mod.Topology.build_virtual({"expert": 2, "model": 2})
+    eng_ep = RaggedInferenceEngine(model, cfg, rng=jax.random.PRNGKey(4),
+                                   topology=topo)
+    got = eng_ep.generate(dict(prompts), max_new_tokens=6)
+    assert got == want, (got, want)
