@@ -401,3 +401,29 @@ def test_ragged_expert_parallel_serving():
                                    topology=topo)
     got = eng_ep.generate(dict(prompts), max_new_tokens=6)
     assert got == want, (got, want)
+
+
+def test_ragged_tp_windowed_serving():
+    """Binding sliding windows under TP serving: the banded gather path
+    (kernel is single-device) composes with head-sharded pools,
+    token-exact vs unsharded."""
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    model = Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=4,
+                  vocab_size=256, max_seq_len=128, use_flash=False,
+                  remat=False, attn_windows=(32, 32))
+    cfg = RaggedConfig(token_budget=64, max_seqs=4, kv_block_size=16,
+                       n_kv_blocks=64, max_context=128)
+    rng = np.random.default_rng(17)
+    prompts = {1: rng.integers(1, 256, (40,)).tolist(),
+               2: rng.integers(1, 256, (50,)).tolist()}
+
+    eng = RaggedInferenceEngine(model, cfg, rng=jax.random.PRNGKey(6))
+    want = eng.generate(dict(prompts), max_new_tokens=6)
+
+    mesh_mod.reset_topology()
+    topo = mesh_mod.Topology.build_virtual({"model": 2})
+    eng_tp = RaggedInferenceEngine(model, cfg, rng=jax.random.PRNGKey(6),
+                                   topology=topo)
+    got = eng_tp.generate(dict(prompts), max_new_tokens=6)
+    assert got == want, (got, want)
