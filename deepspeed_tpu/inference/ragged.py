@@ -409,7 +409,8 @@ class RaggedInferenceEngine:
             b *= 2
         return min(b, self.max_pages)
 
-    def decode_steps(self, first_tokens: Dict[int, int], k: int) -> Dict[int, List[int]]:
+    def decode_steps(self, first_tokens: Dict[int, int], k: int,
+                     eos_token_id: Optional[int] = None) -> Dict[int, List[int]]:
         """Decode ``k`` tokens (greedy or sampled per config) for every uid
         in ``first_tokens`` in ONE device call (see _build_decode).
 
@@ -463,20 +464,35 @@ class RaggedInferenceEngine:
         steps_xs = np.arange(self._decode_step_counter,
                              self._decode_step_counter + k, dtype=np.int32)
         self._decode_step_counter += k
+        eos = -1 if eos_token_id is None else int(eos_token_id)
         gen, self.kv_pool = self._decode_fn(
             self.params, self.kv_pool, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(slots), jnp.asarray(self._host_tables()),
             jnp.asarray(steps_xs), self._rng_decode,
-            self._live_pages_bucket())
+            self._live_pages_bucket(), eos)
         gen = np.asarray(gen)                                   # [S, k]
 
         out = {}
         for uid, first in first_tokens.items():
             seq = self.seqs[uid]
             chain = gen[seq.slot].tolist()
-            # positions seen..seen+k-1 now hold first + chain[:-1]
-            seq.tokens.extend([first] + chain[:-1])
-            seq.seen += k
+            if eos >= 0:
+                # device-side freeze: only tokens actually FED are context.
+                # first==eos feeds nothing; eos at chain[j] means first +
+                # chain[:j] were fed (the EOS itself is emitted, not fed)
+                if first == eos:
+                    fed = []
+                elif eos in chain:
+                    j = chain.index(eos)
+                    fed = [first] + chain[:j]
+                else:
+                    fed = [first] + chain[:-1]
+                seq.tokens.extend(fed)
+                seq.seen += len(fed)
+            else:
+                # positions seen..seen+k-1 now hold first + chain[:-1]
+                seq.tokens.extend([first] + chain[:-1])
+                seq.seen += k
             out[uid] = chain
         return out
 
@@ -531,7 +547,7 @@ class RaggedInferenceEngine:
             room = min(self.config.max_context - self.seqs[u].seen
                        for u in live)
             k = max(1, min(decode_chunk, budget, room))
-            gens = self.decode_steps(live, k)
+            gens = self.decode_steps(live, k, eos_token_id=eos_token_id)
             nxt = {}
             for u, chain in gens.items():
                 stop = False
@@ -706,21 +722,38 @@ class RaggedInferenceEngine:
         cfg = self.config
 
         def decode(params, pools, tokens0, positions0, slots, block_tables,
-                   steps_xs, rng_key, live_pages):
+                   steps_xs, rng_key, live_pages, eos_id):
             # steps_xs: [k] GLOBAL decode-step ids — the per-step sample key
             # is fold_in(rng_key, global_step), so token streams do not
-            # depend on the chunking of decode calls
+            # depend on the chunking of decode calls.
+            # eos_id >= 0 freezes a lane ON DEVICE once it samples EOS:
+            # its token is never fed, its KV scatter routes to the sink
+            # page (slot -1), its position stops advancing, and it emits
+            # eos fillers — post-EOS context pollution cannot happen
+            # (reference ragged manager retires finished sequences
+            # host-side per step; the compiled chunk does it in-loop).
+            alive0 = slots >= 0
+            if eos_id >= 0:
+                alive0 = jnp.logical_and(alive0, tokens0 != eos_id)
+
             def one(carry, step_i):
-                pools, toks, pos = carry
-                x, pools = core(params, pools, toks, slots, pos, block_tables,
-                                live_pages)
+                pools, toks, pos, alive = carry
+                slots_eff = jnp.where(alive, slots, -1)
+                x, pools = core(params, pools, toks, slots_eff, pos,
+                                block_tables, live_pages)
                 logits = model._head(params, x[None, :])[0]    # [S, vocab]
                 nxt = _sample(logits, jax.random.fold_in(rng_key, step_i),
                               cfg.temperature, cfg.top_k, cfg.top_p)
-                return (pools, nxt, pos + 1), nxt
+                if eos_id >= 0:
+                    nxt = jnp.where(alive, nxt, eos_id)
+                    new_alive = jnp.logical_and(alive, nxt != eos_id)
+                else:
+                    new_alive = alive
+                pos = pos + alive.astype(pos.dtype)
+                return (pools, nxt, pos, new_alive), nxt
 
-            (pools, _, _), gen = jax.lax.scan(
-                one, (pools, tokens0, positions0), steps_xs)
+            (pools, _, _, _), gen = jax.lax.scan(
+                one, (pools, tokens0, positions0, alive0), steps_xs)
             return gen.T, pools                                 # [S, k]
 
-        return jax.jit(decode, donate_argnums=(1,), static_argnums=(8,))
+        return jax.jit(decode, donate_argnums=(1,), static_argnums=(8, 9))

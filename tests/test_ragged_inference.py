@@ -427,3 +427,43 @@ def test_ragged_tp_windowed_serving():
                                    topology=topo)
     got = eng_tp.generate(dict(prompts), max_new_tokens=6)
     assert got == want, (got, want)
+
+
+def test_decode_steps_eos_freeze_keeps_context_clean():
+    """On-device EOS freeze: a lane that samples EOS mid-chunk stops
+    feeding tokens (KV routes to the sink page, position halts), so a
+    later put() on the same uid continues from an UNPOLLUTED context —
+    logits must match a fresh engine that never saw the post-EOS steps."""
+    model = Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=2,
+                  vocab_size=256, max_seq_len=128, use_flash=False,
+                  remat=False)
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = dict(token_budget=64, max_seqs=4, kv_block_size=16,
+               n_kv_blocks=64, max_context=128)
+    rng = np.random.default_rng(23)
+    prompt = rng.integers(1, 256, (12,)).tolist()
+
+    eng = RaggedInferenceEngine(model, RaggedConfig(**cfg), params=params)
+    row = eng.put([1], [prompt])
+    first = int(np.argmax(row[0]))
+    # find the eos id that the chain will hit mid-chunk: run a probe chunk
+    probe = eng.decode_steps({1: first}, 6)[1]
+    eos = probe[2]                       # pretend token at step 2 is EOS
+    eng.flush([1])
+
+    # engine A: same decode WITH the freeze
+    eng_a = RaggedInferenceEngine(model, RaggedConfig(**cfg), params=params)
+    first_a = int(np.argmax(eng_a.put([1], [prompt])[0]))
+    assert first_a == first
+    chain = eng_a.decode_steps({1: first}, 6, eos_token_id=eos)[1]
+    j = chain.index(eos)
+    assert chain[j + 1:] == [eos] * (6 - j - 1)   # frozen fillers
+    fed = [first] + chain[:j]
+    assert eng_a.seqs[1].seen == len(prompt) + len(fed)
+    cont_a = eng_a.put([1], [[97]])
+
+    # engine B: fresh, fed exactly prompt + fed tokens, then the same put
+    eng_b = RaggedInferenceEngine(model, RaggedConfig(**cfg), params=params)
+    eng_b.put([1], [prompt + fed])
+    cont_b = eng_b.put([1], [[97]])
+    np.testing.assert_allclose(cont_a[0], cont_b[0], rtol=1e-4, atol=1e-4)
