@@ -1,0 +1,59 @@
+"""Profiler traces + range annotations.
+
+Parity surface: the reference's NVTX instrumentation
+(``deepspeed/utils/nvtx.py`` ``instrument_w_nvtx``, used throughout
+ZeRO-3) and ``accelerator.range_push/range_pop``. TPU-native form: the
+XLA profiler — ``trace()`` captures a TensorBoard-loadable trace
+(HLO timelines, per-op device time, memory viewer), ``annotate``/
+``instrument`` put named ranges on the host track exactly where the
+reference put NVTX ranges, and ``step`` marks step boundaries so the
+profiler's step view groups ops per training step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Capture an XLA profiler trace into ``logdir`` (view with
+    TensorBoard's profile plugin)."""
+    jax.profiler.start_trace(logdir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named range on the profiler's host track (the range_push/range_pop
+    analog). Usable as a context manager."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step(step_num: int):
+    """Step-boundary annotation: groups device ops under one training step
+    in the profiler's step view."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step_num)
+
+
+def instrument(fn=None, *, name: Optional[str] = None):
+    """Decorator putting a named range around every call (reference
+    ``instrument_w_nvtx``)."""
+    def wrap(f):
+        label = name or getattr(f, "__qualname__", getattr(f, "__name__", "fn"))
+
+        @functools.wraps(f)
+        def inner(*args, **kwargs):
+            with jax.profiler.TraceAnnotation(label):
+                return f(*args, **kwargs)
+
+        return inner
+
+    return wrap(fn) if fn is not None else wrap
