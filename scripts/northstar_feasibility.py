@@ -39,10 +39,12 @@ V5P_HBM = 95e9
 V5P_BW = 2765e9
 
 CONFIGS = [
-    # (name, micro_batch_per_chip, seq, remat)
-    ("mb1_s4096_remat", 1, 4096, "full"),
-    ("mb2_s4096_remat", 2, 4096, "full"),
-    ("mb1_s4096_selective", 1, 4096, "selective"),
+    # (name, size, micro_batch_per_chip, seq, remat)
+    ("mb1_s4096_remat", "7b", 1, 4096, "full"),
+    ("mb2_s4096_remat", "7b", 2, 4096, "full"),
+    ("mb1_s4096_selective", "7b", 1, 4096, "selective"),
+    # scale headroom: Llama-2-70B (GQA 8kv) on the same v5p-64 mesh
+    ("70b_mb1_s4096_remat", "70b", 1, 4096, "full"),
 ]
 
 
@@ -63,9 +65,9 @@ def _run_child():
                        "peak_bf16_flops": V5P_PEAK, "hbm_gbps": V5P_BW / 1e9},
               "n_devices": n, "configs": []}
 
-    for name, mb, seq, remat in CONFIGS:
+    for name, size, mb, seq, remat in CONFIGS:
         reset_topology()
-        model = Llama("7b", use_flash=False, remat=True, remat_policy=remat)
+        model = Llama(size, use_flash=False, remat=True, remat_policy=remat)
         topo = Topology.build(MeshConfig(data=n), devices=jax.devices()[:n])
         cfg = Config.from_any({
             "train_batch_size": mb * n,
@@ -106,8 +108,8 @@ def _run_child():
             return (jax.lax.with_sharding_constraint(params, param_sh),
                     mu, nu)
 
-        entry = {"name": name, "micro_batch_per_chip": mb, "seq_len": seq,
-                 "global_batch": mb * n, "remat": remat}
+        entry = {"name": name, "model": size, "micro_batch_per_chip": mb,
+                 "seq_len": seq, "global_batch": mb * n, "remat": remat}
         try:
             lowered = jax.jit(
                 step,
