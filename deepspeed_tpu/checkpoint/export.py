@@ -1,0 +1,108 @@
+"""Export native params back to HuggingFace format.
+
+The reference's ``save_16bit_model`` emits an HF-loadable
+``pytorch_model.bin`` because its module IS a torch HF model
+(engine.py:3010 save path + utils/zero_to_fp32.py consolidation). The
+native stacked layout needs the inverse of checkpoint/hf.py's ingestion
+mapping: unstack the [n_layers, ...] leaves, transpose [in, out] back to
+torch's [out, in], and write safetensors + config.json that
+``transformers`` (and any HF-ecosystem tool) loads directly.
+
+Supported: the llama-layout families (Llama/Mistral/InternLM/Qwen2 —
+RMSNorm + RoPE + gated SiLU + GQA, with optional attention biases).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+__all__ = ["export_hf_llama"]
+
+
+def _t(x) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(x))
+
+
+def _tT(x) -> np.ndarray:
+    """Transpose to torch's [out, in] and make it CONTIGUOUS: safetensors
+    serializes the raw buffer, so a strided .T view would silently write
+    the untransposed bytes under a transposed header."""
+    return np.ascontiguousarray(np.asarray(x).T)
+
+
+def export_hf_llama(model, params: Dict[str, Any], out_dir: str,
+                    model_type: str = "llama") -> str:
+    """Write ``out_dir/model.safetensors`` + ``config.json`` in HF llama
+    naming from a native Transformer's params. Inverse of
+    checkpoint/hf.py::_map_llama (transposes + per-layer unstacking)."""
+    c = model.config
+    if c.norm != "rms" or c.activation != "silu_glu" or c.position != "rope":
+        raise NotImplementedError(
+            "export_hf_llama handles the llama layout (rms + silu_glu + "
+            f"rope); got norm={c.norm} activation={c.activation} "
+            f"position={c.position}")
+    os.makedirs(out_dir, exist_ok=True)
+    lay = params["layers"]
+    state: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _t(params["tok_embed"]),
+        "model.norm.weight": _t(params["final_norm_w"]),
+    }
+    if not c.tie_embeddings:
+        state["lm_head.weight"] = _tT(params["lm_head"])
+    for i in range(c.n_layers):
+        L = f"model.layers.{i}."
+        state.update({
+            L + "input_layernorm.weight": _t(lay["attn_norm_w"][i]),
+            L + "post_attention_layernorm.weight": _t(lay["mlp_norm_w"][i]),
+            L + "self_attn.q_proj.weight": _tT(lay["wq"][i]),
+            L + "self_attn.k_proj.weight": _tT(lay["wk"][i]),
+            L + "self_attn.v_proj.weight": _tT(lay["wv"][i]),
+            L + "self_attn.o_proj.weight": _tT(lay["wo"][i]),
+            L + "mlp.gate_proj.weight": _tT(lay["w_gate"][i]),
+            L + "mlp.up_proj.weight": _tT(lay["w_up"][i]),
+            L + "mlp.down_proj.weight": _tT(lay["w_down"][i]),
+        })
+        if "bq" in lay:
+            state[L + "self_attn.q_proj.bias"] = _t(lay["bq"][i])
+            state[L + "self_attn.k_proj.bias"] = _t(lay["bk"][i])
+            state[L + "self_attn.v_proj.bias"] = _t(lay["bv"][i])
+        if "bo" in lay:
+            state[L + "self_attn.o_proj.bias"] = _t(lay["bo"][i])
+
+    from safetensors.numpy import save_file
+
+    # safetensors has no bf16 numpy dtype bridge everywhere — export fp32
+    # unless the leaves already are a numpy-native dtype
+    state = {k: (v.astype(np.float32)
+                 if v.dtype not in (np.float32, np.float16) else v)
+             for k, v in state.items()}
+    save_file(state, os.path.join(out_dir, "model.safetensors"))
+
+    hf_config = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": model_type,
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.d_model,
+        "intermediate_size": c.d_ff,
+        "num_hidden_layers": c.n_layers,
+        "num_attention_heads": c.n_heads,
+        "num_key_value_heads": c.n_kv_heads,
+        "max_position_embeddings": c.max_seq_len,
+        "rms_norm_eps": c.norm_eps,
+        "rope_theta": c.rope_theta,
+        "tie_word_embeddings": bool(c.tie_embeddings),
+        "attention_bias": bool(c.qkv_bias),
+        "hidden_act": "silu",
+        "torch_dtype": "float32",
+    }
+    if getattr(c, "attn_windows", None):
+        w = c.attn_windows[0]
+        if w and all(x == w for x in c.attn_windows):
+            hf_config["sliding_window"] = int(w)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(hf_config, f, indent=2)
+    return out_dir
