@@ -1,0 +1,25 @@
+"""Continuous-batching serving (FastGen-style) from an HF checkpoint.
+
+    python examples/serve_ragged.py /path/to/hf-llama-checkpoint
+"""
+
+import sys
+
+import jax
+
+from deepspeed_tpu.checkpoint import from_pretrained
+from deepspeed_tpu.inference.ragged import RaggedConfig, RaggedInferenceEngine
+
+model, params = from_pretrained(sys.argv[1], dtype=jax.numpy.bfloat16)
+eng = RaggedInferenceEngine(
+    model,
+    RaggedConfig(token_budget=2048, max_seqs=64, kv_block_size=16,
+                 n_kv_blocks=8192, max_context=model.config.max_seq_len,
+                 temperature=0.7, top_p=0.95),
+    params=params)
+    # topology=Topology.build_virtual({"model": 8})  # TP serving
+
+prompts = {0: [1, 15043, 29871], 1: [1, 1724, 338, 278]}
+out = eng.generate(prompts, max_new_tokens=64, eos_token_id=2)
+for uid, toks in out.items():
+    print(uid, toks)

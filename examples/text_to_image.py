@@ -1,0 +1,28 @@
+"""Stable-diffusion sampling with the one-jit DDIM pipeline.
+
+    python examples/text_to_image.py /path/to/sd-checkpoint-dir
+(expects diffusers layout: unet/, vae/, text_encoder/, with config.json
++ weights in each)
+"""
+
+import sys
+
+import jax
+
+from deepspeed_tpu.checkpoint.diffusers import load_unet, load_vae
+from deepspeed_tpu.inference.diffusion import DDIMSchedule, StableDiffusionPipeline
+
+root = sys.argv[1]
+unet, unet_params = load_unet(f"{root}/unet")
+vae, vae_params = load_vae(f"{root}/vae")
+
+# text conditioning: CLIP text tower (models/clip.py) or any [b, seq, dim]
+# embedding; zeros give unconditional samples
+ctx = jax.numpy.zeros((1, 77, unet.config.cross_attention_dim))
+
+pipe = StableDiffusionPipeline(unet, vae=vae,
+                               schedule=DDIMSchedule(num_inference_steps=30),
+                               guidance_scale=7.5)
+img = pipe(unet_params, ctx, ctx, jax.random.PRNGKey(0),
+           vae_params=vae_params, height=64, width=64)
+print("image:", img.shape, "range", float(img.min()), float(img.max()))
