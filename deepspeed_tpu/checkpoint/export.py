@@ -106,3 +106,63 @@ def export_hf_llama(model, params: Dict[str, Any], out_dir: str,
     with open(os.path.join(out_dir, "config.json"), "w") as f:
         json.dump(hf_config, f, indent=2)
     return out_dir
+
+
+def export_hf_gpt2(model, params: Dict[str, Any], out_dir: str) -> str:
+    """Write HF GPT-2 format (Conv1D [in, out] — the native orientation,
+    fused c_attn) from a native GPT-2-layout Transformer. Together with
+    checkpoint/megatron.py this is a Megatron-LM -> HF conversion
+    pipeline. Inverse of checkpoint/hf.py::_map_gpt2."""
+    c = model.config
+    if c.norm != "layer" or c.position != "learned" or not c.use_bias:
+        raise NotImplementedError(
+            "export_hf_gpt2 handles the GPT-2 layout (layer norm + learned "
+            f"positions + biases); got norm={c.norm} position={c.position} "
+            f"use_bias={c.use_bias}")
+    if c.n_kv_heads != c.n_heads:
+        raise NotImplementedError("GPT-2 layout has no GQA")
+    os.makedirs(out_dir, exist_ok=True)
+    lay = params["layers"]
+    state: Dict[str, np.ndarray] = {
+        "wte.weight": _t(params["tok_embed"]),
+        "wpe.weight": _t(params["pos_embed"]),
+        "ln_f.weight": _t(params["final_norm_w"]),
+        "ln_f.bias": _t(params["final_norm_b"]),
+    }
+    for i in range(c.n_layers):
+        L = f"h.{i}."
+        state.update({
+            L + "ln_1.weight": _t(lay["attn_norm_w"][i]),
+            L + "ln_1.bias": _t(lay["attn_norm_b"][i]),
+            L + "attn.c_attn.weight": np.concatenate(
+                [_t(lay["wq"][i]), _t(lay["wk"][i]), _t(lay["wv"][i])],
+                axis=1),
+            L + "attn.c_attn.bias": np.concatenate(
+                [_t(lay["bq"][i]), _t(lay["bk"][i]), _t(lay["bv"][i])]),
+            L + "attn.c_proj.weight": _t(lay["wo"][i]),
+            L + "attn.c_proj.bias": _t(lay["bo"][i]),
+            L + "ln_2.weight": _t(lay["mlp_norm_w"][i]),
+            L + "ln_2.bias": _t(lay["mlp_norm_b"][i]),
+            L + "mlp.c_fc.weight": _t(lay["w_up"][i]),
+            L + "mlp.c_fc.bias": _t(lay["b_up"][i]),
+            L + "mlp.c_proj.weight": _t(lay["w_down"][i]),
+            L + "mlp.c_proj.bias": _t(lay["b_down"][i]),
+        })
+
+    from safetensors.numpy import save_file
+
+    state = {k: (v.astype(np.float32)
+                 if v.dtype not in (np.float32, np.float16) else v)
+             for k, v in state.items()}
+    save_file(state, os.path.join(out_dir, "model.safetensors"))
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump({
+            "architectures": ["GPT2LMHeadModel"], "model_type": "gpt2",
+            "vocab_size": c.vocab_size, "n_embd": c.d_model,
+            "n_layer": c.n_layers, "n_head": c.n_heads,
+            "n_positions": c.max_seq_len, "n_inner": c.d_ff,
+            "layer_norm_epsilon": c.norm_eps,
+            "activation_function": "gelu_new",
+            "tie_word_embeddings": True, "torch_dtype": "float32",
+        }, f, indent=2)
+    return out_dir

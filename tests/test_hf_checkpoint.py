@@ -509,3 +509,31 @@ def test_export_hf_llama_roundtrip(tmp_path):
     model2, params2 = from_pretrained(out, dtype=jnp.float32)
     back = np.asarray(model2.apply(params2, jnp.asarray(tokens)))
     np.testing.assert_allclose(back, want, rtol=1e-5, atol=1e-5)
+
+
+def test_megatron_to_hf_pipeline(tmp_path):
+    """The full Megatron-LM -> native -> HF GPT-2 conversion pipeline:
+    a Megatron checkpoint ingests, exports to HF format, and transformers
+    produces the ORIGINAL model's logits."""
+    from deepspeed_tpu.checkpoint.export import export_hf_gpt2
+    from deepspeed_tpu.checkpoint.megatron import from_megatron
+
+    torch.manual_seed(0)
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_positions=128)
+    m = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    blob = _gpt2_to_megatron(m, 64, 4, 3.0)
+    d = tmp_path / "meg2" / "mp_rank_00"
+    d.mkdir(parents=True)
+    torch.save(blob, str(d / "model_optim_rng.pt"))
+
+    model, params = from_megatron(str(tmp_path / "meg2"))
+    out = str(tmp_path / "hf_export")
+    export_hf_gpt2(model, params, out)
+    hf2 = transformers.GPT2LMHeadModel.from_pretrained(out).eval()
+
+    tokens = np.random.default_rng(9).integers(1, 250, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+        got = hf2(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
