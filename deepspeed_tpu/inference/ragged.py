@@ -497,6 +497,46 @@ class RaggedInferenceEngine:
         return out
 
     # -- generation convenience -----------------------------------------
+    def stream(self, uid: int, prompt: Sequence[int], *,
+               max_new_tokens: int = 128,
+               eos_token_id: Optional[int] = None,
+               decode_chunk: int = 8):
+        """Incremental generation: yields decoded tokens as chunks
+        complete (the MII/FastGen streaming-response surface). Drives the
+        same put()/decode_steps machinery as generate(); the uid is
+        flushed when the stream ends."""
+        logits = self.put([uid], [list(prompt)])
+        while np.isnan(logits[0]).any():
+            logits = self.put([uid], [[]])
+        tok = int(np.argmax(logits[0])) if self.config.temperature == 0.0             else int(np.asarray(_sample(
+                jnp.asarray(logits), jax.random.fold_in(
+                    self._rng_prefill, self._prefill_round_counter),
+                self.config.temperature, self.config.top_k,
+                self.config.top_p))[0])
+        self._prefill_round_counter += 1
+        produced = 0
+        try:
+            yield tok
+            produced += 1
+            if eos_token_id is not None and tok == eos_token_id:
+                return
+            while produced < max_new_tokens:
+                room = self.config.max_context - self.seqs[uid].seen
+                if room <= 0:
+                    return
+                k = max(1, min(decode_chunk, max_new_tokens - produced, room))
+                chain = self.decode_steps({uid: tok}, k,
+                                          eos_token_id=eos_token_id)[uid]
+                for t in chain:
+                    yield t
+                    produced += 1
+                    if eos_token_id is not None and t == eos_token_id:
+                        return
+                tok = chain[-1]
+        finally:
+            if uid in self.seqs:
+                self.flush([uid])
+
     def generate(self, prompts: Dict[int, Sequence[int]], max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None,
                  decode_chunk: int = 16) -> Dict[int, List[int]]:

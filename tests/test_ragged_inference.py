@@ -467,3 +467,30 @@ def test_decode_steps_eos_freeze_keeps_context_clean():
     eng_b.put([1], [prompt + fed])
     cont_b = eng_b.put([1], [[97]])
     np.testing.assert_allclose(cont_a[0], cont_b[0], rtol=1e-4, atol=1e-4)
+
+
+def test_stream_matches_generate():
+    """stream() yields the same tokens generate() returns, incrementally,
+    and flushes its uid at stream end (incl. early break)."""
+    model = Llama("tiny", n_layers=2, d_model=64, n_heads=4, n_kv_heads=2,
+                  vocab_size=256, max_seq_len=128, use_flash=False,
+                  remat=False)
+    params = model.init(jax.random.PRNGKey(0))
+    kw = dict(token_budget=64, max_seqs=4, kv_block_size=16,
+              n_kv_blocks=64, max_context=128)
+    prompt = np.random.default_rng(31).integers(1, 256, (10,)).tolist()
+
+    eng = RaggedInferenceEngine(model, RaggedConfig(**kw), params=params)
+    want = eng.generate({7: prompt}, max_new_tokens=12)[7]
+
+    eng2 = RaggedInferenceEngine(model, RaggedConfig(**kw), params=params)
+    got = list(eng2.stream(7, prompt, max_new_tokens=12))
+    assert got == want
+    assert 7 not in eng2.seqs                     # flushed at stream end
+
+    # early consumer break still releases the uid's slot + blocks
+    eng3 = RaggedInferenceEngine(model, RaggedConfig(**kw), params=params)
+    it = eng3.stream(8, prompt, max_new_tokens=12)
+    next(it)
+    it.close()
+    assert 8 not in eng3.seqs
