@@ -45,6 +45,25 @@ def export_hf_llama(model, params: Dict[str, Any], out_dir: str,
             "export_hf_llama handles the llama layout (rms + silu_glu + "
             f"rope); got norm={c.norm} activation={c.activation} "
             f"position={c.position}")
+    # bias layouts must match what the TARGET class constructs, or
+    # from_pretrained leaves unmatched bias params randomly initialized
+    # (silently wrong logits): Llama/Mistral attention_bias covers all
+    # four projections; Qwen2 has qkv-only biases.
+    o_bias = bool(getattr(c, "attn_o_bias", False))
+    if model_type in ("llama", "mistral", "internlm"):
+        if bool(c.qkv_bias) != o_bias:
+            raise NotImplementedError(
+                f"{model_type} export needs qkv_bias == attn_o_bias "
+                f"(attention_bias covers all four projections); got "
+                f"qkv_bias={c.qkv_bias} attn_o_bias={o_bias} — export as "
+                "model_type='qwen2' for qkv-only biases")
+    elif model_type == "qwen2":
+        if not c.qkv_bias or o_bias:
+            raise NotImplementedError(
+                "qwen2 export is the qkv-only-bias layout; got "
+                f"qkv_bias={c.qkv_bias} attn_o_bias={o_bias}")
+    else:
+        raise ValueError(f"unknown export model_type '{model_type}'")
     os.makedirs(out_dir, exist_ok=True)
     lay = params["layers"]
     state: Dict[str, np.ndarray] = {
@@ -82,8 +101,11 @@ def export_hf_llama(model, params: Dict[str, Any], out_dir: str,
              for k, v in state.items()}
     save_file(state, os.path.join(out_dir, "model.safetensors"))
 
+    arch = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
+            "qwen2": "Qwen2ForCausalLM",
+            "internlm": "InternLMForCausalLM"}[model_type]
     hf_config = {
-        "architectures": ["LlamaForCausalLM"],
+        "architectures": [arch],
         "model_type": model_type,
         "vocab_size": c.vocab_size,
         "hidden_size": c.d_model,
@@ -95,10 +117,11 @@ def export_hf_llama(model, params: Dict[str, Any], out_dir: str,
         "rms_norm_eps": c.norm_eps,
         "rope_theta": c.rope_theta,
         "tie_word_embeddings": bool(c.tie_embeddings),
-        "attention_bias": bool(c.qkv_bias),
         "hidden_act": "silu",
         "torch_dtype": "float32",
     }
+    if model_type in ("llama", "mistral", "internlm"):
+        hf_config["attention_bias"] = bool(c.qkv_bias)
     if getattr(c, "attn_windows", None):
         w = c.attn_windows[0]
         if w and all(x == w for x in c.attn_windows):
@@ -121,6 +144,12 @@ def export_hf_gpt2(model, params: Dict[str, Any], out_dir: str) -> str:
             f"use_bias={c.use_bias}")
     if c.n_kv_heads != c.n_heads:
         raise NotImplementedError("GPT-2 layout has no GQA")
+    if not c.tie_embeddings:
+        # GPT2LMHeadModel always ties wte to the head — exporting an
+        # untied model would silently drop lm_head
+        raise NotImplementedError(
+            "GPT-2 export requires tie_embeddings=True (GPT2LMHeadModel "
+            "ties the head to wte)")
     os.makedirs(out_dir, exist_ok=True)
     lay = params["layers"]
     state: Dict[str, np.ndarray] = {

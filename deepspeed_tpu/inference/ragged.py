@@ -497,6 +497,21 @@ class RaggedInferenceEngine:
         return out
 
     # -- generation convenience -----------------------------------------
+    def _sample_first(self, rows) -> List[int]:
+        """First decode token(s) from resolved prefill logits rows —
+        greedy on host, else one sampled draw per prefill round (the
+        round counter advances ONLY when sampling, so greedy calls never
+        shift the seeded streams of later sampled calls)."""
+        if self.config.temperature == 0.0:
+            return [int(np.argmax(r)) for r in rows]
+        key = jax.random.fold_in(self._rng_prefill,
+                                 self._prefill_round_counter)
+        self._prefill_round_counter += 1
+        toks = np.asarray(_sample(jnp.asarray(np.stack(rows)), key,
+                                  self.config.temperature,
+                                  self.config.top_k, self.config.top_p))
+        return [int(t) for t in toks]
+
     def stream(self, uid: int, prompt: Sequence[int], *,
                max_new_tokens: int = 128,
                eos_token_id: Optional[int] = None,
@@ -504,18 +519,14 @@ class RaggedInferenceEngine:
         """Incremental generation: yields decoded tokens as chunks
         complete (the MII/FastGen streaming-response surface). Drives the
         same put()/decode_steps machinery as generate(); the uid is
-        flushed when the stream ends."""
+        flushed when the stream ends — including early consumer breaks
+        and mid-prefill failures (no slot/block leak)."""
         logits = self.put([uid], [list(prompt)])
-        while np.isnan(logits[0]).any():
-            logits = self.put([uid], [[]])
-        tok = int(np.argmax(logits[0])) if self.config.temperature == 0.0             else int(np.asarray(_sample(
-                jnp.asarray(logits), jax.random.fold_in(
-                    self._rng_prefill, self._prefill_round_counter),
-                self.config.temperature, self.config.top_k,
-                self.config.top_p))[0])
-        self._prefill_round_counter += 1
-        produced = 0
         try:
+            while np.isnan(logits[0]).any():
+                logits = self.put([uid], [[]])
+            tok = self._sample_first([logits[0]])[0]
+            produced = 0
             yield tok
             produced += 1
             if eos_token_id is not None and tok == eos_token_id:
@@ -559,19 +570,9 @@ class RaggedInferenceEngine:
                 else:
                     resolved.append((u, row))
             if resolved:
-                if self.config.temperature == 0.0:  # greedy: stay on host
-                    for u, row in resolved:
-                        first[u] = int(np.argmax(row))
-                else:
-                    rows = jnp.asarray(np.stack([r for _, r in resolved]))
-                    key = jax.random.fold_in(self._rng_prefill,
-                                             self._prefill_round_counter)
-                    self._prefill_round_counter += 1
-                    toks_out = np.asarray(_sample(
-                        rows, key, self.config.temperature,
-                        self.config.top_k, self.config.top_p))
-                    for (u, _), t in zip(resolved, toks_out):
-                        first[u] = int(t)
+                toks_out = self._sample_first([r for _, r in resolved])
+                for (u, _), t in zip(resolved, toks_out):
+                    first[u] = t
             if not pending:
                 break
             uids = pending

@@ -16,8 +16,10 @@ eng = RaggedInferenceEngine(
     RaggedConfig(token_budget=2048, max_seqs=64, kv_block_size=16,
                  n_kv_blocks=8192, max_context=model.config.max_seq_len,
                  temperature=0.7, top_p=0.95),
-    params=params)
-    # topology=Topology.build_virtual({"model": 8})  # TP serving
+    params=params,
+    # TP serving: from deepspeed_tpu.parallel.mesh import Topology, then
+    # topology=Topology.build_virtual({"model": 8}),
+)
 
 prompts = {0: [1, 15043, 29871], 1: [1, 1724, 338, 278]}
 out = eng.generate(prompts, max_new_tokens=64, eos_token_id=2)

@@ -60,7 +60,8 @@ def _run_child():
 
     n = 64
     assert len(jax.devices()) >= n, len(jax.devices())
-    report = {"target": "Llama-2-7B ZeRO-3 bf16 on v5p-64 (BASELINE config 4)",
+    report = {"target": "Llama-2 7B (BASELINE config 4) + 70B scale probe, "
+                        "ZeRO-3 bf16 on v5p-64",
               "chip": {"name": "v5p", "hbm_bytes": V5P_HBM,
                        "peak_bf16_flops": V5P_PEAK, "hbm_gbps": V5P_BW / 1e9},
               "n_devices": n, "configs": []}
@@ -190,10 +191,11 @@ def _run_child():
 
     ok = [c for c in report["configs"] if c.get("feasible")]
     report["feasible_count"] = len(ok)
+    models_ok = sorted({c.get("model", "7b") for c in ok})
     report["verdict"] = (
-        "FITS: ZeRO-3 Llama-2-7B compiles and fits v5p-64 HBM with "
-        "headroom; pred_mfu is a roofline CEILING (compute vs HBM-bytes "
-        "only — collective latency not modeled), not a measurement"
+        f"FITS: ZeRO-3 Llama-2 {'/'.join(models_ok)} compiles and fits "
+        "v5p-64 HBM with headroom; pred_mfu is a roofline CEILING "
+        "(compute + modeled ICI traffic only — not a measurement)"
         if ok else "DOES NOT FIT")
     with open(os.path.join(HERE, "NORTHSTAR_r04.json"), "w") as f:
         json.dump(report, f, indent=1)
