@@ -14,6 +14,10 @@ while true; do
   sleep 600
 done
 
+echo "== compiled-kernel pytest lane (incl. banded paged + quant) =="
+DST_TPU_TESTS=1 timeout 2400 python -m pytest tests/test_tpu_kernels.py -q | tee /tmp/kernel_lane.out || true
+grep -E "passed|failed" /tmp/kernel_lane.out | tail -1 > /tmp/lane_result.txt || true
+
 echo "== kernel numerics + perf (TPU_KERNEL_CHECK) =="
 timeout 2400 python scripts/tpu_flash_check.py | tee /tmp/flash_check.out || true
 grep '^{' /tmp/flash_check.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp TPU_KERNEL_CHECK_r04.json || echo "[roundup] TPU_KERNEL_CHECK_r04.json NOT refreshed (stage produced no JSON)"
