@@ -66,7 +66,27 @@ def _child_main():
     # costs ~16 ms/step at bs8 post-async-dispatch-fixes (MFU_SWEEP_r04:
     # 695.7 vs 711.6 ms) — off by default; the sweep still A/Bs it
     ce_chunk = int(os.environ.get("DST_BENCH_CE_CHUNK", "0"))
-    if on_tpu:
+    # DST_BENCH_MODEL=1b: the bigger single-chip MFU point. Arithmetic
+    # intensity rises with width (d=2048 vs 1024), so this bounds how much
+    # of the 350M-model MFU gap is model-size artifact vs kernel limit.
+    # ~850M params -> ~11.9 GB optimizer+master state on chip; full remat
+    # + chunked CE to keep activations/logits inside the remaining HBM.
+    model_tag = os.environ.get("DST_BENCH_MODEL", "350m")
+    if model_tag not in ("350m", "1b"):
+        raise ValueError(f"unknown DST_BENCH_MODEL '{model_tag}' "
+                         "(have: 350m, 1b)")
+    if on_tpu and model_tag == "1b":
+        remat_env = os.environ.get("DST_BENCH_REMAT", "full")
+        remat = remat_env != "none"
+        ce_chunk = int(os.environ.get("DST_BENCH_CE_CHUNK", "2048"))
+        model = Llama("1b", d_model=2048, n_layers=14, n_heads=16,
+                      n_kv_heads=16, d_ff=5632, vocab_size=32000,
+                      max_seq_len=2048, remat=remat,
+                      remat_policy=remat_env if remat else "full",
+                      use_flash=use_flash, loss_chunk_size=ce_chunk)
+        batch_size = int(os.environ.get("DST_BENCH_BS", "4"))
+        seq_len, steps, warmup = 2048, 10, 2
+    elif on_tpu:
         model = Llama("tiny", d_model=1024, n_layers=24, n_heads=16, n_kv_heads=16,
                       d_ff=2816, vocab_size=32000, max_seq_len=2048, remat=remat,
                       remat_policy=remat_env if remat else "full",
@@ -153,7 +173,7 @@ def _child_main():
     # CPU fallback rows get a distinct metric name so a consumer reading
     # metric+value alone is never misled into comparing smoke-model CPU
     # numbers against the TPU headline.
-    metric = ("llama_350m_train_tokens_per_sec_per_chip" if on_tpu
+    metric = (f"llama_{model_tag}_train_tokens_per_sec_per_chip" if on_tpu
               else "cpu_fallback_smoke_tokens_per_sec")
     print(json.dumps({
         "metric": metric,

@@ -20,7 +20,7 @@ from typing import Any, Dict
 
 import numpy as np
 
-__all__ = ["export_hf_llama"]
+__all__ = ["export_hf_llama", "export_hf_gpt2"]
 
 
 def _t(x) -> np.ndarray:
@@ -122,6 +122,10 @@ def export_hf_llama(model, params: Dict[str, Any], out_dir: str,
     }
     if model_type in ("llama", "mistral", "internlm"):
         hf_config["attention_bias"] = bool(c.qkv_bias)
+    if model_type == "internlm":
+        # InternLM's remote-code config reads the 'bias' key (default
+        # True) — the same key hf.py ingestion reads (hc.get('bias', ...))
+        hf_config["bias"] = bool(c.qkv_bias)
     if getattr(c, "attn_windows", None):
         w = c.attn_windows[0]
         if w and all(x == w for x in c.attn_windows):

@@ -32,6 +32,11 @@ class DDIMSchedule:
     beta_start: float = 0.00085
     beta_end: float = 0.012
     num_inference_steps: int = 50
+    # diffusers' SD defaults: timesteps start at 1, and the final step's
+    # alpha_prev is alphas_cumprod[0] rather than 1.0 (set_alpha_to_one
+    # is False in the SD scheduler config)
+    steps_offset: int = 1
+    set_alpha_to_one: bool = False
 
     def __post_init__(self):
         # scaled-linear: betas are squares of a linear sqrt-space ramp
@@ -39,16 +44,21 @@ class DDIMSchedule:
                             self.num_train_timesteps, dtype=np.float64) ** 2
         self.alphas_cumprod = np.cumprod(1.0 - betas)
         step = self.num_train_timesteps // self.num_inference_steps
-        # diffusers "leading" spacing: t = i*step for i in reversed(range(n))
-        self.timesteps = np.arange(0, self.num_inference_steps)[::-1] * step
+        # diffusers "leading" spacing: t = i*step + offset, descending
+        self.timesteps = np.clip(
+            np.arange(0, self.num_inference_steps)[::-1] * step
+            + self.steps_offset, 0, self.num_train_timesteps - 1)
 
     def arrays(self):
         ts = jnp.asarray(self.timesteps, jnp.int32)
         acp = jnp.asarray(self.alphas_cumprod, jnp.float32)
         step = self.num_train_timesteps // self.num_inference_steps
-        prev = jnp.clip(ts - step, min=-1)
+        prev = ts - step
+        final_alpha = 1.0 if self.set_alpha_to_one else float(
+            self.alphas_cumprod[0])
         alpha_t = acp[ts]
-        alpha_prev = jnp.where(prev >= 0, acp[jnp.maximum(prev, 0)], 1.0)
+        alpha_prev = jnp.where(prev >= 0, acp[jnp.maximum(prev, 0)],
+                               final_alpha)
         return ts, alpha_t, alpha_prev
 
 

@@ -704,7 +704,9 @@ class RaggedInferenceEngine:
                                                      window=windows[li])
                 attn = attn.astype(x.dtype)
                 attn = attn.reshape(-1, c.n_heads * c.head_dim) @ lp["wo"]
-                if c.use_bias:
+                # attn_o_bias, not use_bias: InternLM has use_bias=False
+                # with a real o_proj bias (models/transformer.py:500)
+                if c.attn_o_bias:
                     attn = attn + lp["bo"]
                 x = x + attn
                 h = norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))

@@ -140,23 +140,37 @@ class RepeatingLoader:
             return next(self._it)
 
 
-def prefetch(iterator: Iterable, size: int = 2) -> Iterator[Any]:
-    """Device-prefetching wrapper: keeps ``size`` batches in flight so host
-    collate/placement of batch N+1 overlaps device compute on batch N
-    (the TPU analog of the reference loaders' pin_memory + non_blocking
-    copies; flax.jax_utils.prefetch_to_device pattern). ``jax.device_put``
-    is async — the queue holds device arrays whose uploads are already
-    enqueued, so the training loop never waits on host->device transfer
-    of the current batch."""
+def prefetch(iterator: Iterable, size: int = 2,
+             sharding=None) -> Iterator[Any]:
+    """Prefetching wrapper: keeps ``size`` batches in flight so batch N+1
+    preparation overlaps device compute on batch N (the TPU analog of the
+    reference loaders' pin_memory + non_blocking copies;
+    flax.jax_utils.prefetch_to_device pattern).
+
+    With ``sharding`` given, each queued batch is tree-mapped through
+    ``jax.device_put`` at enqueue time — device_put is async, so the queue
+    holds device arrays whose uploads are already enqueued and the
+    training loop never waits on host->device transfer. Without it, only
+    host-side iterator work (collate/tokenize) is overlapped; pass the
+    batch sharding (or use runtime.dataloader.shard_batch downstream) to
+    get the transfer overlap too."""
     import collections
 
     queue: collections.deque = collections.deque()
     it = iter(iterator)
 
+    def _place(item):
+        if sharding is None:
+            return item
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sharding), item)
+
     def enqueue(n):
         for _ in range(n):
             try:
-                queue.append(next(it))
+                queue.append(_place(next(it)))
             except StopIteration:
                 return
 

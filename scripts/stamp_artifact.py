@@ -1,0 +1,33 @@
+"""Stamp a raw JSON payload with a provenance block and write it to its
+committed artifact path.  Used by the watcher for stages that emit a JSON
+line on stdout (bench.py, tpu_flash_check.py, tpu_decode_bench.py) rather
+than writing their own artifact.
+
+Usage: python scripts/stamp_artifact.py OUT.json RAW.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _artifact import write_artifact  # noqa: E402
+
+
+def main():
+    out, raw = sys.argv[1], sys.argv[2]
+    with open(raw) as f:
+        data = json.load(f)
+    device = None
+    if isinstance(data, dict):
+        device = (data.get("device")
+                  or (data.get("extra") or {}).get("platform"))
+    write_artifact("", data, device=device, path=out)
+    print(f"[stamp] wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,7 +3,8 @@
 The kernel defaults to 1024x1024 tiles; VMEM pressure vs pipeline depth
 is shape-dependent, so A/B the bench across block_q x block_k via the
 DST_FLASH_BLOCK_Q/K env knobs (ops/attention.py). One bench child per
-config (serial chip claims). Writes FLASH_BLOCK_SWEEP_r04.json.
+config (serial chip claims). Writes FLASH_BLOCK_SWEEP_<round>.json
+(round tag via DST_ROUND, default r05).
 
 Usage: python scripts/tpu_flash_block_sweep.py
 """
@@ -59,8 +60,12 @@ def main():
         results.append(entry)
         mfu = ((entry["result"] or {}).get("extra") or {}).get("mfu")
         print(f"[block-sweep] {entry['config']} -> mfu={mfu}", flush=True)
-    with open(os.path.join(HERE, "FLASH_BLOCK_SWEEP_r04.json"), "w") as f:
-        json.dump(results, f, indent=1)
+    sys.path.insert(0, os.path.join(HERE, "scripts"))
+    from _artifact import write_artifact
+
+    device = next((r["result"]["extra"]["platform"] for r in results
+                   if r["result"]), None)
+    write_artifact("FLASH_BLOCK_SWEEP", results, device=device)
     best = max((r for r in results if r["result"]),
                key=lambda r: r["result"]["extra"].get("mfu", 0), default=None)
     if best:

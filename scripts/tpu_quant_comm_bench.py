@@ -19,7 +19,8 @@ reference positions them the same way (hpZ keeps gathers inside the
 node; qwZ/qgZ earn their keep across slower links,
 blogs/zeropp/README.md).
 
-Writes QUANT_COMM_r04.json. Usage: python scripts/tpu_quant_comm_bench.py
+Writes QUANT_COMM_<round>.json (round tag via DST_ROUND, default r05).
+Usage: python scripts/tpu_quant_comm_bench.py
 """
 
 from __future__ import annotations
@@ -125,8 +126,10 @@ def main():
         "int8 collectives pay off below the break-even link bandwidth; "
         "rows where wins_on_ici_400gbps is false are DCN/cross-host "
         "features (the reference's qwZ/qgZ positioning), not v5e-ICI wins")
-    with open(os.path.join(HERE, "QUANT_COMM_r04.json"), "w") as f:
-        json.dump(report, f, indent=1)
+    sys.path.insert(0, os.path.join(HERE, "scripts"))
+    from _artifact import write_artifact
+
+    write_artifact("QUANT_COMM", report, device=report.get("device"))
     print(json.dumps({"rows": len(report["rows"])}))
     return 0
 

@@ -18,7 +18,8 @@ This driver reproduces that methodology on TPU:
 * A/B: the Pallas paged-attention path vs DST_RAGGED_FORCE_GATHER=1 in a
   child process (one chip claim per run through the axon relay).
 
-Writes SERVE_BENCH_r04.json. Usage: python scripts/tpu_serve_bench.py
+Writes SERVE_BENCH_<round>.json (round tag via DST_ROUND, default r05).
+Usage: python scripts/tpu_serve_bench.py
 """
 
 from __future__ import annotations
@@ -194,9 +195,12 @@ def _run_child():
         if not rows[-1]["meets_sla"] and rows[-1]["p95_token_ms"] > 4 * SLA_MS:
             break                     # far past saturation; stop the sweep
     best = max((r["achieved_qps"] for r in rows if r["meets_sla"]), default=0.0)
+    import jax
+
     print(json.dumps({
         "mode": os.environ.get("DST_RAGGED_FORCE_GATHER") == "1"
         and "gather" or "pallas",
+        "device": jax.devices()[0].device_kind,
         "sla_ms": SLA_MS, "out_tokens": OUT_TOKENS,
         "prompt_pool": PROMPT_POOL, "params": model.config.param_count(),
         "qps_at_sla": best, "curve": rows}), flush=True)
@@ -229,8 +233,12 @@ def main():
         g = (report.get("gather") or {}).get("qps_at_sla") or 0
         if g:
             report["pallas_vs_gather"] = round(report["value"] / g, 2)
-    with open(os.path.join(HERE, "SERVE_BENCH_r04.json"), "w") as f:
-        json.dump(report, f, indent=1)
+    sys.path.insert(0, os.path.join(HERE, "scripts"))
+    from _artifact import write_artifact
+
+    device = next((r.get("device") for r in report.values()
+                   if isinstance(r, dict) and r.get("device")), None)
+    write_artifact("SERVE_BENCH", report, device=device)
     print(json.dumps({k: report.get(k) for k in
                       ("metric", "value", "pallas_vs_gather")}))
     return 0

@@ -12,7 +12,8 @@ them:
 
 plus the flash-attention share measured directly at the bench shape
 (fwd and fwd+bwd), and an optional block-size sweep via
-DST_FLASH_BLOCK_Q/K. Writes STEP_BREAKDOWN_r04.json.
+DST_FLASH_BLOCK_Q/K. Writes STEP_BREAKDOWN_<round>.json (round tag via
+DST_ROUND, default r05).
 
 Usage: python scripts/tpu_step_breakdown.py     (claims the chip)
 """
@@ -171,8 +172,10 @@ def main():
         "optimizer_ms": round(report["engine_step_ms"] - report["fwd_bwd_ms"], 2),
     }
     print(json.dumps(report), flush=True)
-    with open(os.path.join(HERE, "STEP_BREAKDOWN_r04.json"), "w") as f:
-        json.dump(report, f, indent=1)
+    sys.path.insert(0, os.path.join(HERE, "scripts"))
+    from _artifact import write_artifact
+
+    write_artifact("STEP_BREAKDOWN", report, device=report["device"])
     return 0
 
 

@@ -1,45 +1,205 @@
 #!/usr/bin/env bash
-# Poll for the axon TPU tunnel to return, then run the remaining r04
-# evidence stages (kernel check, decode bench, serve bench, quant-comm).
-# Probe is a short-lived child; stages run serially (one chip claim).
+# r05 evidence watcher.  Poll for the axon TPU tunnel; when it is up, run
+# every staged instrument in priority order and `git commit` each artifact
+# THE MOMENT it lands — a tunnel that dies mid-pass must not cost committed
+# evidence (r04 lost three headline deliverables this way).  Stages are
+# idempotent: an artifact that already exists is skipped on later passes,
+# so a second window finishes what the first one started.
+#
+# Usage: nohup bash scripts/tpu_wait_and_finish.sh &   (or run_in_background)
+# Force a rerun of everything: DST_WATCH_FORCE=1 bash scripts/tpu_wait_and_finish.sh
 set -u
 cd "$(dirname "$0")/.."
+R=${DST_ROUND:-r05}
+LOG=scripts/watcher_${R}.log
+FORCE=${DST_WATCH_FORCE:-0}
 
-while true; do
-  if timeout 180 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
-    echo "[wait] TPU back at $(date -u +%H:%M:%S)"
-    break
+log() { echo "[watch $(date -u +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+probe() {
+  timeout 180 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null
+}
+
+# commit exactly the named artifact files (never -A: the builder session
+# works the same tree; staging its WIP would be wrong)
+commit_paths() {  # $1 = message, rest = paths
+  local msg="$1"; shift
+  local have=0
+  for p in "$@"; do [ -e "$p" ] && { git add "$p" 2>>"$LOG" && have=1; }; done
+  [ "$have" = 1 ] || return 0
+  for i in 1 2 3; do
+    # pathspec-limited commit: NEVER sweep builder-staged WIP into an
+    # evidence commit
+    if git commit -q -m "$msg" -- "$@" 2>>"$LOG"; then log "committed: $msg"; return 0; fi
+    sleep 7   # index.lock contention with the builder session
+  done
+  log "commit FAILED after retries: $msg"
+}
+
+need() { [ "$FORCE" = 1 ] || [ ! -e "$1" ]; }
+
+json_tail() {  # last '{'-line of $1 -> $2 ; rc 1 if none
+  grep '^{' "$1" | tail -1 > "$2" && [ -s "$2" ]
+}
+
+stage_bench() {  # headline bench at best-known config, incl. compiled-loop leg
+  need "BENCH_${R}_local.json" || return 0
+  log "stage: headline bench"
+  DST_BENCH_FLASH=1 DST_BENCH_REMAT=selective DST_BENCH_CE_CHUNK=0 \
+    timeout 2400 python bench.py > /tmp/bench_${R}.out 2>>"$LOG"
+  if json_tail /tmp/bench_${R}.out /tmp/bench_${R}.json \
+     && grep -q '"platform": "TPU' /tmp/bench_${R}.json; then
+    python scripts/stamp_artifact.py "BENCH_${R}_local.json" /tmp/bench_${R}.json >>"$LOG" 2>&1
+    commit_paths "TPU evidence: headline bench (${R})" "BENCH_${R}_local.json"
+  else
+    log "headline bench produced no TPU JSON (tunnel died?)"
+    return 1
   fi
-  echo "[wait] tunnel still down at $(date -u +%H:%M:%S); retry in 10 min"
-  sleep 600
+}
+
+stage_breakdown() {
+  need "STEP_BREAKDOWN_${R}.json" || return 0
+  log "stage: step-time breakdown"
+  timeout 2400 python scripts/tpu_step_breakdown.py >>"$LOG" 2>&1 \
+    && commit_paths "TPU evidence: step-time breakdown (${R})" "STEP_BREAKDOWN_${R}.json" \
+    || { log "step breakdown failed"; return 1; }
+}
+
+sweep_complete() {  # the sweep artifact is incremental: exists != finished
+  # runs while the tunnel may be DOWN: unset the axon claim so interpreter
+  # startup can't hang (this is pure JSON parsing, no jax)
+  [ -e "MFU_SWEEP_${R}.json" ] && \
+    timeout 60 env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
+    JAX_PLATFORMS=cpu python - <<EOF 2>/dev/null
+import json, sys
+sys.exit(0 if json.load(open("MFU_SWEEP_${R}.json")).get("complete") else 1)
+EOF
+}
+
+stage_sweep() {   # incremental writes: commit whatever landed even on timeout
+  if [ "$FORCE" != 1 ] && sweep_complete; then return 0; fi
+  log "stage: MFU sweep (staged legs + 1b model)"
+  timeout 7200 python scripts/tpu_mfu_sweep.py >>"$LOG" 2>&1
+  rc=$?
+  [ -e "MFU_SWEEP_${R}.json" ] \
+    && commit_paths "TPU evidence: MFU sweep (${R})" "MFU_SWEEP_${R}.json"
+  [ "$rc" = 0 ] || { log "mfu sweep rc=$rc"; return 1; }
+}
+
+stage_serve() {
+  need "SERVE_BENCH_${R}.json" || return 0
+  log "stage: SLA serving bench"
+  timeout 3600 python scripts/tpu_serve_bench.py >>"$LOG" 2>&1
+  [ -e "SERVE_BENCH_${R}.json" ] \
+    && commit_paths "TPU evidence: SLA serving bench (${R})" "SERVE_BENCH_${R}.json" \
+    || { log "serve bench produced no artifact"; return 1; }
+}
+
+stage_quant() {
+  need "QUANT_COMM_${R}.json" || return 0
+  log "stage: quant-comm microbench"
+  timeout 2400 python scripts/tpu_quant_comm_bench.py >>"$LOG" 2>&1
+  [ -e "QUANT_COMM_${R}.json" ] \
+    && commit_paths "TPU evidence: quant-comm microbench (${R})" "QUANT_COMM_${R}.json" \
+    || { log "quant-comm produced no artifact"; return 1; }
+}
+
+stage_kernel_lane() {
+  need "TPU_KERNEL_LANE_${R}.json" || return 0
+  log "stage: compiled-kernel pytest lane"
+  DST_TPU_TESTS=1 timeout 3000 python -m pytest tests/test_tpu_kernels.py -q \
+    > /tmp/kernel_lane_${R}.out 2>&1
+  tail -3 /tmp/kernel_lane_${R}.out | tee -a "$LOG"
+  python - "$R" <<'EOF' >>"$LOG" 2>&1
+import json, re, sys
+sys.path.insert(0, "scripts")
+from _artifact import provenance, write_artifact
+R = sys.argv[1]
+raw = open(f"/tmp/kernel_lane_{R}.out").read().splitlines()
+tail = raw[-6:]
+summary = next((l for l in reversed(raw) if re.search(r"\d+ (passed|failed)", l)), "")
+if "passed" in summary and "failed" not in summary:
+    write_artifact("TPU_KERNEL_LANE", {
+        "what": "on-chip compiled Pallas kernel lane "
+                "(DST_TPU_TESTS=1 pytest tests/test_tpu_kernels.py)",
+        "result": summary.strip(), "raw_tail": tail})
+else:
+    print(f"[watch] kernel lane not green: {summary!r}; artifact withheld")
+EOF
+  [ -e "TPU_KERNEL_LANE_${R}.json" ] \
+    && commit_paths "TPU evidence: compiled kernel lane (${R})" "TPU_KERNEL_LANE_${R}.json" \
+    || return 1
+}
+
+stage_flash_check() {
+  need "TPU_KERNEL_CHECK_${R}.json" || return 0
+  log "stage: kernel numerics+perf check"
+  timeout 2400 python scripts/tpu_flash_check.py > /tmp/flash_check_${R}.out 2>>"$LOG"
+  if json_tail /tmp/flash_check_${R}.out /tmp/flash_check_${R}.json; then
+    python scripts/stamp_artifact.py "TPU_KERNEL_CHECK_${R}.json" /tmp/flash_check_${R}.json >>"$LOG" 2>&1
+    commit_paths "TPU evidence: kernel check (${R})" "TPU_KERNEL_CHECK_${R}.json"
+  else
+    log "flash check produced no JSON"; return 1
+  fi
+}
+
+stage_decode() {
+  need "TPU_DECODE_BENCH_${R}.json" || return 0
+  log "stage: ragged decode bench"
+  timeout 2400 python scripts/tpu_decode_bench.py > /tmp/decode_${R}.out 2>>"$LOG"
+  if json_tail /tmp/decode_${R}.out /tmp/decode_${R}.json; then
+    python scripts/stamp_artifact.py "TPU_DECODE_BENCH_${R}.json" /tmp/decode_${R}.json >>"$LOG" 2>&1
+    commit_paths "TPU evidence: ragged decode bench (${R})" "TPU_DECODE_BENCH_${R}.json"
+  else
+    log "decode bench produced no JSON"; return 1
+  fi
+}
+
+stage_block_sweep() {
+  need "FLASH_BLOCK_SWEEP_${R}.json" || return 0
+  log "stage: flash block-shape sweep"
+  timeout 3600 python scripts/tpu_flash_block_sweep.py >>"$LOG" 2>&1
+  [ -e "FLASH_BLOCK_SWEEP_${R}.json" ] \
+    && commit_paths "TPU evidence: flash block sweep (${R})" "FLASH_BLOCK_SWEEP_${R}.json" \
+    || { log "block sweep produced no artifact"; return 1; }
+}
+
+all_done() {
+  for f in "BENCH_${R}_local.json" "STEP_BREAKDOWN_${R}.json" \
+           "SERVE_BENCH_${R}.json" \
+           "QUANT_COMM_${R}.json" "TPU_KERNEL_LANE_${R}.json" \
+           "TPU_KERNEL_CHECK_${R}.json" "TPU_DECODE_BENCH_${R}.json" \
+           "FLASH_BLOCK_SWEEP_${R}.json"; do
+    [ -e "$f" ] || return 1
+  done
+  sweep_complete
+}
+
+log "watcher started (round ${R}, force=${FORCE}, pid $$)"
+while true; do
+  if all_done && [ "$FORCE" != 1 ]; then
+    log "all ${R} artifacts present; watcher exiting"
+    commit_paths "Watcher log: all ${R} TPU evidence collected" "$LOG"
+    exit 0
+  fi
+  if probe; then
+    log "TPU tunnel is UP — starting evidence pass"
+    # priority order: the MFU bar first (headline + attribution + sweep),
+    # then the never-measured r04 instruments, then refreshes
+    stage_bench
+    stage_breakdown
+    stage_sweep
+    stage_serve
+    stage_quant
+    stage_kernel_lane
+    stage_flash_check
+    stage_decode
+    stage_block_sweep
+    FORCE=0   # one forced pass max; later passes only fill holes
+    commit_paths "Watcher log after evidence pass (${R})" "$LOG"
+    all_done || sleep 60   # tunnel may still be up; retry holes soon
+  else
+    log "tunnel down; retry in 10 min"
+    sleep 600
+  fi
 done
-
-echo "== compiled-kernel pytest lane (incl. banded paged + quant) =="
-DST_TPU_TESTS=1 timeout 2400 python -m pytest tests/test_tpu_kernels.py -q | tee /tmp/kernel_lane.out || true
-grep -E "passed|failed" /tmp/kernel_lane.out | tail -1 > /tmp/lane_result.txt || true
-
-echo "== kernel numerics + perf (TPU_KERNEL_CHECK) =="
-timeout 2400 python scripts/tpu_flash_check.py | tee /tmp/flash_check.out || true
-grep '^{' /tmp/flash_check.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp TPU_KERNEL_CHECK_r04.json || echo "[roundup] TPU_KERNEL_CHECK_r04.json NOT refreshed (stage produced no JSON)"
-
-echo "== ragged decode benchmark (TPU_DECODE_BENCH) =="
-timeout 2400 python scripts/tpu_decode_bench.py | tee /tmp/decode_bench.out || true
-grep '^{' /tmp/decode_bench.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp TPU_DECODE_BENCH_r04.json || echo "[roundup] TPU_DECODE_BENCH_r04.json NOT refreshed (stage produced no JSON)"
-
-echo "== SLA serving benchmark (SERVE_BENCH) =="
-timeout 2400 python scripts/tpu_serve_bench.py || true
-
-echo "== quantized-collective pack-cost microbench (QUANT_COMM) =="
-timeout 2400 python scripts/tpu_quant_comm_bench.py || true
-
-echo "== step-time breakdown (STEP_BREAKDOWN) =="
-timeout 2400 python scripts/tpu_step_breakdown.py || true
-
-echo "== refreshed MFU sweep (new configs) =="
-timeout 2400 python scripts/tpu_mfu_sweep.py || true
-
-echo "== headline bench =="
-timeout 2400 python bench.py | tee /tmp/bench.out || true
-grep '^{' /tmp/bench.out | tail -1 > /tmp/artifact.tmp && [ -s /tmp/artifact.tmp ] && mv /tmp/artifact.tmp BENCH_r04_local.json || echo "[roundup] BENCH_r04_local.json NOT refreshed"
-
-echo "[wait] all stages done"

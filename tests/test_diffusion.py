@@ -128,7 +128,10 @@ def test_ddim_step_math():
     at = sched.alphas_cumprod[sched.timesteps[0]]
     eps = 0.25
     pred_x0 = (x0 - np.sqrt(1 - at) * eps) / np.sqrt(at)
-    want = pred_x0  # alpha_prev = 1 at the final step
+    # diffusers SD default set_alpha_to_one=False: the final step's
+    # alpha_prev is alphas_cumprod[0], not 1.0
+    ap = sched.alphas_cumprod[0]
+    want = np.sqrt(ap) * pred_x0 + np.sqrt(1 - ap) * eps
     np.testing.assert_allclose(np.asarray(lat), want, rtol=1e-5, atol=1e-5)
 
 

@@ -263,6 +263,30 @@ def test_ragged_serves_relu_activation():
     _assert_ragged_matches_dense(model, params, {1: list(range(1, 9))}, 6)
 
 
+def test_ragged_serves_internlm_layout():
+    """InternLM layout: use_bias=False but qkv AND o_proj biases present
+    (checkpoint/hf.py internlm config). The ragged core must apply the
+    o_proj bias — advisor r4 high finding: it was gated on use_bias and
+    silently dropped every layer's attention output bias."""
+    from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=128, d_model=64, n_layers=2,
+                            n_heads=4, n_kv_heads=4, max_seq_len=256,
+                            norm="rms", activation="silu_glu",
+                            position="rope", use_bias=False, qkv_bias=True,
+                            attn_o_bias=True, tie_embeddings=False,
+                            use_flash=False, remat=False)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(2))
+    # biases init to zeros — randomize them so dropping one is visible
+    kb = jax.random.split(jax.random.PRNGKey(9), 4)
+    for i, name in enumerate(("bq", "bk", "bv", "bo")):
+        params["layers"][name] = 0.5 * jax.random.normal(
+            kb[i], params["layers"][name].shape, jnp.float32)
+    _assert_ragged_matches_dense(
+        model, params, {3: list(range(1, 9)), 5: list(range(40, 50))}, 6)
+
+
 def test_sampled_decode_chunk_invariant_and_seeded():
     """temperature>0 sampling: same engine seed -> identical streams
     regardless of decode chunking; different seed -> different tokens;
