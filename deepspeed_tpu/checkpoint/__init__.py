@@ -5,4 +5,5 @@ universal-checkpoint conversion surface."""
 from .hf import from_pretrained, hf_config, map_hf_params, read_hf_state  # noqa: F401
 from .megatron import from_megatron  # noqa: F401
 from .diffusers import load_unet, load_vae  # noqa: F401
-from .export import export_hf_gpt2, export_hf_llama  # noqa: F401
+from .export import (export_hf_gpt2, export_hf_llama,  # noqa: F401
+                     export_hf_mixtral)

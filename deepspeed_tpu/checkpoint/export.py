@@ -20,7 +20,7 @@ from typing import Any, Dict
 
 import numpy as np
 
-__all__ = ["export_hf_llama", "export_hf_gpt2"]
+__all__ = ["export_hf_llama", "export_hf_gpt2", "export_hf_mixtral"]
 
 
 def _t(x) -> np.ndarray:
@@ -32,6 +32,64 @@ def _tT(x) -> np.ndarray:
     serializes the raw buffer, so a strided .T view would silently write
     the untransposed bytes under a transposed header."""
     return np.ascontiguousarray(np.asarray(x).T)
+
+
+def _llama_trunk_state(c, params) -> Dict[str, np.ndarray]:
+    """Embeddings + final norm + (untied) head + per-layer llama-style
+    attention/norm keys — the state shared by every rms+rope exporter."""
+    lay = params["layers"]
+    state: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _t(params["tok_embed"]),
+        "model.norm.weight": _t(params["final_norm_w"]),
+    }
+    if not c.tie_embeddings:
+        state["lm_head.weight"] = _tT(params["lm_head"])
+    for i in range(c.n_layers):
+        L = f"model.layers.{i}."
+        state.update({
+            L + "input_layernorm.weight": _t(lay["attn_norm_w"][i]),
+            L + "post_attention_layernorm.weight": _t(lay["mlp_norm_w"][i]),
+            L + "self_attn.q_proj.weight": _tT(lay["wq"][i]),
+            L + "self_attn.k_proj.weight": _tT(lay["wk"][i]),
+            L + "self_attn.v_proj.weight": _tT(lay["wv"][i]),
+            L + "self_attn.o_proj.weight": _tT(lay["wo"][i]),
+        })
+    return state
+
+
+def _save_safetensors(state: Dict[str, np.ndarray], out_dir: str) -> None:
+    from safetensors.numpy import save_file
+
+    # safetensors has no bf16 numpy dtype bridge everywhere — export fp32
+    # unless the leaves already are a numpy-native dtype
+    state = {k: (v.astype(np.float32)
+                 if v.dtype not in (np.float32, np.float16) else v)
+             for k, v in state.items()}
+    save_file(state, os.path.join(out_dir, "model.safetensors"))
+
+
+def _base_causal_config(c, model_type: str, arch: str) -> Dict[str, Any]:
+    hf_config: Dict[str, Any] = {
+        "architectures": [arch],
+        "model_type": model_type,
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.d_model,
+        "intermediate_size": c.d_ff,
+        "num_hidden_layers": c.n_layers,
+        "num_attention_heads": c.n_heads,
+        "num_key_value_heads": c.n_kv_heads,
+        "max_position_embeddings": c.max_seq_len,
+        "rms_norm_eps": c.norm_eps,
+        "rope_theta": c.rope_theta,
+        "tie_word_embeddings": bool(c.tie_embeddings),
+        "hidden_act": "silu",
+        "torch_dtype": "float32",
+    }
+    if getattr(c, "attn_windows", None):
+        w = c.attn_windows[0]
+        if w and all(x == w for x in c.attn_windows):
+            hf_config["sliding_window"] = int(w)
+    return hf_config
 
 
 def export_hf_llama(model, params: Dict[str, Any], out_dir: str,
@@ -66,21 +124,10 @@ def export_hf_llama(model, params: Dict[str, Any], out_dir: str,
         raise ValueError(f"unknown export model_type '{model_type}'")
     os.makedirs(out_dir, exist_ok=True)
     lay = params["layers"]
-    state: Dict[str, np.ndarray] = {
-        "model.embed_tokens.weight": _t(params["tok_embed"]),
-        "model.norm.weight": _t(params["final_norm_w"]),
-    }
-    if not c.tie_embeddings:
-        state["lm_head.weight"] = _tT(params["lm_head"])
+    state = _llama_trunk_state(c, params)
     for i in range(c.n_layers):
         L = f"model.layers.{i}."
         state.update({
-            L + "input_layernorm.weight": _t(lay["attn_norm_w"][i]),
-            L + "post_attention_layernorm.weight": _t(lay["mlp_norm_w"][i]),
-            L + "self_attn.q_proj.weight": _tT(lay["wq"][i]),
-            L + "self_attn.k_proj.weight": _tT(lay["wk"][i]),
-            L + "self_attn.v_proj.weight": _tT(lay["wv"][i]),
-            L + "self_attn.o_proj.weight": _tT(lay["wo"][i]),
             L + "mlp.gate_proj.weight": _tT(lay["w_gate"][i]),
             L + "mlp.up_proj.weight": _tT(lay["w_up"][i]),
             L + "mlp.down_proj.weight": _tT(lay["w_down"][i]),
@@ -91,45 +138,68 @@ def export_hf_llama(model, params: Dict[str, Any], out_dir: str,
             state[L + "self_attn.v_proj.bias"] = _t(lay["bv"][i])
         if "bo" in lay:
             state[L + "self_attn.o_proj.bias"] = _t(lay["bo"][i])
-
-    from safetensors.numpy import save_file
-
-    # safetensors has no bf16 numpy dtype bridge everywhere — export fp32
-    # unless the leaves already are a numpy-native dtype
-    state = {k: (v.astype(np.float32)
-                 if v.dtype not in (np.float32, np.float16) else v)
-             for k, v in state.items()}
-    save_file(state, os.path.join(out_dir, "model.safetensors"))
+    _save_safetensors(state, out_dir)
 
     arch = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
             "qwen2": "Qwen2ForCausalLM",
             "internlm": "InternLMForCausalLM"}[model_type]
-    hf_config = {
-        "architectures": [arch],
-        "model_type": model_type,
-        "vocab_size": c.vocab_size,
-        "hidden_size": c.d_model,
-        "intermediate_size": c.d_ff,
-        "num_hidden_layers": c.n_layers,
-        "num_attention_heads": c.n_heads,
-        "num_key_value_heads": c.n_kv_heads,
-        "max_position_embeddings": c.max_seq_len,
-        "rms_norm_eps": c.norm_eps,
-        "rope_theta": c.rope_theta,
-        "tie_word_embeddings": bool(c.tie_embeddings),
-        "hidden_act": "silu",
-        "torch_dtype": "float32",
-    }
+    hf_config = _base_causal_config(c, model_type, arch)
     if model_type in ("llama", "mistral", "internlm"):
         hf_config["attention_bias"] = bool(c.qkv_bias)
     if model_type == "internlm":
         # InternLM's remote-code config reads the 'bias' key (default
         # True) — the same key hf.py ingestion reads (hc.get('bias', ...))
         hf_config["bias"] = bool(c.qkv_bias)
-    if getattr(c, "attn_windows", None):
-        w = c.attn_windows[0]
-        if w and all(x == w for x in c.attn_windows):
-            hf_config["sliding_window"] = int(w)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(hf_config, f, indent=2)
+    return out_dir
+
+
+def export_hf_mixtral(model, params: Dict[str, Any], out_dir: str) -> str:
+    """Write HF Mixtral format from a native MoETransformer: llama-style
+    attention plus per-layer routed experts unstacked from the native
+    [n_layers, n_experts, ...] banks into block_sparse_moe.experts.{e}.w1/
+    w2/w3. Inverse of checkpoint/hf.py::_map_mixtral — the MoE leg of the
+    reference's MoE save surface (runtime/engine.py _save_moe_checkpoint),
+    closing the fine-tune-then-serve round trip for sparse models."""
+    c = model.config
+    if c.norm != "rms" or c.activation != "silu_glu" or c.position != "rope":
+        raise NotImplementedError(
+            "export_hf_mixtral handles the mixtral layout (rms + silu_glu "
+            f"+ rope); got norm={c.norm} activation={c.activation} "
+            f"position={c.position}")
+    E = getattr(c, "n_experts", 0)
+    if not E:
+        raise ValueError("model has no experts — use export_hf_llama")
+    if getattr(c, "n_shared_experts", 0):
+        raise NotImplementedError(
+            "MixtralForCausalLM has no shared-expert branch")
+    if bool(c.qkv_bias) or bool(getattr(c, "attn_o_bias", False)):
+        raise NotImplementedError(
+            "MixtralForCausalLM constructs bias-free attention; got "
+            f"qkv_bias={c.qkv_bias} attn_o_bias={c.attn_o_bias}")
+    os.makedirs(out_dir, exist_ok=True)
+    lay = params["layers"]
+    state = _llama_trunk_state(c, params)
+    for i in range(c.n_layers):
+        L = f"model.layers.{i}."
+        # router: native wg [d, E] -> HF gate [E, d]
+        state[L + "block_sparse_moe.gate.weight"] = _tT(lay["wg"][i])
+        for e in range(E):
+            X = L + f"block_sparse_moe.experts.{e}."
+            # native banks [n, E, d, f] (gate/up) and [n, E, f, d] (down)
+            # -> HF w1/w3 [f, d], w2 [d, f]
+            state[X + "w1.weight"] = _tT(lay["w_gate"][i, e])
+            state[X + "w3.weight"] = _tT(lay["w_up"][i, e])
+            state[X + "w2.weight"] = _tT(lay["w_down"][i, e])
+    _save_safetensors(state, out_dir)
+
+    hf_config = _base_causal_config(c, "mixtral", "MixtralForCausalLM")
+    hf_config.update({
+        "num_local_experts": int(E),
+        "num_experts_per_tok": int(c.top_k),
+        "output_router_logits": False,
+    })
     with open(os.path.join(out_dir, "config.json"), "w") as f:
         json.dump(hf_config, f, indent=2)
     return out_dir

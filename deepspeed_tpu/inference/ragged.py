@@ -625,17 +625,52 @@ class RaggedInferenceEngine:
         windows = tuple(int(w) if 0 < int(w) < cfg.max_context else 0
                         for w in aw) if aw is not None \
             else (0,) * c.n_layers
-        # TP shards the pool/heads; the Pallas kernel is single-device
-        # (GSPMD cannot partition a pallas_call) — TP serving runs the
-        # gather path, which XLA partitions head-wise with zero collectives
-        # inside attention. shard_map-wrapping the kernel is the follow-up.
+        # TP shards the pool/heads. GSPMD cannot partition a pallas_call,
+        # so under TP the kernel runs INSIDE a shard_map whose specs name
+        # the operands' existing sharding (heads/pool over 'model', tables/
+        # positions replicated) — each device runs the kernel on its local
+        # head shard with zero collectives, exactly the treatment the
+        # training flash wrapper got (models/transformer.py _attention;
+        # reference frame: FastGen's TP4 headline,
+        # blogs/deepspeed-fastgen/README.md:163). Attention is head-local,
+        # so no psum is needed; the o-proj contraction after it is GSPMD's.
         # Binding sliding windows ride the kernel too: the per-layer window
         # is STATIC (the python layer loop is unrolled), and the kernel
         # skips + DMA-dedups chunks below the band (O(window) traffic).
-        use_pallas = _use_pallas_paged(
+        # DST_RAGGED_FORCE_PALLAS=interpret pins the kernel path in Pallas
+        # interpret mode — the CPU-lane token-exactness tests for the
+        # sharded kernel ride this.
+        import os as _os
+
+        _force = _os.environ.get("DST_RAGGED_FORCE_PALLAS", "")
+        interp = _force == "interpret"
+        # (no indivisible-heads fallback needed here: __init__ rejects
+        # n_kv_heads % tp != 0 outright, and n_heads is a multiple of
+        # n_kv_heads, so any engine that reaches this point shards cleanly)
+        use_pallas = interp or _use_pallas_paged(
             c.head_dim, bs, self.config.dtype,
-            scalar_ints=cfg.max_seqs * self.max_pages + 2 * cfg.token_budget) \
-            and self._tp_size == 1
+            scalar_ints=cfg.max_seqs * self.max_pages + 2 * cfg.token_budget)
+
+        def _paged_attn_sharded(q, kp, vp, tables, positions, slots,
+                                live_pages, window):
+            """shard_map the paged kernel over the bound mesh: heads and
+            pool sharded on 'model', scalars replicated."""
+            from jax.sharding import PartitionSpec as P_
+
+            hspec = P_(None, "model", None)
+            pspec = P_(None, "model", None, None)
+
+            def local(q, kp, vp, tb, pos, sl):
+                return paged_attention(q, kp, vp, tb, pos, seq_slots=sl,
+                                       live_pages=live_pages, window=window,
+                                       interpret=interp)
+
+            return jax.shard_map(
+                local, mesh=self.topo.mesh, axis_names={"model"},
+                in_specs=(hspec, pspec, pspec, P_(None, None), P_(None),
+                          P_(None)),
+                out_specs=hspec, check_vma=False)(
+                q, kp, vp, tables, positions, slots)
 
         def norm(x, w, b=None):
             return rms_norm(x, w, c.norm_eps) if c.norm == "rms" \
@@ -693,11 +728,16 @@ class RaggedInferenceEngine:
                 # block tables, zero gather); jnp gather path elsewhere.
                 # (positions <= ctx-1 always, so the causal mask subsumes the
                 # context-length mask; inactive lanes produce ignored junk)
-                if use_pallas:
+                if use_pallas and self._tp_size > 1:
+                    attn = _paged_attn_sharded(q, kp, vp, block_tables,
+                                               positions, safe_slot,
+                                               live_pages, windows[li])
+                elif use_pallas:
                     attn = paged_attention(q, kp, vp, block_tables,
                                            positions, seq_slots=safe_slot,
                                            live_pages=live_pages,
-                                           window=windows[li])
+                                           window=windows[li],
+                                           interpret=interp)
                 else:
                     attn = paged_attention_reference(q, kp, vp, tables,
                                                      positions,

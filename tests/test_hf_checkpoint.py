@@ -511,6 +511,32 @@ def test_export_hf_llama_roundtrip(tmp_path):
     np.testing.assert_allclose(back, want, rtol=1e-5, atol=1e-5)
 
 
+def test_export_hf_mixtral_roundtrip(tmp_path):
+    """MoE export (reference _save_moe_checkpoint surface): native
+    Mixtral-layout MoETransformer -> HF export with the expert banks
+    unstacked -> transformers reproduces the ORIGINAL model's logits,
+    and our own ingestion reads the export back bit-consistently."""
+    from deepspeed_tpu.checkpoint.export import export_hf_mixtral
+
+    hf_model, d = _save_tiny(tmp_path, "mixtral", True)
+    model, params = from_pretrained(d, dtype=jnp.float32)
+    out = str(tmp_path / "exported_moe")
+    export_hf_mixtral(model, params, out)
+
+    hf2 = transformers.MixtralForCausalLM.from_pretrained(
+        out, attn_implementation="eager").eval()
+    tokens = np.random.default_rng(3).integers(1, 250, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+        got = hf2(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    model2, params2 = from_pretrained(out, dtype=jnp.float32)
+    native = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    back = np.asarray(model2.apply(params2, jnp.asarray(tokens)))
+    np.testing.assert_allclose(back, native, rtol=1e-5, atol=1e-5)
+
+
 def test_megatron_to_hf_pipeline(tmp_path):
     """The full Megatron-LM -> native -> HF GPT-2 conversion pipeline:
     a Megatron checkpoint ingests, exports to HF format, and transformers
