@@ -244,6 +244,35 @@ def _probe_tpu() -> bool:
     return False
 
 
+def _freshest_local_tpu_artifact():
+    """Newest provenance-stamped BENCH_*_local.json summary, or None."""
+    import glob
+
+    best = None
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in glob.glob(os.path.join(here, "BENCH_r*_local.json")):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except Exception:
+            continue
+        prov = d.get("provenance") or {}
+        utc = prov.get("utc") or ""
+        if not utc:
+            continue  # unstamped artifacts are not auditable references
+        if best is None or utc > best[0]:
+            best = (utc, {
+                "file": os.path.basename(path),
+                "utc": utc or None,
+                "device": prov.get("device"),
+                "git_sha": prov.get("git_sha"),
+                "metric": d.get("metric"),
+                "value": d.get("value"),
+                "mfu": (d.get("extra") or {}).get("mfu"),
+            })
+    return best[1] if best else None
+
+
 def main():
     if os.environ.get("DST_BENCH_CHILD") == "1":
         _child_main()
@@ -270,6 +299,17 @@ def main():
 
     rc, line = _run(child, _cpu_env(), CPU_BENCH_TIMEOUT_S)
     if line:
+        # CPU fallback: point the consumer at the freshest provenance-
+        # stamped local TPU artifact so the driver row and the builder's
+        # on-chip evidence reconcile in one glance (VERDICT r4 item 7)
+        try:
+            row = json.loads(line)
+            ref = _freshest_local_tpu_artifact()
+            if ref:
+                row.setdefault("extra", {})["latest_local_tpu"] = ref
+            line = json.dumps(row)
+        except Exception:
+            pass
         print(line, flush=True)
         return 0
     # last resort: still emit parseable JSON rather than crashing the driver

@@ -55,7 +55,8 @@ class TransformerConfig:
     use_bias: bool = False
     norm_eps: float = 1e-6
     remat: bool = True                # activation checkpointing per block
-    remat_policy: str = "full"        # full | selective | dots_with_no_batch_dims
+    remat_policy: str = "full"        # full | selective | selective_flash
+    #                                 # | dots_with_no_batch_dims | nothing
     use_flash: bool = True
     logits_softcap: float = 0.0
     z_loss: float = 0.0

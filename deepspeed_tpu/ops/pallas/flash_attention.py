@@ -390,6 +390,17 @@ def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret, window):
     scale_v = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     out, lse = _flash_forward(q, k, v, scale_v, causal, block_q, block_k,
                               interpret, window)
+    # Name the kernel residuals so remat policies can SAVE them:
+    # checkpoint_dots ("selective") does not match a pallas_call, so under
+    # plain selective remat the backward replays this whole forward kernel
+    # per layer just to regenerate (out, lse). The "selective_flash" policy
+    # (runtime/activation_checkpointing.py) saves these names instead —
+    # one flash forward per layer per step, ~33 MB/layer at the bench
+    # shape. q/k/v are projection dot outputs, already policy-saved.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 

@@ -32,6 +32,14 @@ from ..utils.logging import log_dist
 _POLICIES = {
     "full": None,  # save nothing, recompute all
     "selective": jax.checkpoint_policies.checkpoint_dots,
+    # selective + the flash kernel's named residuals (out, lse): without
+    # this, checkpoint_dots can't see inside the opaque pallas_call and
+    # the backward replays the whole flash forward per layer (one extra
+    # fwd-attention pass per layer per step) just to rebuild them
+    "selective_flash": jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.checkpoint_dots,
+        jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse")),
     "dots_with_no_batch_dims": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
     "nothing": jax.checkpoint_policies.everything_saveable,
 }

@@ -24,6 +24,13 @@ CONFIGS = [
     # r04 best-known config first (0.3402): fast signal if the window dies
     {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective",
      "DST_BENCH_CE_CHUNK": "0"},
+    # selective + saved flash residuals (r05: kills the per-layer flash
+    # forward REPLAY in the backward — jaxpr-verified 4->3 pallas calls);
+    # costs ~0.85 GB extra saved state at bs8, hence the bs6 hedge
+    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective_flash",
+     "DST_BENCH_CE_CHUNK": "0"},
+    {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective_flash",
+     "DST_BENCH_BS": "6", "DST_BENCH_CE_CHUNK": "0"},
     # the staged-and-unmeasured r04 legs (VERDICT r4 weak #1/#3):
     # batch edge between 8 (fits) and 12 (OOM)
     {"DST_BENCH_FLASH": "1", "DST_BENCH_REMAT": "selective",

@@ -171,3 +171,39 @@ def test_padded_flash_matches_reference_odd_length():
     for a, b_ in zip(gp, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=2e-3, atol=2e-3)
+
+
+def test_selective_flash_policy_saves_kernel_residuals():
+    """The 'selective_flash' remat policy must save the flash kernel's
+    named residuals (out, lse): under plain 'selective' (checkpoint_dots)
+    the backward REPLAYS the forward pallas_call per layer — 4 kernel
+    calls in the grad jaxpr vs 3 when the residuals are saved. Gradients
+    must be identical between the policies."""
+    from deepspeed_tpu.runtime.activation_checkpointing import _POLICIES
+
+    q = jnp.ones((1, 256, 4, 64), jnp.float32)
+
+    def grad_jaxpr_calls(policy_name):
+        f = jax.checkpoint(
+            lambda q, k, v: flash_attention(q, k, v, True, None,
+                                            128, 128, True).sum(),
+            policy=_POLICIES[policy_name])
+        return str(jax.make_jaxpr(jax.grad(f))(q, q, q)).count("pallas_call")
+
+    assert grad_jaxpr_calls("selective") == 4       # fwd + replay + dq + dkv
+    assert grad_jaxpr_calls("selective_flash") == 3  # no forward replay
+
+    # random q/k/v (distinct per batch/head/position) so a residual
+    # save/restore mixup across those dims cannot cancel out
+    qr, kr, vr = _make_qkv(2, 256, 256, 4, 2, 64, seed=3)
+
+    def grads(policy_name):
+        f = jax.checkpoint(
+            lambda q, k, v: (flash_attention(q, k, v, True, None,
+                                             128, 128, True) ** 2).sum(),
+            policy=_POLICIES[policy_name])
+        return jax.grad(f, argnums=(0, 1, 2))(qr, kr, vr)
+
+    for a, b in zip(grads("selective"), grads("selective_flash")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
