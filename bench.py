@@ -263,7 +263,7 @@ def _freshest_local_tpu_artifact():
         if best is None or utc > best[0]:
             best = (utc, {
                 "file": os.path.basename(path),
-                "utc": utc or None,
+                "utc": utc,
                 "device": prov.get("device"),
                 "git_sha": prov.get("git_sha"),
                 "metric": d.get("metric"),

@@ -19,7 +19,12 @@ import os
 import subprocess
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ROUND = os.environ.get("DST_ROUND", "r05")
+
+
+def _round() -> str:
+    # read lazily so DST_ROUND set after import (or between calls in one
+    # process) is honored — import-time capture burned a dry run once
+    return os.environ.get("DST_ROUND", "r05")
 
 
 def _pkg_version(pkg: str):
@@ -50,7 +55,7 @@ def provenance(device: str | None = None) -> dict:
 
 
 def artifact_path(prefix: str) -> str:
-    return os.path.join(HERE, f"{prefix}_{ROUND}.json")
+    return os.path.join(HERE, f"{prefix}_{_round()}.json")
 
 
 def write_artifact(prefix: str, data, device: str | None = None,
