@@ -10,11 +10,20 @@ same path as deepspeed_tpu.autotuning) — and records XLA's own
 ``memory_analysis()`` / ``cost_analysis()`` against the v5p chip budget
 (95 GB HBM, 459 TFLOP/s bf16, 2765 GB/s HBM).
 
-Writes NORTHSTAR_r04.json:
+Writes NORTHSTAR_<round>.json (round tag via DST_ROUND, default r05):
   per-config: peak HBM bytes/chip vs budget, argument/temp split,
   whole-step FLOPs, roofline step time, predicted MFU, collective
   counts from the compiled HLO (all-gather / reduce-scatter / all-reduce
   — the ZeRO-3 schedule GSPMD emitted), and the remat plan.
+
+r05 (VERDICT r4 weak #5): pred_mfu is no longer a bare ceiling that is
+1.0 by construction. The compute term is anchored to the MEASURED
+single-chip MFU (freshest provenance-stamped local bench artifact —
+kernel+XLA efficiency observed on real silicon), and the prediction is
+quoted as a band: ceiling (perfect comm overlap at measured efficiency),
+floor (fully serial comm), and the anchor's provenance. The stated
+assumption: per-chip compute efficiency on the 7B layer shapes is at
+least the 350M-proxy's (arithmetic intensity rises with width).
 
 Usage: python scripts/northstar_feasibility.py   (runs itself on CPU with
 64 virtual devices; the axon TPU plugin is disarmed in the child).
@@ -60,10 +69,30 @@ def _run_child():
 
     n = 64
     assert len(jax.devices()) >= n, len(jax.devices())
+
+    # measured single-chip efficiency anchor (kernel + XLA efficiency on
+    # real silicon); falls back to the r4-committed sweep best if no
+    # provenance-stamped artifact exists yet
+    import bench as bench_mod
+
+    anchor = bench_mod._freshest_local_tpu_artifact()
+    if anchor and anchor.get("mfu"):
+        measured_eff = float(anchor["mfu"])
+        anchor_src = anchor
+    else:
+        measured_eff = 0.3402   # MFU_SWEEP_r04.json best row (350M proxy)
+        anchor_src = {"file": "MFU_SWEEP_r04.json", "note": "unstamped r4 "
+                      "sweep best (350M @ seq2048, v5e)"}
+
     report = {"target": "Llama-2 7B (BASELINE config 4) + 70B scale probe, "
                         "ZeRO-3 bf16 on v5p-64",
               "chip": {"name": "v5p", "hbm_bytes": V5P_HBM,
                        "peak_bf16_flops": V5P_PEAK, "hbm_gbps": V5P_BW / 1e9},
+              "measured_single_chip_mfu_anchor": {
+                  "value": measured_eff, "source": anchor_src,
+                  "assumption": "7B layer shapes achieve >= the 350M "
+                                "proxy's per-chip efficiency (arithmetic "
+                                "intensity rises with d_model)"},
               "n_devices": n, "configs": []}
 
     for name, size, mb, seq, remat in CONFIGS:
@@ -152,13 +181,27 @@ def _run_child():
         tokens = mb * n * seq
         model_flops = model.config.flops_per_token(seq) * tokens
         compute_s = model_flops / n / V5P_PEAK
+        # achievable compute time: ideal FLOP time divided by the MEASURED
+        # single-chip MFU — this is what the chip has actually been
+        # observed to sustain on this stack, not the silicon ceiling
+        compute_eff_s = compute_s / measured_eff
         param_bytes = sum(int(np.prod(s.shape)) * 2  # bf16 compute copy
                           for s in jax.tree_util.tree_leaves(p32))
         ici_eff = 300e9
         comm_s = 3 * param_bytes * (n - 1) / n / ici_eff
-        bw_s = bytes_acc / n / V5P_BW if bytes_acc > 0 else 0.0
-        est_step = max(compute_s, comm_s, bw_s)
-        mfu_pred = compute_s / max(est_step, 1e-12)
+        # (no separate HBM-bandwidth term: single-chip memory stalls are
+        # already folded into the measured anchor, and XLA's CPU-backend
+        # "bytes accessed" counter is untrustworthy for fused dots)
+        # ceiling: comm fully overlapped behind measured-efficiency compute
+        step_ceiling = max(compute_eff_s, comm_s)
+        # floor: ZeRO-3 gathers fully serial with compute
+        step_floor = compute_eff_s + comm_s
+        mfu_ceiling = compute_s / max(step_ceiling, 1e-12)
+        mfu_floor = compute_s / max(step_floor, 1e-12)
+        # the informative 45% question: IF the single-chip anchor reached
+        # 0.45, would pod-scale comm let this config hold it? (the ceiling
+        # itself always equals the anchor for compute-bound configs)
+        mfu_at_045_anchor = compute_s / max(compute_s / 0.45, comm_s)
 
         # the ZeRO-3 collective schedule GSPMD emitted
         hlo = compiled.as_text()
@@ -174,19 +217,28 @@ def _run_child():
             argument_gb_per_chip=round(args_b / n / 1e9, 2),
             temp_gb_per_chip=round(temp_b / n / 1e9, 2),
             step_flops_total=flops,
-            compute_s=round(compute_s, 4),
+            compute_s_ideal=round(compute_s, 4),
+            compute_s_at_measured_eff=round(compute_eff_s, 4),
             zero3_comm_s_if_serial=round(comm_s, 4),
             zero3_comm_gb_per_step=round(3 * param_bytes * (n - 1) / n / 1e9, 1),
-            roofline_step_s=round(est_step, 4),
+            roofline_step_s=round(step_ceiling, 4),
             tokens_per_step=tokens,
-            pred_tokens_per_sec_per_chip=round(tokens / n / est_step, 1),
+            pred_tokens_per_sec_per_chip=round(tokens / n / step_ceiling, 1),
             model_flops_per_step=model_flops,
-            pred_mfu=round(mfu_pred, 4),
+            # band anchored to measured single-chip efficiency: ceiling =
+            # perfect comm overlap, floor = fully serial ZeRO-3 gathers
+            pred_mfu_ceiling=round(mfu_ceiling, 4),
+            pred_mfu_floor=round(mfu_floor, 4),
+            # if the single-chip anchor reached the 0.45 target, the MFU
+            # pod-scale comm would still allow (comm-capped 45% check)
+            pred_mfu_if_anchor_hits_045=round(mfu_at_045_anchor, 4),
+            comm_allows_045=bool(mfu_at_045_anchor >= 0.45 - 1e-9),
             collectives=colls,
         )
         report["configs"].append(entry)
         print(f"[northstar] {name}: hbm {entry['hbm_per_chip_gb']} GB/chip "
-              f"(budget {V5P_HBM / 1e9:.0f}), pred_mfu {entry['pred_mfu']}",
+              f"(budget {V5P_HBM / 1e9:.0f}), pred_mfu "
+              f"{entry['pred_mfu_floor']}..{entry['pred_mfu_ceiling']}",
               flush=True)
 
     ok = [c for c in report["configs"] if c.get("feasible")]
@@ -194,11 +246,14 @@ def _run_child():
     models_ok = sorted({c.get("model", "7b") for c in ok})
     report["verdict"] = (
         f"FITS: ZeRO-3 Llama-2 {'/'.join(models_ok)} compiles and fits "
-        "v5p-64 HBM with headroom; pred_mfu is a roofline CEILING "
-        "(compute + modeled ICI traffic only — not a measurement)"
+        "v5p-64 HBM with headroom; pred_mfu_ceiling/floor bracket the "
+        "45% target using the MEASURED single-chip MFU as the compute-"
+        "efficiency anchor (overlap fraction is the remaining unknown)"
         if ok else "DOES NOT FIT")
-    with open(os.path.join(HERE, "NORTHSTAR_r04.json"), "w") as f:
-        json.dump(report, f, indent=1)
+    sys.path.insert(0, os.path.join(HERE, "scripts"))
+    from _artifact import write_artifact
+
+    write_artifact("NORTHSTAR", report)
     print(json.dumps({"feasible": len(ok), "total": len(report["configs"])}))
 
 
