@@ -137,6 +137,49 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
+def _kv_tile_clamp(causal: bool, window: int, block_q: int, block_k: int,
+                   diag_offset: int):
+    """Clamp a skipped tile's kv-block index onto the nearest RUNNING
+    tile's index. Pallas elides the DMA when an input's block index
+    repeats across grid steps, so tiles whose compute is pl.when-skipped
+    (above the causal diagonal, or fully below the window band) stop
+    costing K/V traffic too — the same dedup the paged kernel uses. For
+    banded attention this turns K/V traffic from O(s^2/bk) into
+    O(s * window / bk), matching the compute bound."""
+    def clamp(qi, ki):
+        j = ki
+        if causal:
+            # fully-masked q tiles (possible when skv < sq) make last_run
+            # negative — pin to block 0, never a negative DMA index
+            last_run = (qi * block_q + block_q - 1 + diag_offset) // block_k
+            j = jnp.maximum(0, jnp.minimum(j, last_run))
+        if window > 0:
+            first_run = jnp.maximum(
+                0, (qi * block_q + diag_offset - window + 1) // block_k)
+            j = jnp.maximum(j, first_run)
+        return j
+    return clamp
+
+
+def _q_tile_clamp(causal: bool, window: int, block_q: int, block_k: int,
+                  diag_offset: int, nq: int):
+    """The dkv-side twin of :func:`_kv_tile_clamp`: clamp a skipped tile's
+    q-block index (derived from the fused (group, q_block) grid dim) onto
+    the nearest RUNNING tile — same band inequalities solved for qi."""
+    def clamp(ki, gq):
+        qi = jax.lax.rem(gq, nq)
+        if causal:
+            # first running q tile for this kv block: qi*bq+bq-1+diag >= ki*bk
+            qi = jnp.maximum(qi, jnp.maximum(
+                0, (ki * block_k - diag_offset) // block_q))
+        if window > 0:
+            # last running q tile: qi*bq+diag-window < ki*bk+bk-1
+            t = ki * block_k + block_k - 1 + window - diag_offset
+            qi = jnp.minimum(qi, jnp.maximum(0, (t - 1) // block_q))
+        return qi
+    return clamp
+
+
 def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
                    window=0):
     b, sq, hq, d = q.shape
@@ -149,6 +192,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
+    clamp = _kv_tile_clamp(causal, window, block_q, block_k, skv - sq)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
@@ -160,10 +204,10 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0),
+                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, clamp(qi, ki), 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0),
+                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, clamp(qi, ki), 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -303,7 +347,10 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
     # dq: grid (b, q_head, q_block, kv_block); K/V indexed per kv-head group
-    # (same trick as the forward — never expanded to q-heads)
+    # (same trick as the forward — never expanded to q-heads). Skipped
+    # tiles clamp their K/V index onto a running tile so they cost no DMA
+    # (see _kv_tile_clamp).
+    clamp = _kv_tile_clamp(causal, window, block_q, block_k, skv - sq)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, sq=sq, skv=skv,
@@ -312,9 +359,9 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
         in_specs=[
             _seq_spec(block_q, d, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             _seq_spec(block_k, d,
-                      lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
+                      lambda bi, hi, qi, ki, g=group: (bi, hi // g, clamp(qi, ki), 0)),
             _seq_spec(block_k, d,
-                      lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
+                      lambda bi, hi, qi, ki, g=group: (bi, hi // g, clamp(qi, ki), 0)),
             _seq_spec(block_q, d, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             _row_spec(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             _row_spec(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -327,9 +374,14 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
 
     # dk/dv: grid (b, kv_head, kv_block, group*q_block) — the fused last dim
     # walks every q-head of the group then every q block, accumulating into
-    # one [block_k, d] scratch per kv head (no hq-sized dk/dv intermediates)
+    # one [block_k, d] scratch per kv head (no hq-sized dk/dv intermediates).
+    # Skipped q tiles (above the diagonal for this kv block, or fully past
+    # the window band) clamp their q-side index onto a running tile so
+    # they cost no q/do/lse/delta DMA.
     def qhead(hk, gq, g=group):
         return hk * g + gq // nq
+
+    q_clamp = _q_tile_clamp(causal, window, block_q, block_k, skv - sq, nq)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
@@ -338,15 +390,15 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k,
         grid=(b, hkv, nk, group * nq),
         in_specs=[
             _seq_spec(block_q, d,
-                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq), 0)),
+                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), q_clamp(ki, gq), 0)),
             _seq_spec(block_k, d, lambda bi, hk, ki, gq: (bi, hk, ki, 0)),
             _seq_spec(block_k, d, lambda bi, hk, ki, gq: (bi, hk, ki, 0)),
             _seq_spec(block_q, d,
-                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq), 0)),
+                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), q_clamp(ki, gq), 0)),
             _row_spec(block_q,
-                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq), 0)),
+                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), q_clamp(ki, gq), 0)),
             _row_spec(block_q,
-                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), jax.lax.rem(gq, nq), 0)),
+                      lambda bi, hk, ki, gq: (bi, qhead(hk, gq), q_clamp(ki, gq), 0)),
         ],
         out_specs=[
             _seq_spec(block_k, d, lambda bi, hk, ki, gq: (bi, hk, ki, 0)),
