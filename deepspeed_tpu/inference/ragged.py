@@ -26,6 +26,7 @@ formulation with identical semantics serves as fallback and oracle.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -64,13 +65,16 @@ def _use_pallas_paged(head_dim: int, block: int, dtype,
 # ----------------------------------------------------------------------
 # host-side state (reference: ragged/blocked_allocator.py, ragged_manager.py)
 class BlockedAllocator:
-    """Free-list allocator over ``n_blocks`` KV pages
+    """Refcounted free-list allocator over ``n_blocks`` KV pages
     (reference blocked_allocator.py — same capability, python list instead
-    of a torch tensor free-list)."""
+    of a torch tensor free-list; refcounts added for prefix-cache block
+    sharing: a page returns to the free list only when every holder —
+    sequences and the cache — has released it)."""
 
     def __init__(self, n_blocks: int):
         self.n_blocks = n_blocks
         self._free: List[int] = list(range(n_blocks))
+        self._ref: Dict[int, int] = {}
 
     @property
     def free_blocks(self) -> int:
@@ -80,10 +84,131 @@ class BlockedAllocator:
         if n > len(self._free):
             raise RuntimeError(f"KV pool exhausted: need {n}, have {len(self._free)}")
         out, self._free = self._free[:n], self._free[n:]
+        for b in out:
+            self._ref[b] = 1
         return out
 
-    def free(self, blocks: Sequence[int]) -> None:
-        self._free.extend(int(b) for b in blocks)
+    def retain(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            self._ref[int(b)] += 1
+
+    def release(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            b = int(b)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                self._free.append(b)
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(int(block), 0)
+
+    # historical name used throughout the engine/tests: a release, not an
+    # unconditional free — shared pages survive until the last holder
+    free = release
+
+
+class PrefixCache:
+    """LRU cache of computed KV pages keyed by full-block token prefixes.
+
+    Beyond-reference capability (FastGen recomputes every prompt; vLLM
+    calls this automatic prefix caching): when a sequence is flushed, its
+    full KV blocks are published under the token prefix they encode; a
+    new prompt sharing that prefix adopts the pages (refcounted via
+    :class:`BlockedAllocator`) and skips their prefill. Correctness rests
+    on immutability of shared pages: sharing covers FULL blocks only and
+    is capped at ``len(prompt) - 1`` tokens, so the engine's scatters only
+    ever write positions at-or-after the shared region's end — except the
+    benign case of re-writing the final shared position with bit-identical
+    K/V (same tokens, same absolute positions, same params)."""
+
+    def __init__(self, block_size: int):
+        import collections
+
+        self.block_size = block_size
+        # prefix tuple -> list of block ids (cache holds one retain each);
+        # ordered oldest-used first: O(1) LRU via move_to_end/popitem
+        self._entries: "collections.OrderedDict[Tuple[int, ...], List[int]]" \
+            = collections.OrderedDict()
+        # per-block count of CACHE references (across nested entries) —
+        # lets reclaimable_blocks() tell cache-only pages from shared ones
+        self._block_refs: Dict[int, int] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def match(self, prompt: Sequence[int]) -> Tuple[int, List[int]]:
+        """Longest cached full-block prefix of ``prompt``, capped so at
+        least one prompt token remains to prefill (its logits seed
+        generation). Returns (shared_token_count, blocks) — blocks are NOT
+        yet retained for the caller."""
+        bs = self.block_size
+        for k in range((len(prompt) - 1) // bs, 0, -1):
+            key = tuple(int(t) for t in prompt[: k * bs])
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return k * bs, ent
+        self.misses += 1
+        return 0, []
+
+    def _hold(self, key, blocks, allocator: BlockedAllocator) -> None:
+        allocator.retain(blocks)
+        for b in blocks:
+            self._block_refs[b] = self._block_refs.get(b, 0) + 1
+        self._entries[key] = blocks
+
+    def publish(self, tokens: Sequence[int], blocks: Sequence[int], seen: int,
+                allocator: BlockedAllocator) -> None:
+        """Offer a flushed sequence's full blocks to the cache (the cache
+        retains them; the sequence's own refs are released separately)."""
+        bs = self.block_size
+        k = min(seen, len(tokens)) // bs
+        if k <= 0:
+            return
+        key = tuple(int(t) for t in tokens[: k * bs])
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return
+        held = [int(b) for b in blocks[:k]]
+        self._hold(key, held, allocator)
+        # keys are exact tuples, so a shorter shared prefix needs its own
+        # entry — publish every nested full-block level too (same pages,
+        # one retain per level)
+        for kk in range(k - 1, 0, -1):
+            kkey = key[: kk * bs]
+            if kkey in self._entries:
+                break
+            self._hold(kkey, held[:kk], allocator)
+
+    def _evict_one(self, allocator: BlockedAllocator) -> None:
+        _, blocks = self._entries.popitem(last=False)   # LRU
+        allocator.release(blocks)
+        for b in blocks:
+            self._block_refs[b] -= 1
+            if self._block_refs[b] == 0:
+                del self._block_refs[b]
+
+    def evict_for(self, allocator: BlockedAllocator, need: int) -> None:
+        """LRU-evict entries until ``need`` blocks are free (or empty)."""
+        while allocator.free_blocks < need and self._entries:
+            self._evict_one(allocator)
+
+    def reclaimable_blocks(self, allocator: BlockedAllocator) -> int:
+        """Distinct pages that would return to the free list if the whole
+        cache dropped: pages whose every reference is the cache's own.
+        Admission checks (can_schedule/query) count these as available —
+        without this, a cache that has absorbed the pool starves admission
+        forever while _check_pool could evict its way out."""
+        return sum(1 for b, n in self._block_refs.items()
+                   if allocator.refcount(b) == n)
+
+    def drop_all(self, allocator: BlockedAllocator) -> None:
+        while self._entries:
+            self._evict_one(allocator)
 
 
 @dataclass
@@ -119,6 +244,12 @@ class RaggedConfig:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    # automatic prefix caching (beyond the reference: FastGen has no KV
+    # reuse across requests): completed sequences publish their full KV
+    # blocks into an LRU cache keyed by the token prefix; new prompts
+    # sharing a full-block prefix skip its prefill entirely. Shared pages
+    # are refcounted; cache entries are evicted under pool pressure.
+    enable_prefix_cache: bool = False
 
 
 class RaggedInferenceEngine:
@@ -186,6 +317,8 @@ class RaggedInferenceEngine:
                     is_leaf=lambda x: isinstance(x, PartitionSpec)))
         cfg = self.config
         self.allocator = BlockedAllocator(cfg.n_kv_blocks)
+        self.prefix_cache = (PrefixCache(cfg.kv_block_size)
+                             if cfg.enable_prefix_cache else None)
         self.seqs: Dict[int, SequenceDescriptor] = {}
         self._free_slots = list(range(cfg.max_seqs))
         self.max_pages = cfg.max_context // cfg.kv_block_size
@@ -221,6 +354,7 @@ class RaggedInferenceEngine:
         self._step_fn = None
         self._core_fn = None
         self._decode_fn = None
+        self._copy_page_fn = None
         # sampling streams: decode steps fold a GLOBAL step counter into the
         # decode key, so sampled output is invariant to how decode_steps
         # calls chunk the token budget; prefill first-tokens get their own
@@ -246,9 +380,19 @@ class RaggedInferenceEngine:
         owned = len(self.seqs[uid].blocks) if uid in self.seqs else 0
         ctx_room = self.config.max_context - seen
         slack_in_blocks = owned * self.config.kv_block_size - seen
-        kv_room = slack_in_blocks + self.allocator.free_blocks * self.config.kv_block_size
+        avail = self._available_blocks()
+        kv_room = slack_in_blocks + avail * self.config.kv_block_size
         return (max(0, min(self.config.token_budget, ctx_room, kv_room)),
-                self.allocator.free_blocks)
+                avail)
+
+    def _available_blocks(self) -> int:
+        """Free pages plus cache-only-held pages (_check_pool evicts those
+        on demand, so admission must count them or it starves once the
+        prefix cache has absorbed the pool)."""
+        free = self.allocator.free_blocks
+        if self.prefix_cache is not None:
+            free += self.prefix_cache.reclaimable_blocks(self.allocator)
+        return free
 
     def can_schedule(self, uids: Sequence[int], lengths: Sequence[int]) -> bool:
         """Whether prompts of the given lengths fit (slots + kv blocks) —
@@ -264,13 +408,18 @@ class RaggedInferenceEngine:
             else:
                 need_blocks += -(-length // bs) + 1
         return (len(new) <= len(self._free_slots)
-                and need_blocks <= self.allocator.free_blocks)
+                and need_blocks <= self._available_blocks())
 
     def flush(self, uids: Sequence[int]) -> None:
-        """Release sequence state + KV blocks (reference engine_v2.flush :228)."""
+        """Release sequence state + KV blocks (reference engine_v2.flush :228).
+        With the prefix cache on, the sequence's full KV blocks are
+        published (cache-retained) before its own refs drop."""
         for uid in uids:
             seq = self.seqs.pop(uid, None)
             if seq is not None:
+                if self.prefix_cache is not None:
+                    self.prefix_cache.publish(seq.tokens, seq.blocks,
+                                              seq.seen, self.allocator)
                 self.allocator.free(seq.blocks)
                 self._free_slots.append(seq.slot)
 
@@ -285,12 +434,48 @@ class RaggedInferenceEngine:
         if not 0 <= length <= seq.seen:
             raise ValueError(
                 f"uid {uid}: trim length {length} outside [0, seen={seq.seen}]")
+        bs = self.config.kv_block_size
+        keep = -(-length // bs) if length else 0
+        # prefix-cache copy-on-write: after a mid-block trim the next
+        # scatter targets rows INSIDE the boundary block; if that page is
+        # shared (cache or another sequence holds it), writing would
+        # corrupt the other holders — give this sequence a private copy.
+        # Allocate it BEFORE mutating any state (evicting LRU prefixes if
+        # the pool is dry): a failed trim must leave the sequence intact,
+        # never pointed at a still-shared page it will scatter into.
+        cow_new = None
+        if (length % bs and keep <= len(seq.blocks)
+                and self.allocator.refcount(seq.blocks[keep - 1]) > 1):
+            if (self.allocator.free_blocks < 1
+                    and self.prefix_cache is not None):
+                self.prefix_cache.evict_for(self.allocator, 1)
+            # eviction may have dropped the cache's own ref on the
+            # boundary page, making it private — re-check before copying
+            if self.allocator.refcount(seq.blocks[keep - 1]) > 1:
+                cow_new = self.allocator.allocate(1)[0]   # may raise: state
+                # untouched so far
         seq.tokens = seq.tokens[:length]
         seq.seen = length
-        keep = -(-length // self.config.kv_block_size) if length else 0
         if keep < len(seq.blocks):
             self.allocator.free(seq.blocks[keep:])
             del seq.blocks[keep:]
+        if cow_new is not None:
+            old = seq.blocks[keep - 1]
+            self.kv_pool = self._copy_page(self.kv_pool, old, cow_new)
+            self.allocator.release([old])
+            seq.blocks[keep - 1] = cow_new
+
+    def _copy_page(self, pools, src: int, dst: int):
+        """Device-side page copy across every layer's K/V leaf (one jitted
+        donated program; used by trim's copy-on-write)."""
+        if self._copy_page_fn is None:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def cp(pools, src, dst):
+                return jax.tree_util.tree_map(
+                    lambda p: p.at[dst].set(p[src]), pools)
+
+            self._copy_page_fn = cp
+        return self._copy_page_fn(pools, jnp.int32(src), jnp.int32(dst))
 
     # -- step ------------------------------------------------------------
     def put(self, uids: Sequence[int], tokens: Sequence[Sequence[int]]) -> np.ndarray:
@@ -302,12 +487,22 @@ class RaggedInferenceEngine:
         """
         cfg = self.config
         for uid, toks in zip(uids, tokens):
-            if uid not in self.seqs:
+            new = uid not in self.seqs
+            if new:
                 if not self._free_slots:
                     raise RuntimeError("no free sequence slots; flush() first")
                 self.seqs[uid] = SequenceDescriptor(uid=uid,
                                                     slot=self._free_slots.pop())
-            self.seqs[uid].tokens.extend(int(t) for t in toks)
+            seq = self.seqs[uid]
+            seq.tokens.extend(int(t) for t in toks)
+            if new and self.prefix_cache is not None and seq.tokens:
+                # adopt the longest cached full-block prefix: its KV pages
+                # are shared (retained), and prefill starts past them
+                shared, blocks = self.prefix_cache.match(seq.tokens)
+                if shared:
+                    self.allocator.retain(blocks)
+                    seq.blocks = list(blocks)
+                    seq.seen = shared
 
         # ---- Dynamic SplitFuse packing: decodes (and short prompt tails)
         # first, then the longest-pending prefill fills the leftover budget
@@ -386,8 +581,11 @@ class RaggedInferenceEngine:
     def _check_pool(self, needs) -> None:
         """Admission check shared by put()/decode_steps(): the whole
         schedule's new-block demand must fit the pool before ANY uid is
-        granted blocks (two-phase validate-then-allocate)."""
+        granted blocks (two-phase validate-then-allocate). Cache-held
+        pages are reclaimable: evict LRU prefixes before giving up."""
         short = sum(n for n in needs if n > 0)
+        if short > self.allocator.free_blocks and self.prefix_cache is not None:
+            self.prefix_cache.evict_for(self.allocator, short)
         if short > self.allocator.free_blocks:
             raise RuntimeError(
                 f"KV pool exhausted: need {short} blocks, have "

@@ -46,6 +46,18 @@ OUT_TOKENS = 64 if not _SMOKE else 4
 DURATION_S = 20.0 if not _SMOKE else 2.0   # per-rate measurement window
 RATES = (1.0, 2.0, 4.0, 8.0, 12.0) if not _SMOKE else (2.0,)
 
+# shared-system-prompt leg: every request starts with the same SYS tokens
+# (the chat-serving common case) and the engine's automatic prefix cache
+# is on — the qps delta vs the plain pallas leg is the prefix-cache win
+_SYS_LEN = int(os.environ.get("DST_SERVE_SYS_PROMPT", "0"))
+SYS_TOKENS = (np.random.default_rng(7)
+              .integers(1, 32000, (_SYS_LEN,)).tolist() if _SYS_LEN else [])
+
+
+def _make_prompt(rng: np.random.Generator, plen: int) -> list:
+    take = min(_SYS_LEN, plen - 1)
+    return SYS_TOKENS[:take] + rng.integers(1, 32000, (plen - take,)).tolist()
+
 
 def _build_engine():
     import jax
@@ -58,13 +70,15 @@ def _build_engine():
                       n_kv_heads=4, vocab_size=256, max_seq_len=128,
                       use_flash=False, remat=False)
         cfg = RaggedConfig(token_budget=128, max_seqs=8, kv_block_size=16,
-                           n_kv_blocks=64, max_context=128)
+                           n_kv_blocks=64, max_context=128,
+                           enable_prefix_cache=_SYS_LEN > 0)
     else:
         model = Llama("tiny", d_model=1024, n_layers=16, n_heads=16,
                       n_kv_heads=16, d_ff=2816, vocab_size=32000,
                       max_seq_len=2048, use_flash=False, remat=False)
         cfg = RaggedConfig(token_budget=2048, max_seqs=64, kv_block_size=16,
-                           n_kv_blocks=6144, max_context=2048)
+                           n_kv_blocks=6144, max_context=2048,
+                           enable_prefix_cache=_SYS_LEN > 0)
     return RaggedInferenceEngine(model, cfg, rng=jax.random.PRNGKey(0)), model
 
 
@@ -101,7 +115,7 @@ def _run_rate(eng, rate: float, rng: np.random.Generator):
                 break
             waiting.pop(0)
             new_uids.append(u)
-            new_toks.append(rng.integers(1, 32000, (plen,)).tolist())
+            new_toks.append(_make_prompt(rng, plen))
             live[u] = {"generated": 0, "t_arrive": t_arr,
                        "t_first": None, "last": None}
         # schedule decode continuations (one sampled token) and drive
@@ -183,8 +197,9 @@ def _run_child():
     assert _SMOKE or jax.devices()[0].platform == "tpu", "requires a real TPU"
     eng, model = _build_engine()
     rng = np.random.default_rng(0)
-    # warmup: compile prefill buckets + decode tick shapes
-    warm = {90000 + i: rng.integers(1, 32000, (p,)).tolist()
+    # warmup: compile prefill buckets + decode tick shapes (and, on the
+    # prefix-cache leg, seed the cache with the system prompt)
+    warm = {90000 + i: _make_prompt(rng, p)
             for i, p in enumerate(PROMPT_POOL)}
     eng.generate(warm, max_new_tokens=4)
 
@@ -197,13 +212,21 @@ def _run_child():
     best = max((r["achieved_qps"] for r in rows if r["meets_sla"]), default=0.0)
     import jax
 
-    print(json.dumps({
-        "mode": os.environ.get("DST_RAGGED_FORCE_GATHER") == "1"
-        and "gather" or "pallas",
+    mode = ("pallas_prefix_cache" if _SYS_LEN
+            else "gather" if os.environ.get("DST_RAGGED_FORCE_GATHER") == "1"
+            else "pallas")
+    row = {
+        "mode": mode,
         "device": jax.devices()[0].device_kind,
         "sla_ms": SLA_MS, "out_tokens": OUT_TOKENS,
         "prompt_pool": PROMPT_POOL, "params": model.config.param_count(),
-        "qps_at_sla": best, "curve": rows}), flush=True)
+        "qps_at_sla": best, "curve": rows}
+    if eng.prefix_cache is not None:
+        row["prefix_cache"] = {"sys_prompt_len": _SYS_LEN,
+                               "hits": eng.prefix_cache.hits,
+                               "misses": eng.prefix_cache.misses,
+                               "entries": len(eng.prefix_cache)}
+    print(json.dumps(row), flush=True)
 
 
 def main():
@@ -212,8 +235,18 @@ def main():
         return 0
     report = {"metric": "serve_qps_at_p95_token_sla", "unit": "req/s",
               "sla_ms": SLA_MS}
-    for mode, env_extra in (("pallas", {}),
-                            ("gather", {"DST_RAGGED_FORCE_GATHER": "1"})):
+    # third leg: a shared system prompt (the chat-serving common case)
+    # with automatic prefix caching on — its qps-vs-pallas delta is the
+    # committed prefix-cache win (the reference has no counterpart)
+    # every leg pins BOTH knobs so an externally-set env can't silently
+    # turn a control leg into a prefix-cached (or gather) run
+    for mode, env_extra in (
+            ("pallas", {"DST_RAGGED_FORCE_GATHER": "0",
+                        "DST_SERVE_SYS_PROMPT": "0"}),
+            ("gather", {"DST_RAGGED_FORCE_GATHER": "1",
+                        "DST_SERVE_SYS_PROMPT": "0"}),
+            ("pallas_prefix_cache", {"DST_RAGGED_FORCE_GATHER": "0",
+                                     "DST_SERVE_SYS_PROMPT": "256"})):
         env = dict(os.environ, **env_extra)
         env[_CHILD] = "1"
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
@@ -233,6 +266,9 @@ def main():
         g = (report.get("gather") or {}).get("qps_at_sla") or 0
         if g:
             report["pallas_vs_gather"] = round(report["value"] / g, 2)
+        pc = (report.get("pallas_prefix_cache") or {}).get("qps_at_sla") or 0
+        if pc and report["value"]:
+            report["prefix_cache_vs_pallas"] = round(pc / report["value"], 2)
     sys.path.insert(0, os.path.join(HERE, "scripts"))
     from _artifact import write_artifact
 

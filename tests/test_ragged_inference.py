@@ -453,10 +453,13 @@ def test_ragged_tp_rejects_indivisible_heads():
                               topology=topo)
 
 
-def test_ragged_expert_parallel_serving():
-    """MoE serving over the 'expert' mesh axis: the expert bank shards
-    per partition_specs and GSPMD routes dispatch — greedy output stays
-    token-exact vs the unsharded engine."""
+@pytest.mark.parametrize("kernel_path", [False, True])
+def test_ragged_expert_parallel_serving(kernel_path, monkeypatch):
+    """MoE serving over a TP x EP mesh (the reference's Mixtral serving
+    composition): expert banks shard over 'expert', heads/pool over
+    'model' — on both the gather path and the Pallas kernel path (the
+    kernel's shard_map manualizes only 'model'; expert routing stays
+    GSPMD's). Greedy output token-exact vs the unsharded engine."""
     from deepspeed_tpu.models import GPTMoE
     from deepspeed_tpu.parallel import mesh as mesh_mod
 
@@ -469,9 +472,12 @@ def test_ragged_expert_parallel_serving():
     prompts = {5: rng.integers(1, 256, (11,)).tolist(),
                6: rng.integers(1, 256, (20,)).tolist()}
 
+    mesh_mod.reset_topology()
     eng = RaggedInferenceEngine(model, cfg, rng=jax.random.PRNGKey(4))
     want = eng.generate(dict(prompts), max_new_tokens=6)
 
+    if kernel_path:
+        monkeypatch.setenv("DST_RAGGED_FORCE_PALLAS", "interpret")
     mesh_mod.reset_topology()
     topo = mesh_mod.Topology.build_virtual({"expert": 2, "model": 2})
     eng_ep = RaggedInferenceEngine(model, cfg, rng=jax.random.PRNGKey(4),
@@ -575,3 +581,120 @@ def test_stream_matches_generate():
     next(it)
     it.close()
     assert 8 not in eng3.seqs
+
+
+# ---------------------------------------------------------------------
+# automatic prefix caching (beyond-reference: FastGen recomputes every
+# prompt; here completed sequences publish KV pages for full-block
+# prefix reuse)
+def _pc_cfg(**kw):
+    kw.setdefault("enable_prefix_cache", True)
+    return _cfg(**kw)
+
+
+def test_prefix_cache_reuse_token_exact():
+    """A prompt sharing a cached full-block prefix must adopt its KV pages
+    (no recompute) and still produce token-exact output vs a cache-less
+    engine."""
+    model = _llama()
+    params = model.init(jax.random.PRNGKey(5))
+    rng = np.random.default_rng(21)
+    P = rng.integers(1, 128, (20,)).tolist()          # 2 full blocks @ bs 8
+
+    oracle = RaggedInferenceEngine(model, _cfg(), params=params)
+    want_p = oracle.generate({1: list(P)}, max_new_tokens=6)[1]
+
+    eng = RaggedInferenceEngine(model, _pc_cfg(), params=params)
+    out1 = eng.generate({1: list(P)}, max_new_tokens=6)[1]
+    assert out1 == want_p
+    assert eng.prefix_cache.hits == 0 and len(eng.prefix_cache) > 0
+
+    # same prompt again: must hit the cache and stay exact
+    out2 = eng.generate({2: list(P)}, max_new_tokens=6)[2]
+    assert out2 == want_p
+    assert eng.prefix_cache.hits >= 1
+
+    # different tail sharing the first block only
+    Q = P[:8] + rng.integers(1, 128, (7,)).tolist()
+    want_q = RaggedInferenceEngine(model, _cfg(), params=params).generate(
+        {3: list(Q)}, max_new_tokens=6)[3]
+    out3 = eng.generate({3: list(Q)}, max_new_tokens=6)[3]
+    assert out3 == want_q
+
+
+def test_prefix_cache_shares_pages_and_refcounts():
+    """The adopted pages are the SAME block ids (shared, refcounted), and
+    pool accounting balances: cache-held pages return to the free list on
+    drop_all."""
+    model = _llama()
+    params = model.init(jax.random.PRNGKey(6))
+    eng = RaggedInferenceEngine(model, _pc_cfg(), params=params)
+    P = list(range(1, 21))                            # 20 tokens, bs 8
+    eng.generate({1: P}, max_new_tokens=4)
+    cached = next(iter(eng.prefix_cache._entries.values()))
+    free_before = eng.allocator.free_blocks
+
+    eng.put([2], [list(P)])
+    seq = eng.seqs[2]
+    assert seq.blocks[: len(cached)] == cached        # identity, not copies
+    assert all(eng.allocator.refcount(b) >= 2 for b in cached)
+    eng.flush([2])
+    assert eng.allocator.free_blocks == free_before
+    eng.prefix_cache.drop_all(eng.allocator)
+    assert eng.allocator.free_blocks == eng.allocator.n_blocks
+
+
+def test_prefix_cache_eviction_under_pool_pressure():
+    """Cache-held pages are reclaimable: a prompt that needs more blocks
+    than the free list holds evicts LRU prefixes instead of failing."""
+    model = _llama()
+    params = model.init(jax.random.PRNGKey(7))
+    # tiny pool: 10 blocks of 8 -> an 80-token budget total
+    eng = RaggedInferenceEngine(
+        model, _pc_cfg(n_kv_blocks=10, max_context=64), params=params)
+    rng = np.random.default_rng(31)
+    A = rng.integers(1, 128, (30,)).tolist()
+    eng.generate({1: list(A)}, max_new_tokens=4)      # publishes ~4 blocks
+    held = len(eng.prefix_cache)
+    assert held > 0
+    B = rng.integers(1, 128, (40,)).tolist()
+    # admission must count cache-only-held pages as reclaimable: a
+    # cache-saturated pool would otherwise starve can_schedule forever
+    assert eng.can_schedule([2], [len(B) + 4])
+    want = RaggedInferenceEngine(
+        model, _cfg(n_kv_blocks=10, max_context=64),
+        params=params).generate({2: list(B)}, max_new_tokens=4)[2]
+    out = eng.generate({2: list(B)}, max_new_tokens=4)[2]
+    assert out == want                                # evicted, not crashed
+
+
+def test_prefix_cache_trim_copy_on_write():
+    """Trimming into a SHARED block must not corrupt the cached copy:
+    the sequence gets a private page; a later prompt reusing the cache
+    still reproduces the original continuation."""
+    model = _llama()
+    params = model.init(jax.random.PRNGKey(8))
+    P = list(np.random.default_rng(41).integers(1, 128, (16,)))  # 2 blocks
+
+    eng = RaggedInferenceEngine(model, _pc_cfg(), params=params)
+    want = eng.generate({1: [int(t) for t in P]}, max_new_tokens=6)[1]
+
+    # adopt the cached prefix — sharing is capped at len-1, so with a
+    # 16-token prompt only block 0 (positions 0-7) is shared
+    eng.put([2], [[int(t) for t in P]])
+    shared_block = eng.seqs[2].blocks[0]
+    assert eng.allocator.refcount(shared_block) >= 2
+    # trim INTO the shared block (pos 4): must copy-on-write
+    eng.trim(2, 4)
+    assert eng.seqs[2].blocks[0] != shared_block      # private CoW page
+    assert eng.allocator.refcount(shared_block) >= 1  # cache still holds it
+    # scribble new tokens through the trimmed sequence (writes rows 4..)
+    logits = eng.put([2], [[3, 5, 7, 9]])
+    for _ in range(3):
+        t = int(np.argmax(logits[0]))
+        logits = eng.put([2], [[t]])
+    eng.flush([2])
+
+    # the cached prefix must be unpolluted: same prompt, same answer
+    out = eng.generate({3: [int(t) for t in P]}, max_new_tokens=6)[3]
+    assert out == want
