@@ -15,7 +15,11 @@ eng = RaggedInferenceEngine(
     model,
     RaggedConfig(token_budget=2048, max_seqs=64, kv_block_size=16,
                  n_kv_blocks=8192, max_context=model.config.max_seq_len,
-                 temperature=0.7, top_p=0.95),
+                 temperature=0.7, top_p=0.95,
+                 # shared-system-prompt serving: completed requests
+                 # publish their KV pages; later prompts sharing a
+                 # full-block prefix skip its prefill entirely
+                 enable_prefix_cache=True),
     params=params,
     # TP serving: from deepspeed_tpu.parallel.mesh import Topology, then
     # topology=Topology.build_virtual({"model": 8}),
