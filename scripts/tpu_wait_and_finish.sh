@@ -13,6 +13,11 @@ cd "$(dirname "$0")/.."
 R=${DST_ROUND:-r05}
 LOG=scripts/watcher_${R}.log
 FORCE=${DST_WATCH_FORCE:-0}
+# persistent XLA compile cache: the headline config compiles once per
+# window instead of once per stage (stage_bench, sweep row 1 and
+# stage_bench_best share it); harmlessly ignored if axon bypasses it
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/dst_xla_cache}
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 log() { echo "[watch $(date -u +%H:%M:%S)] $*" | tee -a "$LOG"; }
 
