@@ -211,6 +211,28 @@ class PrefixCache:
             self._evict_one(allocator)
 
 
+def _prompt_lookup(ctx: Sequence[int], ngram: int, k: int) -> List[int]:
+    """Prompt-lookup drafting: if the trailing ``ngram`` of ``ctx`` occurred
+    earlier, propose the (up to ``k``) tokens that followed its most recent
+    earlier occurrence. The zero-cost draft model of prompt-lookup /
+    n-gram speculative decoding — strong on the summarization/code/RAG
+    workloads where outputs quote their inputs."""
+    if k <= 0 or ngram <= 0 or len(ctx) <= ngram:
+        return []
+    arr = np.asarray(ctx, np.int32)
+    pat = arr[-ngram:]
+    win = np.lib.stride_tricks.sliding_window_view(arr[:-1], ngram)
+    hits = np.nonzero((win == pat).all(axis=1))[0]
+    if len(hits) == 0:
+        return []
+    # prefer the most recent occurrence that still has k continuation
+    # tokens; fall back to whichever hit offers the longest continuation
+    cont_len = np.minimum(len(arr) - (hits + ngram), k)
+    full = np.nonzero(cont_len == k)[0]
+    j = int(hits[full[-1]] if len(full) else hits[np.argmax(cont_len)])
+    return arr[j + ngram: j + ngram + k].tolist()
+
+
 @dataclass
 class SequenceDescriptor:
     """Reference DSSequenceDescriptor: uid, slot, tokens seen/scheduled,
@@ -355,6 +377,9 @@ class RaggedInferenceEngine:
         self._core_fn = None
         self._decode_fn = None
         self._copy_page_fn = None
+        self._verify_fn = None
+        # speculative-decoding acceptance stats (generate_speculative)
+        self.spec_stats = {"proposed": 0, "accepted": 0, "rounds": 0}
         # sampling streams: decode steps fold a GLOBAL step counter into the
         # decode key, so sampled output is invariant to how decode_steps
         # calls chunk the token budget; prefill first-tokens get their own
@@ -522,33 +547,9 @@ class RaggedInferenceEngine:
         # ---- validate + allocate for the WHOLE schedule before mutating any
         # sequence state, so an exhausted pool leaves every descriptor
         # consistent (seen never advances without its KV being written)
-        needs = []
-        for seq, take in sched:
-            new_total = seq.seen + take
-            if new_total > cfg.max_context:
-                raise ValueError(
-                    f"uid {seq.uid}: context {new_total} exceeds "
-                    f"max_context {cfg.max_context}")
-            needs.append(-(-new_total // cfg.kv_block_size) - len(seq.blocks))
-        self._check_pool(needs)
-
-        # ---- build the flat step batch (reference: C++ fast_host_buffer).
-        # T rounds the scheduled token count up to a bucket, not the full
-        # budget: a pure-decode step with 32 live seqs must not pay a
-        # 4096-lane forward (one compile per bucket, cached by jit)
-        scheduled = sum(take for _, take in sched)
-        T = next(b for b in self._buckets if b >= scheduled)
-        chunks, seens_l, slots_l = [], [], []
-        for (seq, take), need in zip(sched, needs):
-            if need > 0:
-                seq.blocks.extend(self.allocator.allocate(need))
-            chunks.append(seq.tokens[seq.seen:seq.seen + take])
-            seens_l.append(seq.seen)
-            slots_l.append(seq.slot)
-        # flat-lane construction on the native host-buffer builder
-        # (reference fast_host_buffer.cpp); numpy fallback is bit-identical
-        flat_tokens, flat_slot, flat_pos, last_idx = build_batch(
-            chunks, seens_l, slots_l, T)
+        needs = self._validate_sched(sched)
+        flat_tokens, flat_slot, flat_pos, last_idx = \
+            self._allocate_and_build(sched, needs)
         last_index = {}  # uid -> index in flat batch of its last token
         for (seq, take), li in zip(sched, last_idx):
             seq.seen += take
@@ -577,6 +578,93 @@ class RaggedInferenceEngine:
             if seq.pending == 0 and uid in last_index:
                 out[i] = logits[seq.slot]
         return out
+
+    def _validate_sched(self, sched) -> List[int]:
+        """Validate a (seq, take) schedule WITHOUT mutating anything:
+        context bound, pool demand (evicting cached prefixes if needed),
+        and batch-width fit. Returns per-entry new-block needs."""
+        cfg = self.config
+        needs = []
+        for seq, take in sched:
+            new_total = seq.seen + take
+            if new_total > cfg.max_context:
+                raise ValueError(
+                    f"uid {seq.uid}: context {new_total} exceeds "
+                    f"max_context {cfg.max_context}")
+            needs.append(-(-new_total // cfg.kv_block_size) - len(seq.blocks))
+        self._check_pool(needs)
+        scheduled = sum(take for _, take in sched)
+        if scheduled > cfg.token_budget:
+            raise ValueError(f"scheduled tokens {scheduled} exceed "
+                             f"token_budget {cfg.token_budget}")
+        return needs
+
+    def _allocate_and_build(self, sched, needs):
+        """Grant blocks and build the flat step batch (reference: C++
+        fast_host_buffer). T rounds the scheduled token count up to a
+        bucket, not the full budget: a pure-decode step with 32 live seqs
+        must not pay a 4096-lane forward (one compile per bucket, cached
+        by jit). The numpy fallback of build_batch is bit-identical to
+        the native builder."""
+        scheduled = sum(take for _, take in sched)
+        T = next(b for b in self._buckets if b >= scheduled)
+        chunks, seens_l, slots_l = [], [], []
+        for (seq, take), need in zip(sched, needs):
+            if need > 0:
+                seq.blocks.extend(self.allocator.allocate(need))
+            chunks.append(seq.tokens[seq.seen:seq.seen + take])
+            seens_l.append(seq.seen)
+            slots_l.append(seq.slot)
+        return build_batch(chunks, seens_l, slots_l, T)
+
+    def _put_verify(self, uids: Sequence[int],
+                    chains: Sequence[List[int]]) -> List[np.ndarray]:
+        """Speculative-verify step: admit each uid's token chain and return
+        the logits of EVERY chain row (vs put(), which selects only the
+        last). One device call verifies all proposals; the caller accepts
+        the longest matching prefix and trims the rest. k is pow2-bucketed
+        so the jit cache stays O(log k) wide."""
+        cfg = self.config
+        sched = [(self.seqs[u], len(c)) for u, c in zip(uids, chains)]
+        # validate BEFORE touching seq.tokens: a failed round must not
+        # leave unverified draft tokens in any sequence's stream
+        needs = self._validate_sched(sched)
+        for u, c in zip(uids, chains):
+            self.seqs[u].tokens.extend(int(t) for t in c)
+        flat_tokens, flat_slot, flat_pos, last_idx = \
+            self._allocate_and_build(sched, needs)
+        k_max = 1
+        while k_max < max(take for _, take in sched):
+            k_max *= 2
+        sel_rows = np.zeros((cfg.max_seqs, k_max), np.int32)
+        for (seq, take), li in zip(sched, last_idx):
+            li = int(li)
+            sel_rows[seq.slot, :take] = np.arange(li - take + 1, li + 1)
+            sel_rows[seq.slot, take:] = li      # padding rows: never read
+            seq.seen += take
+        if self._verify_fn is None:
+            self._verify_fn = self._build_verify()
+        logits, self.kv_pool = self._verify_fn(
+            self.params, self.kv_pool, jnp.asarray(flat_tokens),
+            jnp.asarray(flat_slot), jnp.asarray(flat_pos),
+            jnp.asarray(self._host_tables()), jnp.asarray(sel_rows),
+            self._live_pages_bucket())
+        logits = np.asarray(logits)             # [max_seqs, k_max, vocab]
+        return [logits[seq.slot, :take] for seq, take in sched]
+
+    def _build_verify(self):
+        core = self._core
+        model = self.model
+
+        def step(params, pools, tokens, slots, positions, block_tables,
+                 sel_rows, live_pages):
+            x, pools = core(params, pools, tokens, slots, positions,
+                            block_tables, live_pages)
+            x_sel = x[sel_rows.reshape(-1)]                 # [S*k, d]
+            logits = model._head(params, x_sel[None, :])[0]
+            return logits.reshape(sel_rows.shape + (-1,)), pools
+
+        return jax.jit(step, donate_argnums=(1,), static_argnums=(7,))
 
     def _check_pool(self, needs) -> None:
         """Admission check shared by put()/decode_steps(): the whole
@@ -755,28 +843,7 @@ class RaggedInferenceEngine:
         temperature/top-k/top-p sampling (chunk-invariant streams).
         Returns uid -> generated tokens."""
         done: Dict[int, List[int]] = {u: [] for u in prompts}
-        uids = list(prompts)
-        logits = self.put(uids, [list(p) for p in prompts.values()])
-        # run prefill to completion, collecting each uid's first decode
-        # token as its row resolves (long prompts span multiple steps)
-        first: Dict[int, int] = {}
-        while True:
-            pending, resolved = [], []
-            for u, row in zip(uids, logits):
-                if np.isnan(row).any():
-                    pending.append(u)
-                else:
-                    resolved.append((u, row))
-            if resolved:
-                toks_out = self._sample_first([r for _, r in resolved])
-                for (u, _), t in zip(resolved, toks_out):
-                    first[u] = t
-            if not pending:
-                break
-            uids = pending
-            logits = self.put(pending, [[] for _ in pending])
-        for u, t in first.items():
-            done[u].append(t)
+        first = self._prefill_first(prompts, done)
 
         live = {u: t for u, t in first.items()
                 if len(done[u]) < max_new_tokens
@@ -798,6 +865,115 @@ class RaggedInferenceEngine:
                 if (not stop and len(done[u]) < max_new_tokens
                         and self.seqs[u].seen < self.config.max_context):
                     nxt[u] = chain[-1]
+            live = nxt
+        for u in done:
+            done[u] = done[u][:max_new_tokens]
+        self.flush(list(prompts))
+        return done
+
+    def _prefill_first(self, prompts: Dict[int, Sequence[int]],
+                       done: Dict[int, List[int]]) -> Dict[int, int]:
+        """Run SplitFuse prefill to completion for ``prompts``, collecting
+        each uid's first decode token as its row resolves (long prompts
+        span multiple put() steps). Appends the first token to ``done``
+        and returns uid -> first token. Shared by generate() and
+        generate_speculative() (identical under greedy; sampled first
+        tokens ride the seeded prefill stream)."""
+        uids = list(prompts)
+        logits = self.put(uids, [list(p) for p in prompts.values()])
+        first: Dict[int, int] = {}
+        while True:
+            pending, resolved = [], []
+            for u, row in zip(uids, logits):
+                if np.isnan(row).any():
+                    pending.append(u)
+                else:
+                    resolved.append((u, row))
+            if resolved:
+                toks_out = self._sample_first([r for _, r in resolved])
+                for (u, _), t in zip(resolved, toks_out):
+                    first[u] = t
+            if not pending:
+                break
+            uids = pending
+            logits = self.put(pending, [[] for _ in pending])
+        for u, t in first.items():
+            done[u].append(t)
+        return first
+
+    def generate_speculative(self, prompts: Dict[int, Sequence[int]],
+                             max_new_tokens: int = 32,
+                             eos_token_id: Optional[int] = None,
+                             ngram: int = 3,
+                             lookahead: int = 4) -> Dict[int, List[int]]:
+        """Prompt-lookup speculative decoding (greedy only; beyond the
+        reference — FastGen decodes strictly one token per step).
+
+        Each round drafts up to ``lookahead`` continuation tokens per
+        sequence by matching its trailing ``ngram`` against earlier
+        context (zero-cost n-gram draft; no draft model), verifies the
+        whole chain in ONE ragged step via per-row logits, accepts the
+        longest matching prefix, and trims the rejected tail's KV.
+        Greedy acceptance makes the output TOKEN-IDENTICAL to
+        ``generate()`` — acceptance rate only changes how many device
+        round trips it takes. Stats land in ``self.spec_stats``.
+        """
+        if self.config.temperature != 0.0:
+            raise NotImplementedError(
+                "speculative decoding is greedy-only (temperature == 0); "
+                "sampled acceptance needs rejection sampling")
+        done: Dict[int, List[int]] = {u: [] for u in prompts}
+        first = self._prefill_first(prompts, done)
+
+        live = {u: t for u, t in first.items()
+                if len(done[u]) < max_new_tokens
+                and not (eos_token_id is not None and t == eos_token_id)}
+        while live:
+            # fair-share the token budget across live chains so the
+            # verify round always fits one step batch
+            share = max(1, self.config.token_budget // len(live))
+            v_uids, v_chains = [], []
+            for u, t0 in live.items():
+                seq = self.seqs[u]
+                room = self.config.max_context - seq.seen
+                if room <= 0:
+                    continue
+                k = max(0, min(lookahead, room - 1, share - 1,
+                               max_new_tokens - len(done[u]) - 1))
+                guesses = _prompt_lookup(seq.tokens + [t0], ngram, k)
+                v_uids.append(u)
+                v_chains.append([t0] + guesses)
+            if not v_uids:
+                break
+            rows = self._put_verify(v_uids, v_chains)
+            self.spec_stats["rounds"] += 1
+            nxt: Dict[int, int] = {}
+            for u, chain, lr in zip(v_uids, v_chains, rows):
+                a = np.argmax(lr, axis=-1)            # [len(chain)]
+                matched = 0
+                while (matched < len(chain) - 1
+                       and int(a[matched]) == chain[matched + 1]):
+                    matched += 1
+                self.spec_stats["proposed"] += len(chain) - 1
+                self.spec_stats["accepted"] += matched
+                emitted = [int(x) for x in a[:matched + 1]]
+                seq = self.seqs[u]
+                seen0 = seq.seen - len(chain)
+                stop_at = None
+                if eos_token_id is not None and eos_token_id in emitted:
+                    stop_at = emitted.index(eos_token_id)
+                    emitted = emitted[:stop_at + 1]
+                # rewind KV/tokens to the validated prefix (rejected rows
+                # are never read — attention is position-bounded — but the
+                # token stream must stay clean for further serving)
+                keep = seen0 + (stop_at if stop_at is not None
+                                else matched) + 1
+                if keep < seq.seen:
+                    self.trim(u, keep)
+                done[u].extend(emitted)
+                if (stop_at is None and len(done[u]) < max_new_tokens
+                        and seq.seen < self.config.max_context):
+                    nxt[u] = emitted[-1]
             live = nxt
         for u in done:
             done[u] = done[u][:max_new_tokens]

@@ -698,3 +698,125 @@ def test_prefix_cache_trim_copy_on_write():
     # the cached prefix must be unpolluted: same prompt, same answer
     out = eng.generate({3: [int(t) for t in P]}, max_new_tokens=6)[3]
     assert out == want
+
+
+# ---------------------------------------------------------------------
+# prompt-lookup speculative decoding (beyond-reference: FastGen decodes
+# one token per step; here n-gram drafts verify as a chain in one step)
+def test_speculative_matches_generate_token_exact():
+    """Greedy acceptance makes generate_speculative token-IDENTICAL to
+    generate() — on a repetitive prompt (drafts accepted) AND a random
+    one (drafts mostly rejected)."""
+    model = _llama()
+    params = model.init(jax.random.PRNGKey(9))
+    rep = [5, 6, 7, 8] * 6                        # n-gram heaven
+    rnd = list(np.random.default_rng(51).integers(1, 128, (17,)))
+
+    for prompt in (rep, rnd):
+        want = RaggedInferenceEngine(model, _cfg(), params=params).generate(
+            {1: [int(t) for t in prompt]}, max_new_tokens=12)[1]
+        eng = RaggedInferenceEngine(model, _cfg(), params=params)
+        got = eng.generate_speculative({1: [int(t) for t in prompt]},
+                                       max_new_tokens=12)[1]
+        assert got == want, (got, want)
+        assert eng.spec_stats["rounds"] >= 1
+
+
+def test_speculative_acceptance_machinery(monkeypatch):
+    """With an ORACLE draft (the true continuation), every proposal must
+    be accepted and the device-round count collapses to
+    ceil(tokens / (lookahead+1)) — pins the verify/accept/trim path
+    independently of whether a random model happens to be repetitive."""
+    import deepspeed_tpu.inference.ragged as ragged_mod
+
+    model = _llama()
+    params = model.init(jax.random.PRNGKey(9))
+    P = list(np.random.default_rng(53).integers(1, 128, (13,)))
+    want = RaggedInferenceEngine(model, _cfg(), params=params).generate(
+        {1: list(P)}, max_new_tokens=12)[1]
+    full = P + want
+
+    def oracle(ctx, ngram, k):
+        assert list(ctx) == full[:len(ctx)]        # stream stays validated
+        return full[len(ctx): len(ctx) + k]
+
+    monkeypatch.setattr(ragged_mod, "_prompt_lookup", oracle)
+    eng = RaggedInferenceEngine(model, _cfg(), params=params)
+    got = eng.generate_speculative({1: list(P)}, max_new_tokens=12,
+                                   lookahead=4)[1]
+    assert got == want, (got, want)
+    assert eng.spec_stats["accepted"] == eng.spec_stats["proposed"] > 0
+    assert eng.spec_stats["rounds"] == 3           # ceil(11 / 5) rounds
+
+
+def test_speculative_eos_and_multi_sequence():
+    """EOS inside an accepted chain stops that sequence exactly where
+    generate() stops it; mixed batches verify independently."""
+    model = _llama()
+    params = model.init(jax.random.PRNGKey(10))
+    p1 = [9, 2, 9, 2] * 5
+    p2 = list(np.random.default_rng(52).integers(1, 128, (11,)))
+    ref_eng = RaggedInferenceEngine(model, _cfg(), params=params)
+    ref = ref_eng.generate({1: list(p1), 2: list(p2)}, max_new_tokens=10)
+    # pick an eos that actually occurs mid-stream for seq 1 (else fall
+    # back to exercising the no-eos path — still a valid parity check)
+    eos = ref[1][3] if len(ref[1]) > 4 else None
+    want = RaggedInferenceEngine(model, _cfg(), params=params).generate(
+        {1: list(p1), 2: list(p2)}, max_new_tokens=10, eos_token_id=eos)
+
+    eng = RaggedInferenceEngine(model, _cfg(), params=params)
+    got = eng.generate_speculative({1: list(p1), 2: list(p2)},
+                                   max_new_tokens=10, eos_token_id=eos)
+    assert got == want, (got, want)
+
+
+def test_speculative_composes_with_prefix_cache():
+    """Speculative decoding + prefix caching together: trim-rewinds into
+    private tail blocks never touch cached pages; output stays exact."""
+    model = _llama()
+    params = model.init(jax.random.PRNGKey(11))
+    P = [3, 4, 5] * 8                              # 24 tokens, repetitive
+    want = RaggedInferenceEngine(model, _cfg(), params=params).generate(
+        {1: list(P)}, max_new_tokens=10)[1]
+    eng = RaggedInferenceEngine(model, _pc_cfg(), params=params)
+    a = eng.generate_speculative({1: list(P)}, max_new_tokens=10)[1]
+    b = eng.generate_speculative({2: list(P)}, max_new_tokens=10)[2]
+    assert a == want and b == want
+    assert eng.prefix_cache.hits >= 1              # cache hit on round 2
+
+
+def test_speculative_rejects_sampling():
+    model = _llama()
+    eng = RaggedInferenceEngine(model, _cfg(temperature=0.8),
+                                rng=jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="greedy-only"):
+        eng.generate_speculative({1: [1, 2, 3]})
+
+
+def test_prompt_lookup_drafting():
+    from deepspeed_tpu.inference.ragged import _prompt_lookup
+
+    ctx = [1, 2, 3, 9, 9, 1, 2, 3]
+    assert _prompt_lookup(ctx, 3, 2) == [9, 9]     # follows [1,2,3]
+    assert _prompt_lookup(ctx, 3, 5) == [9, 9, 1, 2, 3]
+    assert _prompt_lookup([7, 8, 9], 3, 2) == []   # no earlier occurrence
+    # prefers the hit with a full-k continuation (j=0 gives two tokens)
+    assert _prompt_lookup([5, 5, 5, 5], 2, 2) == [5, 5]
+    assert _prompt_lookup([1, 2], 3, 2) == []      # shorter than ngram
+
+
+def test_speculative_budget_clamp():
+    """Many live sequences x large lookahead under a small token budget:
+    chains must fair-share the budget (no StopIteration off the bucket
+    list) and stay token-exact."""
+    model = _llama()
+    params = model.init(jax.random.PRNGKey(12))
+    rng = np.random.default_rng(61)
+    prompts = {i: rng.integers(1, 128, (9,)).tolist() for i in range(4)}
+    cfg = dict(token_budget=16, max_seqs=4)
+    want = RaggedInferenceEngine(model, _cfg(**cfg), params=params).generate(
+        {u: list(p) for u, p in prompts.items()}, max_new_tokens=6)
+    eng = RaggedInferenceEngine(model, _cfg(**cfg), params=params)
+    got = eng.generate_speculative({u: list(p) for u, p in prompts.items()},
+                                   max_new_tokens=6, lookahead=32)
+    assert got == want, (got, want)
