@@ -81,11 +81,23 @@ sys.exit(0 if json.load(open("MFU_SWEEP_${R}.json")).get("complete") else 1)
 EOF
 }
 
-stage_sweep() {   # incremental writes: commit whatever landed even on timeout
+stage_sweep() {   # incremental writes: commit EACH row as it lands so a
+  # dying tunnel mid-sweep costs at most ~one config's evidence
   if [ "$FORCE" != 1 ] && sweep_complete; then return 0; fi
   log "stage: MFU sweep (staged legs + 1b model)"
-  timeout 7200 python scripts/tpu_mfu_sweep.py >>"$LOG" 2>&1
-  rc=$?
+  timeout 7200 python scripts/tpu_mfu_sweep.py >>"$LOG" 2>&1 &
+  local pid=$! last="" cur
+  while kill -0 "$pid" 2>/dev/null; do
+    sleep 120
+    if [ -e "MFU_SWEEP_${R}.json" ]; then
+      cur=$(md5sum "MFU_SWEEP_${R}.json" | cut -d' ' -f1)
+      if [ "$cur" != "$last" ]; then
+        commit_paths "TPU evidence: MFU sweep progress (${R})" "MFU_SWEEP_${R}.json"
+        last=$cur
+      fi
+    fi
+  done
+  wait "$pid"; local rc=$?
   [ -e "MFU_SWEEP_${R}.json" ] \
     && commit_paths "TPU evidence: MFU sweep (${R})" "MFU_SWEEP_${R}.json"
   [ "$rc" = 0 ] || { log "mfu sweep rc=$rc"; return 1; }
@@ -240,15 +252,17 @@ while true; do
   fi
   if probe; then
     log "TPU tunnel is UP — starting evidence pass"
-    # priority order: the MFU bar first (headline + attribution + sweep),
-    # then the never-measured r04 instruments, then refreshes
+    # priority order: headline (incl. compiled-loop MFU) first, then the
+    # sweep (its first rows are the selective_flash 0.35 shot, committed
+    # per-row), then the never-measured r04 instruments, attribution,
+    # and refreshes
     stage_bench
-    stage_breakdown
     stage_sweep
     stage_bench_best
     stage_serve
     stage_quant
     stage_kernel_lane
+    stage_breakdown
     stage_flash_check
     stage_decode
     stage_block_sweep
