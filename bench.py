@@ -280,18 +280,41 @@ def main():
 
     child = [sys.executable, os.path.abspath(__file__)]
     if _probe_tpu():
-        # respect a caller-set DST_BENCH_FLASH (the MFU sweep A/Bs it);
-        # default to flash on
+        # respect caller-set DST_BENCH_FLASH / DST_BENCH_REMAT (the MFU
+        # sweep pins them per leg). With no remat override, try the r05
+        # selective_flash policy first (saves the flash kernel residuals
+        # — no backward forward-replay) and fall back to the always-fits
+        # selective policy if it OOMs.
         flash = os.environ.get("DST_BENCH_FLASH", "1")
-        rc, line = _run(child, dict(_tpu_env(), DST_BENCH_FLASH=flash), TPU_BENCH_TIMEOUT_S)
-        if line:
-            print(line, flush=True)
-            return 0
-        if flash == "1":
-            print("[bench] TPU bench with flash failed; retrying without flash",
-                  file=sys.stderr)
-            rc, line = _run(child, dict(_tpu_env(), DST_BENCH_FLASH="0"),
+        model_tag = os.environ.get("DST_BENCH_MODEL", "350m")
+        if "DST_BENCH_REMAT" in os.environ:
+            remat_ladder = [os.environ["DST_BENCH_REMAT"]]
+        elif model_tag == "1b":
+            remat_ladder = ["full"]   # the 1b config's memory-bound default
+        elif flash == "1":
+            remat_ladder = ["selective_flash", "selective"]
+        else:
+            # without flash there are no kernel residuals to save —
+            # selective_flash would be a duplicate of selective
+            remat_ladder = ["selective"]
+        for remat in remat_ladder:
+            rc, line = _run(child, dict(_tpu_env(), DST_BENCH_FLASH=flash,
+                                        DST_BENCH_REMAT=remat),
                             TPU_BENCH_TIMEOUT_S)
+            if line:
+                print(line, flush=True)
+                return 0
+            print(f"[bench] TPU bench failed at remat={remat}",
+                  file=sys.stderr)
+        if flash == "1" and model_tag != "1b":
+            # honor a caller-pinned remat in the retry (a sweep leg's row
+            # must never be silently measured under a different policy);
+            # the 1b model skips this — selective remat does not fit HBM
+            print("[bench] retrying without flash", file=sys.stderr)
+            no_flash_env = dict(_tpu_env(), DST_BENCH_FLASH="0")
+            if "DST_BENCH_REMAT" not in os.environ:
+                no_flash_env["DST_BENCH_REMAT"] = "selective"
+            rc, line = _run(child, no_flash_env, TPU_BENCH_TIMEOUT_S)
             if line:
                 print(line, flush=True)
                 return 0
