@@ -820,3 +820,21 @@ def test_speculative_budget_clamp():
     got = eng.generate_speculative({u: list(p) for u, p in prompts.items()},
                                    max_new_tokens=6, lookahead=32)
     assert got == want, (got, want)
+
+
+def test_stream_composes_with_prefix_cache():
+    """stream() flushes on close, publishing into the prefix cache; a
+    second stream of the same prompt adopts the pages and yields the
+    identical token sequence."""
+    model = _llama()
+    params = model.init(jax.random.PRNGKey(13))
+    P = list(np.random.default_rng(71).integers(1, 128, (20,)))
+
+    ref_eng = RaggedInferenceEngine(model, _cfg(), params=params)
+    want = list(ref_eng.stream(1, list(P), max_new_tokens=8))
+
+    eng = RaggedInferenceEngine(model, _pc_cfg(), params=params)
+    a = list(eng.stream(1, list(P), max_new_tokens=8))
+    b = list(eng.stream(2, list(P), max_new_tokens=8))
+    assert a == want and b == want
+    assert eng.prefix_cache.hits >= 1
