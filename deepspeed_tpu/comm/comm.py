@@ -97,6 +97,14 @@ class CommsLogger:
             self.axes[(op_name, size_bytes)] = axis_name
         if world:
             self.worlds[(op_name, size_bytes)] = world
+        # unified telemetry: every recorded collective also lands in the
+        # shared metrics registry, so comm volume shows up next to step
+        # time in the exporters without a separate pipeline
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        reg.counter(f"comm/{op_name}/calls").inc()
+        reg.counter(f"comm/{op_name}/bytes").inc(size_bytes)
         if self.verbose:
             algbw, busbw = _get_bw(op_name, size_bytes, duration_s, world)
             log_dist(
@@ -126,6 +134,23 @@ class CommsLogger:
         table = "\n".join(lines)
         logger.info(table)
         return table
+
+    def snapshot_totals(self) -> Dict[str, Dict[str, float]]:
+        """Aggregate per-op totals for StepStats: {op: {count, bytes,
+        time_s}}. Counts/bytes are trace-time facts (the collectives the
+        compiled program contains); time_s sums the recorded durations,
+        which are real only after :func:`measure_comm_latencies` backfills
+        them."""
+        out: Dict[str, Dict[str, float]] = {}
+        for op, sizes in self.records.items():
+            count = bytes_total = time_total = 0.0
+            for size, durs in sizes.items():
+                count += len(durs)
+                bytes_total += size * len(durs)
+                time_total += sum(durs)
+            out[op] = {"count": count, "bytes": bytes_total,
+                       "time_s": time_total}
+        return out
 
     def reset(self) -> None:
         self.records.clear()

@@ -394,6 +394,54 @@ class MonitorConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Unified telemetry pipeline (``telemetry`` block — TPU-native, no
+    reference analog; see docs/observability.md).
+
+    When enabled, the train engine emits one StepStats JSONL record per
+    optimizer step (wall time, tokens/s, MFU, comm breakdown, memory
+    watermarks) and runs heartbeat/stall detection. Disabled (default),
+    the engine adds zero extra per-step host synchronization.
+    """
+
+    enabled: bool = False
+    output_dir: str = "telemetry"
+    jsonl_path: Optional[str] = None       # default: <output_dir>/steps.jsonl
+    prometheus_path: Optional[str] = None  # e.g. <output_dir>/metrics.prom
+    flush_every: int = 1
+    export_every: int = 10
+    stall_detection: bool = True
+    stall_factor: float = 3.0
+    stall_window: int = 20
+    stall_warmup_steps: int = 2
+    heartbeat_path: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryConfig":
+        if not d:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_take(d, "enabled", False)),
+            output_dir=str(_take(d, "output_dir", "telemetry")),
+            jsonl_path=_take(d, "jsonl_path", None),
+            prometheus_path=_take(d, "prometheus_path", None),
+            flush_every=int(_take(d, "flush_every", 1)),
+            export_every=int(_take(d, "export_every", 10)),
+            stall_detection=bool(_take(d, "stall_detection", True)),
+            stall_factor=float(_take(d, "stall_factor", 3.0)),
+            stall_window=int(_take(d, "stall_window", 20)),
+            stall_warmup_steps=int(_take(d, "stall_warmup_steps", 2)),
+            heartbeat_path=_take(d, "heartbeat_path", None),
+        )
+        if out.stall_factor <= 1.0:
+            raise ConfigError(
+                f"telemetry.stall_factor must exceed 1.0, got {out.stall_factor}")
+        _warn_unknown(d, "telemetry")
+        return out
+
+
+@dataclass
 class FlopsProfilerConfig:
     """Mirrors reference ``profiling/config.py``."""
 
@@ -557,6 +605,7 @@ class Config:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     activation_checkpointing: ActivationCheckpointingConfig = field(default_factory=ActivationCheckpointingConfig)
     monitor: MonitorConfig = field(default_factory=MonitorConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
@@ -618,6 +667,7 @@ class Config:
             monitor=MonitorConfig.from_dict(
                 _take(d, "tensorboard", None), _take(d, "csv_monitor", None), _take(d, "wandb", None)
             ),
+            telemetry=TelemetryConfig.from_dict(_take(d, "telemetry", None)),
             flops_profiler=FlopsProfilerConfig.from_dict(_take(d, "flops_profiler", None)),
             comms_logger=CommsLoggerConfig.from_dict(_take(d, "comms_logger", None)),
             pipeline=PipelineConfig.from_dict(_take(d, "pipeline", None)),

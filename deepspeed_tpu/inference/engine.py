@@ -24,6 +24,7 @@ TPU-first:
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple, Union
@@ -448,6 +449,13 @@ class InferenceEngine:
         if self._prefill_fn is None:
             self._prefill_fn = self._build_prefill()
             self._decode_fn = self._build_decode()
+        # request telemetry: TTFT + decode throughput. The timestamps ride
+        # host fetches the loop performs anyway (np.asarray per token), so
+        # instrumentation adds no extra device sync either way.
+        from ..telemetry import get_telemetry
+
+        telem = get_telemetry()
+        t_start = time.perf_counter()
         caches = self._alloc_cache(b, max_len)
         # per-engine RNG stream: successive generate() calls draw fresh keys
         # (the reference engine likewise does not reseed per request)
@@ -461,11 +469,14 @@ class InferenceEngine:
         if eos_token_id is not None:
             finished |= np.asarray(next_tok) == eos_token_id
         out = [np.asarray(next_tok)]
+        t_first = time.perf_counter()  # first token materialized on host
+        n_generated = b  # real tokens produced (finished rows emit padding)
         pos = s
         for i in range(max_new_tokens - 1):
             if finished.all():
                 break
             rng, sub = jax.random.split(rng)
+            n_generated += int(b - finished.sum())
             caches, next_tok = self._decode_fn(
                 self.params, caches, next_tok, jnp.asarray(pos, jnp.int32), sub)
             step = np.asarray(next_tok)
@@ -476,6 +487,15 @@ class InferenceEngine:
             out.append(step)
             pos += 1
         gen = np.stack(out, axis=1)
+        if telem.enabled:
+            t_end = time.perf_counter()
+            decode_s = t_end - t_first
+            n_decoded = n_generated - b
+            telem.record_request(
+                latency_s=t_end - t_start, ttft_s=t_first - t_start,
+                new_tokens=n_generated,
+                decode_tokens_per_s=(n_decoded / decode_s
+                                     if n_decoded and decode_s > 0 else None))
         return np.concatenate([np.asarray(input_ids), gen], axis=1)
 
     def forward(self, input_ids, **kw):

@@ -27,6 +27,7 @@ formulation with identical semantics serves as fallback and oracle.
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -243,6 +244,11 @@ class SequenceDescriptor:
     tokens: List[int] = field(default_factory=list)  # full known token stream
     seen: int = 0                                    # tokens already in KV
     blocks: List[int] = field(default_factory=list)
+    # telemetry clocks: t_admitted is cleared once TTFT is recorded;
+    # t_created survives until flush() reports end-to-end latency
+    t_admitted: Optional[float] = None
+    t_created: Optional[float] = None
+    prompt_len: int = 0
 
     @property
     def pending(self) -> int:
@@ -396,6 +402,14 @@ class RaggedInferenceEngine:
         log_dist(f"RaggedInferenceEngine: budget={cfg.token_budget} "
                  f"blocks={cfg.n_kv_blocks}x{cfg.kv_block_size}")
 
+    @property
+    def _telemetry(self):
+        # resolved per call: the global pipeline may be installed after
+        # this engine is constructed
+        from ..telemetry import get_telemetry
+
+        return get_telemetry()
+
     # -- scheduling API (parity engine_v2.query/can_schedule) -----------
     def query(self, uid: int) -> Tuple[int, int]:
         """(max new tokens schedulable for uid now, free kv blocks) —
@@ -439,9 +453,16 @@ class RaggedInferenceEngine:
         """Release sequence state + KV blocks (reference engine_v2.flush :228).
         With the prefix cache on, the sequence's full KV blocks are
         published (cache-retained) before its own refs drop."""
+        now = time.perf_counter()
         for uid in uids:
             seq = self.seqs.pop(uid, None)
             if seq is not None:
+                if seq.t_created is not None:
+                    # request retires here: end-to-end latency + tokens the
+                    # engine generated beyond the admitted prompt
+                    self._telemetry.record_request(
+                        latency_s=now - seq.t_created,
+                        new_tokens=max(0, len(seq.tokens) - seq.prompt_len))
                 if self.prefix_cache is not None:
                     self.prefix_cache.publish(seq.tokens, seq.blocks,
                                               seq.seen, self.allocator)
@@ -516,10 +537,15 @@ class RaggedInferenceEngine:
             if new:
                 if not self._free_slots:
                     raise RuntimeError("no free sequence slots; flush() first")
+                now = time.perf_counter()
                 self.seqs[uid] = SequenceDescriptor(uid=uid,
-                                                    slot=self._free_slots.pop())
+                                                    slot=self._free_slots.pop(),
+                                                    t_admitted=now,
+                                                    t_created=now)
             seq = self.seqs[uid]
             seq.tokens.extend(int(t) for t in toks)
+            if new:
+                seq.prompt_len = len(seq.tokens)
             if new and self.prefix_cache is not None and seq.tokens:
                 # adopt the longest cached full-block prefix: its KV pages
                 # are shared (retained), and prefill starts past them
@@ -573,11 +599,38 @@ class RaggedInferenceEngine:
         logits = np.asarray(logits)                    # [max_seqs, vocab]
 
         out = np.full((len(uids), logits.shape[-1]), np.nan, np.float32)
+        now = time.perf_counter()
         for i, uid in enumerate(uids):
             seq = self.seqs[uid]
             if seq.pending == 0 and uid in last_index:
                 out[i] = logits[seq.slot]
+                if seq.t_admitted is not None:
+                    # prompt fully prefilled and first logits on host: TTFT.
+                    # End-to-end latency is reported at flush(), when the
+                    # request actually completes.
+                    self._telemetry.record_request(
+                        ttft_s=now - seq.t_admitted)
+                    seq.t_admitted = None
+        self._record_step_telemetry(sched)
         return out
+
+    def kv_occupancy(self) -> float:
+        """Fraction of the paged KV pool currently held by live sequences
+        or the prefix cache (1.0 = exhausted)."""
+        return 1.0 - self.allocator.free_blocks / self.allocator.n_blocks
+
+    def _record_step_telemetry(self, sched) -> None:
+        """Per-ragged-step series: scheduled tokens + pool occupancy. Host
+        dict updates only — nothing here touches the device."""
+        t = self._telemetry
+        if not t.enabled:
+            return
+        r = t.registry
+        r.counter("inference/ragged_steps").inc()
+        r.counter("inference/scheduled_tokens").inc(
+            sum(take for _, take in sched))
+        r.gauge("inference/kv_occupancy").set(self.kv_occupancy())
+        r.gauge("inference/live_sequences").set(len(self.seqs))
 
     def _validate_sched(self, sched) -> List[int]:
         """Validate a (seq, take) schedule WITHOUT mutating anything:

@@ -67,6 +67,9 @@ class ElasticAgent:
                 f"elastic agent: worker failed rc={proc.returncode} "
                 f"(attempt {attempt + 1}/{self.max_restarts + 1})")
             if attempt < self.max_restarts:
+                from ..resilience import record_restart
+
+                record_restart()
                 if self.on_restart is not None:
                     self.on_restart(attempt)
                 time.sleep(self.backoff_s)

@@ -23,17 +23,38 @@ class CsvMonitor:
     def __init__(self, output_path: str, job_name: str):
         self.dir = os.path.join(output_path or "csv_monitor", job_name)
         os.makedirs(self.dir, exist_ok=True)
+        # per-metric open handles: one os.open per metric per run, not one
+        # open/close per event
         self._files = {}
 
-    def write_events(self, events: List[Event]) -> None:
-        for name, value, step in events:
+    def _writer(self, name: str):
+        entry = self._files.get(name)
+        if entry is None:
             fname = os.path.join(self.dir, name.replace("/", "_") + ".csv")
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", name])
-                w.writerow([step, value])
+            new = not os.path.exists(fname) or os.path.getsize(fname) == 0
+            f = open(fname, "a", newline="")
+            w = csv.writer(f)
+            if new:
+                w.writerow(["step", name])
+            entry = (f, w)
+            self._files[name] = entry
+        return entry
+
+    def write_events(self, events: List[Event]) -> None:
+        touched = set()
+        for name, value, step in events:
+            _, w = self._writer(name)
+            w.writerow([step, value])
+            touched.add(name)
+        for name in touched:
+            self._files[name][0].flush()
+
+    def close(self) -> None:
+        for f, _ in self._files.values():
+            if not f.closed:
+                f.flush()
+                f.close()
+        self._files.clear()
 
 
 class TensorBoardMonitor:
@@ -53,6 +74,11 @@ class TensorBoardMonitor:
             self.writer.add_scalar(name, value, step)
         self.writer.flush()
 
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+            self.writer = None
+
 
 class WandbMonitor:
     def __init__(self, project: Optional[str], team: Optional[str], group: Optional[str]):
@@ -70,6 +96,11 @@ class WandbMonitor:
         for name, value, step in events:
             self.run.log({name: value}, step=step)
 
+    def close(self) -> None:
+        if self.run is not None:
+            self.run.finish()
+            self.run = None
+
 
 class MonitorMaster:
     """Fan-out monitor (reference monitor/monitor.py:29)."""
@@ -86,3 +117,16 @@ class MonitorMaster:
     def write_events(self, events: List[Event]) -> None:
         for w in self.writers:
             w.write_events(events)
+
+    def close(self) -> None:
+        """Flush and close every writer (idempotent). Called from engine
+        shutdown — the TensorBoard writer in particular buffers events and
+        loses the tail of a run if never closed."""
+        for w in self.writers:
+            close = getattr(w, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception as e:
+                    logger.warning(f"monitor writer close failed: {e}")
+        self.writers = []

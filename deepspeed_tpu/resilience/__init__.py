@@ -1,0 +1,18 @@
+"""Resilience primitives: retries, restart accounting, preemption capture.
+
+TPU pods get preempted and collectives occasionally wedge; production
+training survives by retrying transient failures, restarting from the
+latest checkpoint (launcher/agent.py ElasticAgent), and draining cleanly
+on a preemption signal. Every such event is counted in the shared
+telemetry registry (``resilience/*`` series) so restart storms are
+visible in the same exporters as step time.
+"""
+
+from .retry import RetryError, RetryPolicy, retry_call  # noqa: F401
+from .preemption import PreemptionGuard  # noqa: F401
+from .counters import (  # noqa: F401
+    record_failure,
+    record_restart,
+    record_retry,
+    restart_count_from_env,
+)

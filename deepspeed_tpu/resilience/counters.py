@@ -1,0 +1,34 @@
+"""Restart/retry/failure counters in the shared telemetry registry."""
+
+from __future__ import annotations
+
+import os
+
+from ..telemetry.registry import get_registry
+
+
+def record_restart(n: int = 1) -> None:
+    """Count a worker restart (ElasticAgent calls this per relaunch)."""
+    get_registry().counter("resilience/restarts").inc(n)
+
+
+def record_retry(op: str = "default") -> None:
+    get_registry().counter(f"resilience/retries/{op}").inc()
+
+
+def record_failure(op: str = "default") -> None:
+    get_registry().counter(f"resilience/failures/{op}").inc()
+
+
+def restart_count_from_env() -> int:
+    """The restart generation this process is running as, from the
+    ``DST_ELASTIC_RESTART`` env the ElasticAgent exports. A trainee calls
+    this once at startup to seed its restart gauge — the agent's own
+    counter lives in the agent process, not here."""
+    try:
+        n = int(os.environ.get("DST_ELASTIC_RESTART", "0"))
+    except ValueError:
+        return 0
+    if n > 0:
+        get_registry().gauge("resilience/restart_generation").set(n)
+    return n

@@ -1,0 +1,63 @@
+"""Bounded retry with exponential backoff, instrumented.
+
+For transient host-side failures around the training loop: checkpoint
+writes to flaky filesystems, coordinator reconnects, KV-store fetches.
+NOT for device-side errors inside a compiled step — those need a restart
+(launcher/agent.py), not a retry.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Tuple, Type
+
+from ..utils.logging import logger
+from .counters import record_failure, record_retry
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted; ``__cause__`` is the last failure."""
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    max_attempts: int = 3
+    backoff_s: float = 0.5
+    backoff_multiplier: float = 2.0
+    max_backoff_s: float = 30.0
+    retry_on: Tuple[Type[BaseException], ...] = (OSError, RuntimeError)
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
+
+
+def retry_call(fn: Callable[..., Any], *args,
+               policy: RetryPolicy = RetryPolicy(),
+               op: str = "default",
+               sleep: Callable[[float], None] = time.sleep,
+               **kwargs) -> Any:
+    """Call ``fn(*args, **kwargs)``; on a ``policy.retry_on`` exception,
+    back off and retry up to ``policy.max_attempts`` total attempts.
+    Retries/failures are counted under ``resilience/{retries,failures}/{op}``.
+    """
+    delay = policy.backoff_s
+    last: BaseException
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:
+            last = e
+            if attempt == policy.max_attempts:
+                record_failure(op)
+                raise RetryError(
+                    f"{op}: {attempt} attempts failed; last: {e!r}") from e
+            record_retry(op)
+            logger.warning(
+                f"resilience: {op} attempt {attempt}/{policy.max_attempts} "
+                f"failed ({e!r}); retrying in {delay:.2f}s")
+            sleep(delay)
+            delay = min(delay * policy.backoff_multiplier,
+                        policy.max_backoff_s)
+    raise AssertionError("unreachable")  # loop always returns or raises

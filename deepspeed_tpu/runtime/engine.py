@@ -139,6 +139,11 @@ class TrainEngine:
         # -- ZeRO placement rules
         self.zero_rules = ZeroShardingRules(self.topo, config.zero)
         param_shapes = jax.eval_shape(lambda p: p, params)
+        # fp32 gradient-tree bytes: the per-step cross-'data' reduction
+        # payload the telemetry comm breakdown reports (_grad_reduce_comm)
+        self._grad_bytes = int(sum(
+            np.prod(l.shape) for l in jax.tree_util.tree_leaves(param_shapes)
+            if hasattr(l, "shape")) * 4)
         self.param_shardings = self.zero_rules.param_shardings(param_shapes, tp_specs)
         self.grad_shardings = self.zero_rules.grad_shardings(param_shapes, tp_specs)
 
@@ -271,12 +276,39 @@ class TrainEngine:
         # -- bookkeeping / observability
         self.timers = SynchronizedWallClockTimer()
         self.tput = ThroughputTimer(batch_size=config.train_batch_size,
-                                    steps_per_output=config.steps_per_print)
+                                    steps_per_output=config.steps_per_print,
+                                    monitor_memory=config.memory_breakdown)
         self.monitor = None
         if config.monitor.enabled:
             from ..monitor.monitor import MonitorMaster
 
             self.monitor = MonitorMaster(config.monitor)
+        # unified telemetry: the monitor (when enabled) is one sink among
+        # several; with telemetry AND monitor off, wants_step_records is
+        # False and the step path keeps the seed's sync discipline exactly
+        from ..telemetry import Telemetry
+
+        self.telemetry = Telemetry(config.telemetry, monitor=self.monitor)
+        if config.telemetry.enabled:
+            from ..resilience import restart_count_from_env
+            from ..telemetry import set_telemetry
+
+            # share the pipeline with the comm facade / inference engines
+            set_telemetry(self.telemetry)
+            restart_count_from_env()
+        if config.comms_logger.enabled or config.telemetry.enabled:
+            # trace-time recording is free at steady state; telemetry needs
+            # it on for the StepStats comm breakdown
+            from ..comm.comm import configure_comms_logger
+
+            configure_comms_logger(enabled=True,
+                                   verbose=config.comms_logger.verbose)
+        self._step_flops: Optional[float] = None  # per-step, from XLA cost analysis
+        self._peak_flops: Optional[float] = None
+        self._tokens_per_batch: Optional[int] = None
+        self._comm_totals_prev: Dict[str, Dict[str, float]] = {}
+        self._grad_comm_noted = False
+        self._closed = False
         self.ckpt_engine = CheckpointEngine(async_save=config.checkpoint.async_save)
 
         # compat micro-step accumulation state
@@ -577,6 +609,11 @@ class TrainEngine:
             # per-step copy-in)
             self.opt_state = jax.device_put(self.opt_state, self.opt_state_shardings)
         self._params_to_device()
+        if self.telemetry.wants_step_records and self._step_flops is None:
+            # MFU numerator from HLO cost analysis of the lowered step,
+            # measured BEFORE the donated call while the argument buffers
+            # are alive (no XLA compile — see _measure_step_flops)
+            self._measure_step_flops(batch)
         self.params, self.opt_state, self.scaler_state, self.rng, metrics = self._train_step_fn(
             self.params, self.opt_state, self.scaler_state, self.rng, batch)
         self._params_to_offload()
@@ -590,14 +627,16 @@ class TrainEngine:
         # sync_obj blocks the host until the step completes — honest per-step
         # timing, but it forbids dispatch-ahead pipelining. Only pay for it
         # when the user asked for timing (wall_clock_breakdown), when a
-        # monitor will fetch the metrics anyway (so the fetch lands inside
-        # the timed region, not the untimed gap), or at the report boundary.
+        # telemetry sink will fetch the metrics anyway (so the fetch lands
+        # inside the timed region, not the untimed gap), or at the report
+        # boundary. Telemetry off + monitor off => same sync points as seed.
         report_boundary = self.tput.will_report_next()
+        want_stats = self.telemetry.wants_step_records
         sync = metrics["loss"] if (
-            self.config.wall_clock_breakdown or self.monitor is not None
+            self.config.wall_clock_breakdown or want_stats
             or report_boundary) else None
-        self.tput.stop(sync_obj=sync, report_speed=True)
-        self._write_monitor(metrics, log_step=report_boundary)
+        step_dt = self.tput.stop(sync_obj=sync, report_speed=True)
+        self._emit_step(metrics, wall_time_s=step_dt, log_step=report_boundary)
         self._note_skipped(metrics["skipped"])
         self._last_loss = metrics["loss"]
         if self.config.memory_breakdown and report_boundary:
@@ -644,6 +683,11 @@ class TrainEngine:
         on TPU, so the split exists only at the Python API level)."""
         self._reject_if_pipelined()
         self._params_to_device()
+        # no phase timer here: forward() is an eval op in this engine
+        # (backward() recomputes through jax.grad), and it is routinely
+        # called for validation between optimizer steps — accumulating it
+        # into the next step's phase times would corrupt wall_time_s and
+        # trip false stalls
         loss, _aux = self._jitted_eval()(self.params, batch, self._next_rng())
         self._last_loss = loss
         return loss
@@ -653,12 +697,18 @@ class TrainEngine:
         engine.backward engine.py:1902 + ZeRO IPG accumulation)."""
         self._reject_if_pipelined()
         self._params_to_device()
+        self._note_batch_shape(batch, scale=self.gradient_accumulation_steps)
         if self._micro_grad_fn is None:
             self._micro_grad_fn = jax.jit(
                 lambda p, b, r, s: self._loss_and_grads(p, b, r, s)[:2],
                 out_shardings=(self.grad_shardings, None))
         scale = self.scaler_state.scale if self.config.fp16.enabled else jnp.ones([], jnp.float32)
+        want_stats = self.telemetry.wants_step_records
+        if want_stats:
+            self.timers("compat/backward").start()
         grads, loss = self._micro_grad_fn(self.params, batch, self._next_rng(), scale)
+        if want_stats:
+            self.timers("compat/backward").stop(sync_obj=loss)
         if self._acc_grads is None:
             self._acc_grads = grads
         else:
@@ -703,8 +753,13 @@ class TrainEngine:
             self._apply_update_fn = jax.jit(apply_update, donate_argnums=donate)
 
         self._params_to_device()
+        want_stats = self.telemetry.wants_step_records
+        if want_stats:
+            self.timers("compat/optimizer").start()
         self.params, self.opt_state, self.scaler_state, gnorm, skipped = self._apply_update_fn(
             self.params, self.opt_state, self.scaler_state, self._acc_grads)
+        if want_stats:
+            self.timers("compat/optimizer").stop(sync_obj=gnorm)
         self._acc_grads = None
         self._params_to_offload()
         self.global_steps += 1
@@ -713,8 +768,22 @@ class TrainEngine:
         # train_batch's report boundary lands on steps_per_print multiples
         self.tput.step_count = self.global_steps
         self._note_skipped(skipped)
-        self._write_monitor({"loss": self._last_loss, "grad_norm": gnorm,
-                             "loss_scale": self.scaler_state.scale, "skipped": skipped})
+        phase_times = None
+        wall = None
+        if want_stats:
+            # phase wall times accumulated since the last boundary. Only
+            # backward/optimizer: forward() is an eval op here (see above),
+            # so forward_s stays null on both engine paths
+            phase_times = {}
+            for phase in ("backward", "optimizer"):
+                t = self.timers.timers.get(f"compat/{phase}")
+                if t is not None and t.count:
+                    phase_times[phase] = t.elapsed_total
+                    t.reset()
+            wall = sum(phase_times.values()) or None
+        self._emit_step({"loss": self._last_loss, "grad_norm": gnorm,
+                         "loss_scale": self.scaler_state.scale, "skipped": skipped},
+                        wall_time_s=wall, phase_times=phase_times)
 
     # ==================================================================
     def eval_batch(self, batch: Any) -> Any:
@@ -734,8 +803,15 @@ class TrainEngine:
         self.rng, sub = jax.random.split(self.rng)
         return sub
 
-    def _write_monitor(self, metrics: Dict[str, Any],
-                       log_step: Optional[bool] = None) -> None:
+    def _emit_step(self, metrics: Dict[str, Any],
+                   wall_time_s: Optional[float] = None,
+                   log_step: Optional[bool] = None,
+                   phase_times: Optional[Dict[str, float]] = None) -> None:
+        """Step-boundary observability: the human log line plus — when any
+        telemetry sink is configured (JSONL/Prometheus/monitor) — one
+        StepStats span record through the unified pipeline. Replaces the
+        seed's ad-hoc ``_write_monitor``: MonitorMaster now receives its
+        Train/* events as one telemetry sink among several."""
         # keyed off the throughput timer's boundary when the caller knows it
         # (train_batch) so the blocking float() fetches below never land
         # mid-window on an unsynced step; global_steps fallback for the
@@ -748,12 +824,186 @@ class TrainEngine:
                 f"lr={self.get_lr():.3e} grad_norm={float(metrics['grad_norm']):.3f}"
                 + (f" loss_scale={float(metrics['loss_scale']):.0f}" if self.config.fp16.enabled else "")
             )
-        if self.monitor is not None:
-            self.monitor.write_events([
-                ("Train/loss", float(metrics["loss"]), self.global_steps),
-                ("Train/lr", self.get_lr(), self.global_steps),
-                ("Train/grad_norm", float(metrics["grad_norm"]), self.global_steps),
-            ])
+        if not self.telemetry.wants_step_records:
+            return
+        self.telemetry.record_step(
+            self._build_step_stats(metrics, wall_time_s, phase_times))
+
+    def _build_step_stats(self, metrics: Dict[str, Any],
+                          wall_time_s: Optional[float],
+                          phase_times: Optional[Dict[str, float]] = None):
+        from ..telemetry import StepStats
+
+        dt = float(wall_time_s) if wall_time_s else 0.0
+        tokens = self._count_batch_tokens()
+        comm, comm_s = self._comm_step_delta()
+        if self.telemetry.enabled:
+            from ..utils.memory import device_memory_stats, host_rss_gb
+
+            memory = device_memory_stats()
+            rss = host_rss_gb()
+            if rss is not None:
+                memory["host_rss_gb"] = rss
+        else:  # monitor-only: reuse the report-boundary sample, if any
+            memory = dict(self.tput.last_memory)
+        mfu = 0.0
+        if dt > 0 and self._step_flops and self._get_peak_flops():
+            mfu = self._step_flops / dt / self._get_peak_flops()
+        return StepStats(
+            step=self.global_steps,
+            wall_time_s=dt,
+            tokens_per_s=tokens / dt if dt > 0 else 0.0,
+            samples_per_s=self.train_batch_size / dt if dt > 0 else 0.0,
+            mfu=mfu,
+            loss=float(metrics["loss"]) if metrics.get("loss") is not None else None,
+            grad_norm=float(metrics["grad_norm"]) if metrics.get("grad_norm") is not None else None,
+            loss_scale=float(metrics["loss_scale"]) if self.config.fp16.enabled else None,
+            lr=self.get_lr(),
+            skipped=bool(metrics["skipped"]) if metrics.get("skipped") is not None else None,
+            forward_s=(phase_times or {}).get("forward"),
+            backward_s=(phase_times or {}).get("backward"),
+            optimizer_s=(phase_times or {}).get("optimizer"),
+            comm_s=comm_s,
+            comm=comm,
+            memory=memory,
+        )
+
+    def _count_batch_tokens(self) -> int:
+        """Tokens per optimizer step: sequence models carry [batch, seq]
+        input_ids; anything else counts samples (tokens == samples for
+        non-sequence workloads)."""
+        return (self._tokens_per_batch if self._tokens_per_batch is not None
+                else self.config.train_batch_size)
+
+    def _note_batch_shape(self, batch: Any, scale: int = 1) -> None:
+        """Latch tokens-per-step from the first observed batch. ``scale``
+        lifts a micro-batch (compat path) to the full accumulation step."""
+        if self._tokens_per_batch is not None:
+            return
+        if isinstance(batch, dict) and "input_ids" in batch:
+            self._tokens_per_batch = int(
+                np.prod(batch["input_ids"].shape)) * scale
+        else:
+            self._tokens_per_batch = self.config.train_batch_size
+
+    def _grad_reduce_comm(self):
+        """(op, entry) for this step's gradient-reduction traffic. GSPMD
+        inserts the collective inside the compiled step where the facade's
+        wrappers cannot see it, but the op and payload are determined by
+        the grad shardings: replicated grads (stage 0) reduce with an
+        all-reduce of the full fp32 tree; sharded grads (stage >= 1) with
+        a reduce-scatter. Recorded with the CommsLogger ONCE (so
+        measure_comm_latencies can replay it and log_summary shows one
+        row, not one per step) and merged into every step's breakdown
+        here; time_s comes from the backfilled record when available."""
+        dp = self.topo.data_parallel_size
+        if dp <= 1 or not self._grad_bytes:
+            return None
+        from ..comm.comm import get_comms_logger
+
+        log = get_comms_logger()
+        if not log.enabled:
+            return None
+        op = "reduce_scatter" if self.config.zero.stage >= 1 else "all_reduce"
+        if not self._grad_comm_noted:
+            log.append(op, self._grad_bytes, 0.0, dp, "data")
+            self._grad_comm_noted = True
+        else:
+            # append() fed the registry once at the one-time record; keep
+            # the exported comm/<op> counters tracking the per-step traffic
+            from ..telemetry.registry import get_registry
+
+            reg = get_registry()
+            reg.counter(f"comm/{op}/calls").inc()
+            reg.counter(f"comm/{op}/bytes").inc(self._grad_bytes)
+        durs = log.records.get(op, {}).get(self._grad_bytes, [])
+        t = durs[0] if durs and durs[0] > 0 else 0.0
+        return op, {"count": 1.0, "bytes": float(self._grad_bytes),
+                    "time_s": t}
+
+    def _comm_step_delta(self):
+        """Per-step comm breakdown: delta of the CommsLogger's cumulative
+        totals since the last emitted step. Counts/bytes are trace-time
+        facts; time_s becomes real once measure_comm_latencies backfills."""
+        from ..comm.comm import get_comms_logger
+
+        # the engine's implied gradient reduction happens EVERY step, but
+        # its CommsLogger record is a one-time synthetic append (so
+        # measure_comm_latencies can replay it). Subtract that record from
+        # the cumulative stream — including its possibly-backfilled
+        # duration — and re-inject the entry per step below; otherwise the
+        # step after a backfill would count the measured latency twice
+        # (once via the snapshot jump, once via the merge).
+        grad = self._grad_reduce_comm()
+        totals = get_comms_logger().snapshot_totals()
+        if grad is not None and grad[0] in totals:
+            cur = totals[grad[0]]
+            for k in ("count", "bytes", "time_s"):
+                cur[k] = max(0.0, cur[k] - grad[1][k])
+        delta: Dict[str, Dict[str, float]] = {}
+        comm_s = 0.0
+        for op, cur in totals.items():
+            prev = self._comm_totals_prev.get(op, {})
+            d = {k: cur[k] - prev.get(k, 0.0) for k in cur}
+            if d["count"] <= 0 and d["time_s"]:
+                # duration moved with no new records: that's a
+                # measure_comm_latencies backfill rewriting history, not
+                # traffic on this step — don't spike this step's comm_s
+                d["time_s"] = 0.0
+            if any(v for v in d.values()):
+                delta[op] = d
+                comm_s += d["time_s"]
+        self._comm_totals_prev = totals
+        if grad is not None:
+            op, entry = grad
+            if op in delta:
+                for k in entry:
+                    delta[op][k] += entry[k]
+            else:
+                delta[op] = dict(entry)
+            comm_s += entry["time_s"]
+        return delta, (comm_s if comm_s > 0 else None)
+
+    def _measure_step_flops(self, batch: Any) -> None:
+        """One-time HLO cost analysis of the fused train step (the flops
+        profiler's program counting applied to the real step). Analysis
+        runs on the LOWERED module, not a compiled one — ``.compile()``
+        here would XLA-compile the step a second time (the AOT executable
+        does not populate the jit call cache), doubling time-to-first-step
+        for large models. Pre-optimization flops differ negligibly for the
+        matmul-dominated MFU numerator."""
+        self._note_batch_shape(batch)
+        try:
+            cost = self._train_step_fn.lower(
+                self.params, self.opt_state, self.scaler_state, self.rng,
+                batch).cost_analysis()
+            if isinstance(cost, list):  # some versions return [dict]
+                cost = cost[0] if cost else {}
+            f = (cost or {}).get("flops")
+            self._step_flops = float(f) if f and f > 0 else 0.0
+        except Exception as e:  # backend without cost analysis
+            logger.debug(f"train-step cost analysis unavailable: {e}")
+            self._step_flops = 0.0
+
+    def _get_peak_flops(self) -> float:
+        if self._peak_flops is None:
+            from ..profiling.flops_profiler import _peak_flops_per_device
+
+            self._peak_flops = _peak_flops_per_device() * len(jax.devices())
+        return self._peak_flops
+
+    def close(self) -> None:
+        """Engine shutdown: flush + close every telemetry sink (including
+        the MonitorMaster adapter — the TensorBoard writer buffers events
+        and loses the run tail if never closed). Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.telemetry.close()
+        from ..telemetry import get_telemetry, set_telemetry
+
+        if get_telemetry() is self.telemetry:
+            set_telemetry(None)
 
     # ==================================================================
     # checkpointing (parity with engine.save_checkpoint engine.py:3010)

@@ -1,0 +1,37 @@
+"""Unified telemetry subsystem.
+
+One pipeline replacing the reference's scattered observability
+(utils/timer aggregates, monitor/ event tuples, comms_logging dicts,
+flops_profiler printouts): a shared :class:`MetricsRegistry`, per-step
+:class:`StepStats` span records with a validated JSONL schema, exporters
+(JSONL, Prometheus text, the legacy MonitorMaster as an adapter sink),
+and heartbeat/stall detection. See docs/observability.md.
+"""
+
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from .spans import (  # noqa: F401
+    SCHEMA_VERSION,
+    STEP_RECORD_SCHEMA,
+    StepStats,
+    validate_step_record,
+)
+from .sinks import (  # noqa: F401
+    JsonlSink,
+    MonitorSink,
+    PrometheusTextExporter,
+    render_prometheus,
+)
+from .heartbeat import Heartbeat, StallDetector  # noqa: F401
+from .telemetry import (  # noqa: F401
+    Telemetry,
+    configure_telemetry,
+    get_telemetry,
+    set_telemetry,
+)

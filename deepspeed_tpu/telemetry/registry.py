@@ -1,0 +1,193 @@
+"""Metrics registry: counters, gauges, histograms with percentile summaries.
+
+The reference scatters its numbers across ``utils/timer.py`` aggregates,
+``monitor/`` event tuples and the CommsLogger's ad-hoc dicts. This registry
+is the one shared store they all feed: plain host-side Python (no device
+traffic, no jax import), safe to update from the training loop, the
+inference engines and the comm facade alike. Exporters
+(:mod:`deepspeed_tpu.telemetry.sinks`) render snapshots of it.
+
+Metric names are ``/``-separated paths (``train/step_time_s``,
+``comm/all_reduce/bytes``); the Prometheus exporter flattens them to
+``_``-separated series names.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class Counter:
+    """Monotonically increasing count (events, bytes, retries)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name}: negative increment {n}")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-observed value (occupancy, loss scale, free blocks)."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[float] = None
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    @property
+    def value(self) -> Optional[float]:
+        return self._value
+
+
+class Histogram:
+    """Streaming distribution with percentile summaries.
+
+    Keeps exact count/sum/min/max plus a bounded window of the most recent
+    ``window`` observations for percentile estimates — deterministic (no
+    sampling) and the right bias for operational telemetry, where "p99 over
+    the recent past" beats "p99 since process start".
+    """
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_window", "_buf",
+                 "_pos", "_lock")
+
+    def __init__(self, name: str, window: int = 1024):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._window = window
+        self._buf: List[float] = []
+        self._pos = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            if len(self._buf) < self._window:
+                self._buf.append(v)
+            else:  # ring: overwrite oldest
+                self._buf[self._pos] = v
+                self._pos = (self._pos + 1) % self._window
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Linear-interpolated percentile over the recent window.
+        ``p`` in [0, 100]."""
+        with self._lock:
+            data = sorted(self._buf)
+        if not data:
+            return None
+        if len(data) == 1:
+            return data[0]
+        rank = (p / 100.0) * (len(data) - 1)
+        lo = int(rank)
+        hi = min(lo + 1, len(data) - 1)
+        frac = rank - lo
+        return data[lo] * (1.0 - frac) + data[hi] * frac
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def summary(self) -> Dict[str, Optional[float]]:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "mean": self.mean,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+
+class MetricsRegistry:
+    """Get-or-create store of named metrics.
+
+    A name is bound to one metric kind for the registry's lifetime;
+    re-requesting it with a different kind is a programming error and
+    raises instead of silently shadowing.
+    """
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric '{name}' already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str, window: int = 1024) -> Histogram:
+        return self._get(name, Histogram, window=window)
+
+    def metrics(self) -> Dict[str, object]:
+        with self._lock:
+            return dict(self._metrics)
+
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-serializable view of every metric: counters/gauges as
+        scalars, histograms as their summary dict."""
+        out: Dict[str, object] = {}
+        for name, m in self.metrics().items():
+            if isinstance(m, Histogram):
+                out[name] = m.summary()
+            else:
+                out[name] = m.value
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+# ----------------------------------------------------------------------
+# default registry: the shared store the comm facade, inference engines and
+# resilience counters feed when not handed an explicit one
+_DEFAULT = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _DEFAULT
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    global _DEFAULT
+    _DEFAULT = registry
+    return registry

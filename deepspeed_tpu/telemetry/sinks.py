@@ -1,0 +1,171 @@
+"""Telemetry sinks: where step records and registry snapshots go.
+
+A sink is anything with ``write(record: dict)`` and ``close()``. The
+``Telemetry`` facade fans each step record out to every configured sink:
+
+* :class:`JsonlSink` — structured machine-readable log, one JSON object
+  per line (the format the smoke test and golden-file test validate).
+* :class:`PrometheusTextExporter` — renders the metrics registry in the
+  Prometheus text exposition format to a file on every ``export_every``-th
+  record (atomic rename, so a scraper never reads a torn file).
+* :class:`MonitorSink` — adapts :class:`~deepspeed_tpu.monitor.monitor.
+  MonitorMaster` (TensorBoard/CSV/W&B) into this fan-out, making the
+  legacy monitor one telemetry sink among several.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional
+
+from ..utils.logging import logger
+from .registry import Counter, Gauge, Histogram, MetricsRegistry
+
+
+class JsonlSink:
+    """Append-only JSONL writer; ``flush_every`` bounds record loss on
+    crash (1 = flush per record, the default for small step counts)."""
+
+    def __init__(self, path: str, flush_every: int = 1):
+        self.path = path
+        self.flush_every = max(1, int(flush_every))
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+        self._pending = 0
+
+    def write(self, record: Dict[str, Any]) -> None:
+        self._f.write(json.dumps(record, default=_json_default) + "\n")
+        self._pending += 1
+        if self._pending >= self.flush_every:
+            self._f.flush()
+            self._pending = 0
+
+    def close(self) -> None:
+        if self._f is not None and not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+
+def _json_default(x):
+    # numpy / jax scalars that slipped into a record
+    if hasattr(x, "item"):
+        return x.item()
+    return str(x)
+
+
+_PROM_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return _PROM_NAME.sub("_", name)
+
+
+def render_prometheus(registry: MetricsRegistry,
+                      prefix: str = "dst") -> str:
+    """Render every metric in ``registry`` in the Prometheus text format.
+    Histograms export as summaries (count/sum + p50/p90/p99 quantiles)."""
+    lines = []
+    for name, m in sorted(registry.metrics().items()):
+        pname = f"{prefix}_{_prom_name(name)}"
+        if isinstance(m, Counter):
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {m.value}")
+        elif isinstance(m, Gauge):
+            if m.value is None:
+                continue
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {m.value}")
+        elif isinstance(m, Histogram):
+            lines.append(f"# TYPE {pname} summary")
+            for q in (50, 90, 99):
+                v = m.percentile(q)
+                if v is not None:
+                    lines.append(
+                        f"{pname}{{quantile=\"{q / 100}\"}} {v}")
+            lines.append(f"{pname}_sum {m.sum}")
+            lines.append(f"{pname}_count {m.count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class PrometheusTextExporter:
+    """Writes the registry to ``path`` in text exposition format. With
+    ``path=None`` it only serves :meth:`render` (pull-style use)."""
+
+    def __init__(self, registry: MetricsRegistry, path: Optional[str] = None,
+                 export_every: int = 1, prefix: str = "dst"):
+        self.registry = registry
+        self.path = path
+        self.export_every = max(1, int(export_every))
+        self.prefix = prefix
+        self._since_export = 0
+        if path:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+
+    def render(self) -> str:
+        return render_prometheus(self.registry, prefix=self.prefix)
+
+    def export(self) -> None:
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(self.render())
+        os.replace(tmp, self.path)
+
+    # sink protocol: a step record arriving is the export trigger; the
+    # content comes from the registry, not the record
+    def write(self, record: Dict[str, Any]) -> None:
+        self._since_export += 1
+        if self._since_export >= self.export_every:
+            self.export()
+            self._since_export = 0
+
+    def close(self) -> None:
+        try:
+            self.export()
+        except OSError as e:  # closing must not mask the real failure
+            logger.warning(f"prometheus export on close failed: {e}")
+
+
+class MonitorSink:
+    """Adapter: step records -> MonitorMaster scalar events. This is how
+    the legacy TensorBoard/CSV/W&B writers keep receiving the same
+    Train/* series they always did, now fed from the unified pipeline."""
+
+    # record field -> legacy event name (the series the reference's
+    # _write_monitor emitted, plus the new throughput/memory series)
+    SCALARS = (
+        ("loss", "Train/loss"),
+        ("lr", "Train/lr"),
+        ("grad_norm", "Train/grad_norm"),
+        ("wall_time_s", "Train/step_time_s"),
+        ("tokens_per_s", "Train/tokens_per_s"),
+        ("samples_per_s", "Train/samples_per_s"),
+        ("mfu", "Train/mfu"),
+    )
+
+    def __init__(self, monitor: Any):
+        self.monitor = monitor
+
+    def write(self, record: Dict[str, Any]) -> None:
+        step = int(record.get("step", 0))
+        events = []
+        for field_name, event_name in self.SCALARS:
+            v = record.get(field_name)
+            if v is not None:
+                events.append((event_name, float(v), step))
+        for k, v in (record.get("memory") or {}).items():
+            events.append((f"Memory/{k}", float(v), step))
+        if events:
+            self.monitor.write_events(events)
+
+    def close(self) -> None:
+        close = getattr(self.monitor, "close", None)
+        if close is not None:
+            close()

@@ -1,0 +1,118 @@
+"""Per-step span records and their machine-readable schema.
+
+``StepStats`` is the one record answering "where did the step time go":
+wall time, phase breakdown (where the execution model can attribute it),
+throughput, MFU, comm-time breakdown, device-memory watermarks and the
+training scalars. The JSONL sink writes one of these per step; the smoke
+test and golden-file test validate every emitted line against
+:data:`STEP_RECORD_SCHEMA`.
+
+Phase attribution caveat (TPU-first honesty): the fused ``train_batch``
+path compiles forward+backward+optimizer into ONE XLA program, so
+``forward_s``/``backward_s``/``optimizer_s`` are ``null`` there — only the
+compat ``forward()``/``backward()``/``step()`` path can time the phases
+separately from the host. ``comm`` carries the CommsLogger's per-op
+breakdown (bytes always; latencies once
+:func:`deepspeed_tpu.comm.measure_comm_latencies` has backfilled them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+# field -> (types, required). Required fields must be present and non-None
+# in every emitted record; optional fields must type-check when present.
+STEP_RECORD_SCHEMA: Dict[str, tuple] = {
+    "schema_version": ((int,), True),
+    "step": ((int,), True),
+    "timestamp": ((float, int), True),
+    "wall_time_s": ((float, int), True),
+    "tokens_per_s": ((float, int), True),
+    "samples_per_s": ((float, int), True),
+    "mfu": ((float, int), True),
+    "loss": ((float, int), False),
+    "grad_norm": ((float, int), False),
+    "loss_scale": ((float, int), False),
+    "lr": ((float, int), False),
+    "skipped": ((bool,), False),
+    "forward_s": ((float, int), False),
+    "backward_s": ((float, int), False),
+    "optimizer_s": ((float, int), False),
+    "comm_s": ((float, int), False),
+    "comm": ((dict,), True),
+    "memory": ((dict,), True),
+    "stalled": ((bool,), True),
+}
+
+
+@dataclass
+class StepStats:
+    """One training step's span record (see module docstring)."""
+
+    step: int
+    wall_time_s: float
+    tokens_per_s: float = 0.0
+    samples_per_s: float = 0.0
+    mfu: float = 0.0
+    loss: Optional[float] = None
+    grad_norm: Optional[float] = None
+    loss_scale: Optional[float] = None
+    lr: Optional[float] = None
+    skipped: Optional[bool] = None
+    forward_s: Optional[float] = None
+    backward_s: Optional[float] = None
+    optimizer_s: Optional[float] = None
+    comm_s: Optional[float] = None
+    # per-op comm breakdown: {op: {"count": int, "bytes": int, "time_s": float}}
+    comm: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    # device-memory watermarks from utils/memory.py (hbm_peak_gb, ...)
+    memory: Dict[str, float] = field(default_factory=dict)
+    stalled: bool = False
+    timestamp: float = field(default_factory=time.time)
+
+    def to_record(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["schema_version"] = SCHEMA_VERSION
+        return d
+
+
+def validate_step_record(record: Dict[str, Any]) -> List[str]:
+    """Validate one JSONL step record against :data:`STEP_RECORD_SCHEMA`.
+    Returns a list of violation strings; empty means valid."""
+    errors: List[str] = []
+    if not isinstance(record, dict):
+        return [f"record is {type(record).__name__}, expected dict"]
+    for name, (types, required) in STEP_RECORD_SCHEMA.items():
+        if name not in record or record[name] is None:
+            if required:
+                errors.append(f"missing required field '{name}'")
+            continue
+        v = record[name]
+        # bool is an int subclass; reject it where int means "number"
+        if isinstance(v, bool) and bool not in types:
+            errors.append(f"field '{name}' is bool, expected {types}")
+        elif not isinstance(v, types):
+            errors.append(
+                f"field '{name}' is {type(v).__name__}, expected {types}")
+    if isinstance(record.get("comm"), dict):
+        for op, entry in record["comm"].items():
+            if not isinstance(entry, dict):
+                errors.append(f"comm['{op}'] is not a dict")
+                continue
+            for k in ("count", "bytes", "time_s"):
+                if not isinstance(entry.get(k), (int, float)) or \
+                        isinstance(entry.get(k), bool):
+                    errors.append(f"comm['{op}']['{k}'] missing or non-numeric")
+    if isinstance(record.get("memory"), dict):
+        for k, v in record["memory"].items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                errors.append(f"memory['{k}'] non-numeric")
+    if record.get("schema_version") not in (None, SCHEMA_VERSION):
+        errors.append(
+            f"schema_version {record.get('schema_version')} != {SCHEMA_VERSION}")
+    return errors

@@ -31,15 +31,38 @@ def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> lo
 logger = _create_logger()
 
 
+_cached_process_index: Optional[int] = None
+
+
 def _process_index() -> int:
     # Avoid importing jax at module import time (tests set env vars first);
-    # also works before jax.distributed initialization.
+    # also works before jax.distributed initialization. The successful
+    # jax.process_index() result is cached — the index never changes within
+    # a process, and re-resolving it on every log_dist call costs an
+    # attribute walk into jax per log line.
+    env = os.environ.get("DST_LOG_RANK")  # test/tooling override
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            warning_once(f"DST_LOG_RANK={env!r} is not an integer; ignored")
+    global _cached_process_index
+    if _cached_process_index is not None:
+        return _cached_process_index
     try:
         import jax
 
-        return jax.process_index()
+        _cached_process_index = jax.process_index()
+        return _cached_process_index
     except Exception:
+        # not cached: jax may simply not be initialized yet
         return int(os.environ.get("RANK", "0"))
+
+
+def reset_process_index_cache() -> None:
+    """Drop the cached process index (tests; re-init after jax.distributed)."""
+    global _cached_process_index
+    _cached_process_index = None
 
 
 def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:

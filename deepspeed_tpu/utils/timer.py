@@ -83,12 +83,17 @@ class ThroughputTimer:
     def __init__(self, batch_size: int, steps_per_output: int = 50, monitor_memory: bool = False):
         self.batch_size = batch_size
         self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
         self.total_samples = 0
         self.total_time = 0.0
         self._start = None
         self.step_count = 0
         self._window_time = 0.0
         self._window_steps = 0
+        self.last_step_s: Optional[float] = None
+        # latest device-memory sample (report boundaries only, so the
+        # steady-state step never pays the allocator-stats call)
+        self.last_memory: Dict[str, float] = {}
 
     def start(self) -> None:
         self._start = time.perf_counter()
@@ -99,9 +104,9 @@ class ThroughputTimer:
         report-boundary predicate lives in exactly one place."""
         return (self.step_count + 1) % self.steps_per_output == 0
 
-    def stop(self, sync_obj: Any = None, report_speed: bool = True) -> None:
+    def stop(self, sync_obj: Any = None, report_speed: bool = True) -> Optional[float]:
         if self._start is None:
-            return
+            return None
         if sync_obj is not None:
             _fence(sync_obj)
         dt = time.perf_counter() - self._start
@@ -111,18 +116,31 @@ class ThroughputTimer:
         self.total_time += dt
         self._window_time += dt
         self._window_steps += 1
+        self.last_step_s = dt
         if report_speed and self.step_count % self.steps_per_output == 0:
             # window-averaged ms/step: under async dispatch the engine only
             # syncs at the report boundary, so the boundary step's own dt
             # covers the whole drained window — dt alone would read ~window x
             # the true step time (and ~0 on unsynced steps)
             ms = self._window_time / self._window_steps * 1e3
+            mem = ""
+            if self.monitor_memory:
+                # report boundary == already host-synced (the engine passed
+                # a sync object), so sampling allocator stats here adds no
+                # extra device round trip to the steady-state step
+                from .memory import device_memory_stats
+
+                self.last_memory = device_memory_stats()
+                if self.last_memory:
+                    mem = ", " + ", ".join(
+                        f"{k}={v}" for k, v in self.last_memory.items())
             log_dist(
                 f"step {self.step_count}: {self.avg_samples_per_sec():.2f} samples/s, "
-                f"{ms:.1f} ms/step (avg over {self._window_steps})"
+                f"{ms:.1f} ms/step (avg over {self._window_steps}){mem}"
             )
             self._window_time = 0.0
             self._window_steps = 0
+        return dt
 
     def avg_samples_per_sec(self) -> float:
         return self.total_samples / self.total_time if self.total_time else 0.0

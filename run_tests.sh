@@ -3,6 +3,26 @@
 # skips the axon TPU claim (sitecustomize registers/claims the single TPU at
 # EVERY interpreter start when PALLAS_AXON_POOL_IPS is set; concurrent
 # claims deadlock and CPU tests don't need the chip at all).
-exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+#
+# After the unit suite, the telemetry smoke test runs a tiny train loop with
+# telemetry enabled and validates every emitted JSONL step record against
+# the schema (scripts/telemetry_smoke.py exits nonzero on violation).
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest "${@:-tests/}" -q
+pytest_rc=$?
+
+smoke_rc=0
+if [ "$#" -eq 0 ]; then
+    # full-suite runs only: a targeted ./run_tests.sh tests/test_x.py
+    # shouldn't pay the smoke loop's engine build
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python scripts/telemetry_smoke.py
+    smoke_rc=$?
+fi
+
+if [ "$pytest_rc" -ne 0 ]; then
+    exit "$pytest_rc"
+fi
+exit "$smoke_rc"

@@ -93,6 +93,53 @@ def test_sparse_allreduce_matches_dense(logger_on):
     np.testing.assert_allclose(np.asarray(got)[0], dense, rtol=1e-5)
 
 
+def test_bw_math_known_payload():
+    """algbw/busbw formulas on a known payload (reference calc_bw_log,
+    utils/comms_logging.py:34): algbw = size/t; ring all-reduce moves
+    2(n-1)/n x the payload over the bus, all-gather/reduce-scatter/
+    all-to-all (n-1)/n, broadcast 1x."""
+    from deepspeed_tpu.comm.comm import _get_bw
+
+    size, dur, n = 1_000_000_000, 1.0, 8  # 1 GB in 1 s across 8 ranks
+    algbw, busbw = _get_bw("all_reduce", size, dur, n)
+    assert algbw == pytest.approx(1.0)
+    assert busbw == pytest.approx(2 * (n - 1) / n)  # 1.75 GB/s
+    for op in ("all_gather", "reduce_scatter", "all_to_all"):
+        algbw, busbw = _get_bw(op, size, dur, n)
+        assert algbw == pytest.approx(1.0)
+        assert busbw == pytest.approx((n - 1) / n)  # 0.875 GB/s
+    algbw, busbw = _get_bw("broadcast", size, dur, n)
+    assert algbw == busbw == pytest.approx(1.0)
+    # half the time => double the bandwidth
+    algbw, _ = _get_bw("all_reduce", size, 0.5, n)
+    assert algbw == pytest.approx(2.0)
+    # degenerate duration reports zeros, never divides by zero
+    assert _get_bw("all_reduce", size, 0.0, n) == (0.0, 0.0)
+
+
+def test_comms_events_flow_into_registry(logger_on):
+    """Unified telemetry: every recorded collective also lands in the
+    shared metrics registry (comm/<op>/{calls,bytes}), and the aggregate
+    snapshot the engine folds into StepStats matches."""
+    from deepspeed_tpu.telemetry import MetricsRegistry, get_registry, set_registry
+
+    old = get_registry()
+    reg = set_registry(MetricsRegistry())
+    try:
+        logger_on.append("all_reduce", 256, 0.0, 8, "data")
+        logger_on.append("all_reduce", 256, 0.0, 8, "data")
+        logger_on.append("all_gather", 128, 0.5, 8, "data")
+        assert reg.counter("comm/all_reduce/calls").value == 2
+        assert reg.counter("comm/all_reduce/bytes").value == 512
+        assert reg.counter("comm/all_gather/calls").value == 1
+        totals = logger_on.snapshot_totals()
+        assert totals["all_reduce"] == {"count": 2, "bytes": 512, "time_s": 0.0}
+        assert totals["all_gather"] == {"count": 1, "bytes": 128,
+                                        "time_s": pytest.approx(0.5)}
+    finally:
+        set_registry(old)
+
+
 def test_reduce_gather_scatter(logger_on):
     topo = Topology.build_virtual({"data": 4})
     set_topology(topo)

@@ -1,0 +1,378 @@
+"""Unified telemetry subsystem: registry/percentiles, JSONL step-record
+schema from a tiny train loop, stall detection, exporters, monitor handle
+caching + close, resilience counters, cached log rank."""
+
+import json
+import logging
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.telemetry import (
+    Histogram,
+    JsonlSink,
+    MetricsRegistry,
+    StallDetector,
+    StepStats,
+    Telemetry,
+    get_telemetry,
+    render_prometheus,
+    set_registry,
+    set_telemetry,
+    validate_step_record,
+)
+from simple_model import init_mlp_params, make_batch, mlp_loss
+
+
+@pytest.fixture(autouse=True)
+def _isolate_global_telemetry():
+    """Each test gets a fresh default registry and no global pipeline."""
+    old = set_registry(MetricsRegistry())
+    set_telemetry(None)
+    yield
+    set_registry(old)
+    set_telemetry(None)
+
+
+# ----------------------------------------------------------------------
+# MetricsRegistry
+def test_counter_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("a/b")
+    c.inc()
+    c.inc(2.5)
+    assert reg.counter("a/b").value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("g")
+    assert g.value is None
+    g.set(7)
+    assert reg.gauge("g").value == 7.0
+
+
+def test_registry_kind_conflict_raises():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_histogram_percentiles_known_data():
+    h = Histogram("h")
+    for v in range(100):  # 0..99
+        h.observe(float(v))
+    assert h.count == 100
+    assert h.min == 0.0 and h.max == 99.0
+    assert h.mean == pytest.approx(49.5)
+    # linear interpolation over the sorted window
+    assert h.percentile(0) == 0.0
+    assert h.percentile(100) == 99.0
+    assert h.percentile(50) == pytest.approx(49.5)
+    assert h.percentile(90) == pytest.approx(89.1)
+    assert h.percentile(99) == pytest.approx(98.01)
+
+
+def test_histogram_window_keeps_recent():
+    h = Histogram("h", window=10)
+    for v in range(100):
+        h.observe(float(v))
+    # exact aggregates cover everything; percentiles only the window
+    assert h.count == 100
+    assert h.percentile(0) >= 90.0
+    summ = h.summary()
+    assert summ["count"] == 100 and summ["max"] == 99.0
+
+
+def test_snapshot_shapes():
+    reg = MetricsRegistry()
+    reg.counter("c").inc(2)
+    reg.gauge("g").set(1.5)
+    reg.histogram("h").observe(3.0)
+    snap = reg.snapshot()
+    assert snap["c"] == 2.0 and snap["g"] == 1.5
+    assert snap["h"]["count"] == 1 and snap["h"]["p50"] == 3.0
+
+
+# ----------------------------------------------------------------------
+# exporters
+def test_prometheus_render():
+    reg = MetricsRegistry()
+    reg.counter("train/steps").inc(5)
+    reg.gauge("inference/kv_occupancy").set(0.25)
+    reg.histogram("train/step_time_s").observe(0.1)
+    text = render_prometheus(reg)
+    assert "# TYPE dst_train_steps counter" in text
+    assert "dst_train_steps 5.0" in text
+    assert "dst_inference_kv_occupancy 0.25" in text
+    assert 'dst_train_step_time_s{quantile="0.5"} 0.1' in text
+    assert "dst_train_step_time_s_count 1" in text
+
+
+def test_jsonl_sink_roundtrip(tmp_path):
+    path = str(tmp_path / "out.jsonl")
+    sink = JsonlSink(path)
+    sink.write({"a": 1, "np": np.float32(2.5)})
+    sink.close()
+    rec = json.loads(open(path).read())
+    assert rec == {"a": 1, "np": 2.5}
+
+
+# ----------------------------------------------------------------------
+# stall detector
+def test_stall_detector_flags_slow_step():
+    det = StallDetector(window=10, factor=3.0, warmup_steps=2)
+    flagged = []
+    for i in range(10):
+        assert det.observe(i, 0.1) is False
+    assert det.observe(99, 0.5) is True  # 5x the 0.1 median
+    assert det.stall_count == 1
+    # within-factor step after the stall is clean
+    assert det.observe(100, 0.15) is False
+
+
+def test_stall_detector_warmup_absorbs_compile():
+    det = StallDetector(window=10, factor=3.0, warmup_steps=2)
+    # compile steps: huge, but inside warmup -> never flagged, never
+    # polluting the window
+    assert det.observe(0, 30.0) is False
+    assert det.observe(1, 25.0) is False
+    for i in range(2, 8):
+        assert det.observe(i, 0.1) is False
+    assert det.observe(8, 1.0) is True
+
+
+def test_stall_factor_validation():
+    with pytest.raises(ValueError):
+        StallDetector(factor=1.0)
+
+
+# ----------------------------------------------------------------------
+# schema
+def test_validate_step_record_catches_violations():
+    good = StepStats(step=1, wall_time_s=0.1).to_record()
+    assert validate_step_record(good) == []
+    bad = dict(good)
+    del bad["wall_time_s"]
+    bad["comm"] = {"all_reduce": {"count": 1}}  # missing bytes/time_s
+    errs = validate_step_record(bad)
+    assert any("wall_time_s" in e for e in errs)
+    assert any("all_reduce" in e for e in errs)
+    assert validate_step_record({"step": "x"})  # junk record -> errors
+
+
+# ----------------------------------------------------------------------
+# golden: 3-step tiny train loop emits schema-valid records
+def _train_with_telemetry(tmp_path, steps=3, extra_cfg=None, tag="t"):
+    out = str(tmp_path / tag)
+    cfg = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "steps_per_print": 1000,
+        "telemetry": {"enabled": True, "output_dir": out,
+                      "prometheus_path": os.path.join(out, "metrics.prom"),
+                      "export_every": 1},
+    }
+    for k, v in (extra_cfg or {}).items():
+        cfg[k] = v
+    params = init_mlp_params(jax.random.PRNGKey(0))
+    engine, _, _, _ = dst.initialize(loss_fn=mlp_loss, params=params, config=cfg)
+    batch = make_batch(16)
+    for _ in range(steps):
+        engine.train_batch(batch)
+    engine.close()
+    lines = open(os.path.join(out, "steps.jsonl")).read().splitlines()
+    return engine, [json.loads(ln) for ln in lines], out
+
+
+def test_train_loop_jsonl_schema(tmp_path):
+    engine, records, out = _train_with_telemetry(
+        tmp_path, steps=3, extra_cfg={"zero_optimization": {"stage": 1}})
+    assert len(records) == 3
+    for i, rec in enumerate(records):
+        assert validate_step_record(rec) == [], validate_step_record(rec)
+        assert rec["step"] == i + 1
+        assert rec["wall_time_s"] > 0
+        assert rec["tokens_per_s"] > 0
+        assert rec["loss"] is not None
+        # dp=8 stage-1: the grad reduction shows up in the comm breakdown
+        assert "reduce_scatter" in rec["comm"]
+        assert rec["comm"]["reduce_scatter"]["bytes"] > 0
+    # prometheus file exported and parseable
+    prom = open(os.path.join(out, "metrics.prom")).read()
+    assert "dst_train_steps 3.0" in prom
+    # close() is idempotent and cleared the global pipeline
+    engine.close()
+    assert get_telemetry().enabled is False
+
+
+def test_telemetry_off_keeps_engine_lean(tmp_path):
+    cfg = {"train_batch_size": 16,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000}
+    params = init_mlp_params(jax.random.PRNGKey(0))
+    engine, _, _, _ = dst.initialize(loss_fn=mlp_loss, params=params, config=cfg)
+    assert engine.telemetry.wants_step_records is False
+    assert engine.telemetry.sinks == []
+    engine.train_batch(make_batch(16))
+    engine.close()
+
+
+def test_compat_path_phase_times(tmp_path):
+    out = str(tmp_path / "compat")
+    cfg = {"train_batch_size": 16,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000,
+           "telemetry": {"enabled": True, "output_dir": out}}
+    params = init_mlp_params(jax.random.PRNGKey(0))
+    engine, _, _, _ = dst.initialize(loss_fn=mlp_loss, params=params, config=cfg)
+    batch = make_batch(16)
+    engine.backward(batch)
+    engine.step()
+    jsonl = os.path.join(out, "steps.jsonl")
+    engine.close()
+    recs = [json.loads(ln) for ln in open(jsonl).read().splitlines()]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert validate_step_record(rec) == []
+    assert rec["backward_s"] > 0 and rec["optimizer_s"] > 0
+
+
+# ----------------------------------------------------------------------
+# monitor satellite
+def test_csv_monitor_caches_handles(tmp_path):
+    from deepspeed_tpu.monitor.monitor import CsvMonitor
+
+    mon = CsvMonitor(str(tmp_path), "job")
+    mon.write_events([("Train/loss", 1.0, 1), ("Train/loss", 0.5, 2)])
+    mon.write_events([("Train/loss", 0.25, 3)])
+    assert len(mon._files) == 1  # one cached handle, not one per event
+    mon.close()
+    assert mon._files == {}
+    lines = open(os.path.join(str(tmp_path), "job",
+                              "Train_loss.csv")).read().splitlines()
+    assert lines[0].startswith("step")
+    assert len(lines) == 4  # header + 3 events, single header
+
+
+def test_monitor_master_close_idempotent(tmp_path):
+    from deepspeed_tpu.config import MonitorConfig
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+    cfg = MonitorConfig(csv_enabled=True, csv_output_path=str(tmp_path),
+                        csv_job_name="job")
+    m = MonitorMaster(cfg)
+    m.write_events([("Train/loss", 1.0, 1)])
+    m.close()
+    m.close()  # second close is a no-op
+    assert m.writers == []
+
+
+def test_monitor_is_a_telemetry_sink(tmp_path):
+    from deepspeed_tpu.config import MonitorConfig
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+    mon = MonitorMaster(MonitorConfig(csv_enabled=True,
+                                      csv_output_path=str(tmp_path),
+                                      csv_job_name="job"))
+    t = Telemetry(config=None, monitor=mon)
+    assert t.wants_step_records  # monitor present => per-step records
+    t.record_step(StepStats(step=1, wall_time_s=0.1, loss=2.0))
+    t.close()
+    loss_csv = os.path.join(str(tmp_path), "job", "Train_loss.csv")
+    assert [ln.split(",") for ln in open(loss_csv).read().splitlines()][1] == ["1", "2.0"]
+
+
+def test_record_request_series(tmp_path):
+    class Cfg:
+        enabled = True
+        output_dir = str(tmp_path / "req")
+
+    t = Telemetry(config=Cfg())
+    t.record_request(latency_s=0.5, ttft_s=0.1, new_tokens=8,
+                     decode_tokens_per_s=17.5)
+    t.record_request(latency_s=0.7)
+    r = t.registry
+    assert r.counter("inference/requests").value == 2
+    assert r.counter("inference/generated_tokens").value == 8
+    assert r.histogram("inference/ttft_s").count == 1
+    assert r.histogram("inference/request_latency_s").percentile(100) == 0.7
+    t.close()
+    # the disabled global stub drops request metrics silently
+    get_telemetry().record_request(latency_s=1.0)
+    assert "inference/requests" not in get_telemetry().registry.metrics() or \
+        get_telemetry().registry.counter("inference/requests").value == 2
+
+
+# ----------------------------------------------------------------------
+# resilience
+def test_retry_call_counts_and_succeeds():
+    from deepspeed_tpu.resilience import RetryPolicy, retry_call
+    from deepspeed_tpu.telemetry import get_registry
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = retry_call(flaky, policy=RetryPolicy(max_attempts=5, backoff_s=0),
+                     op="ckpt", sleep=lambda _: None)
+    assert out == "ok" and calls["n"] == 3
+    assert get_registry().counter("resilience/retries/ckpt").value == 2
+
+
+def test_retry_call_exhaustion_raises():
+    from deepspeed_tpu.resilience import RetryError, RetryPolicy, retry_call
+    from deepspeed_tpu.telemetry import get_registry
+
+    def always_fails():
+        raise RuntimeError("nope")
+
+    with pytest.raises(RetryError):
+        retry_call(always_fails, policy=RetryPolicy(max_attempts=2, backoff_s=0),
+                   op="x", sleep=lambda _: None)
+    assert get_registry().counter("resilience/failures/x").value == 1
+
+
+def test_preemption_guard_flag():
+    from deepspeed_tpu.resilience import PreemptionGuard
+
+    with PreemptionGuard(signals=()) as guard:
+        assert guard.should_stop is False
+        guard.request_stop()
+        assert guard.should_stop is True
+
+
+# ----------------------------------------------------------------------
+# logging satellite
+def test_log_dist_env_override(monkeypatch):
+    from deepspeed_tpu.utils import logging as dlog
+
+    monkeypatch.setenv("DST_LOG_RANK", "3")
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append  # the package logger does not propagate
+    dlog.logger.addHandler(handler)
+    try:
+        dlog.log_dist("only-rank-0")          # filtered: we are "rank 3"
+        dlog.log_dist("rank-3-message", ranks=[3])
+        dlog.log_dist("everyone", ranks=[-1])
+    finally:
+        dlog.logger.removeHandler(handler)
+    text = "\n".join(r.getMessage() for r in records)
+    assert "only-rank-0" not in text
+    assert "rank-3-message" in text and "[Rank 3]" in text
+    assert "everyone" in text
+
+
+def test_process_index_cached(monkeypatch):
+    from deepspeed_tpu.utils import logging as dlog
+
+    dlog.reset_process_index_cache()
+    assert dlog._process_index() == 0
+    assert dlog._cached_process_index == 0  # cached after first resolution
