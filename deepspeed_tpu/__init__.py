@@ -108,6 +108,14 @@ def initialize(*,
     if training_data is not None:
         dataloader = DataLoader(training_data, cfg.train_batch_size, topology,
                                 seed=cfg.train_seed, collate_fn=collate_fn)
+        # checkpoints carry the loader position (epoch + batch index) so a
+        # resumed run replays the exact remaining batch order
+        engine.bind_dataloader(dataloader)
+    if cfg.checkpoint.auto_resume and cfg.checkpoint.save_dir:
+        # preemption-safe auto-resume (docs/fault_tolerance.md): pick up
+        # from the newest VALID checkpoint — torn/corrupt tags are skipped
+        # by the manifest verification; a missing dir is first boot
+        engine.load_checkpoint(cfg.checkpoint.save_dir, auto=True)
     return engine, engine.optimizer, dataloader, engine.lr_schedule
 
 

@@ -181,10 +181,18 @@ def _nbytes(x: Any) -> int:
         return 0
 
 
+# chaos hook: resilience.chaos.install_fault_injector points this at the
+# installed FaultInjector's on_collective (delay/fail injection for the
+# fault-tolerance tests). None = zero overhead on every facade call.
+_CHAOS_HOOK = None
+
+
 def _record(op: str, x: Any, axis_name: Optional[str]) -> None:
     # Inside jit the transfer can't be timed at the call site (XLA schedules
     # it); record op/size/axis now, measure_comm_latencies() backfills real
     # durations via timed standalone replays.
+    if _CHAOS_HOOK is not None:
+        _CHAOS_HOOK(op)
     _COMMS_LOGGER.append(op, _nbytes(x), 0.0, 0, axis_name)
 
 
@@ -311,6 +319,8 @@ def get_local_rank() -> int:
 def barrier() -> None:
     """Cross-process barrier (reference comm/comm.py:406). A tiny all-reduce
     over every addressable device forces synchronization."""
+    if _CHAOS_HOOK is not None:
+        _CHAOS_HOOK("barrier")
     x = jnp.ones((jax.device_count(),))
     jax.block_until_ready(
         jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x.reshape(jax.local_device_count(), -1)[:, 0])
@@ -409,6 +419,8 @@ def scatter(x, axis_name: str, src_index: int = 0, axis: int = 0):
         raise ValueError(
             f"scatter: dim {axis} size {x.shape[axis]} not divisible by "
             f"axis size {world} (torch scatter errors on unequal chunks too)")
+    if _CHAOS_HOOK is not None:
+        _CHAOS_HOOK("scatter")
     _COMMS_LOGGER.append("scatter", max(_nbytes(x) // world, 1), 0.0, 0,
                          axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -430,6 +442,8 @@ def sparse_allreduce(rows, indices, axis_name: str, dense_dim: int):
     equal across ranks (pad with a repeated index — scatter-add makes
     duplicate indices safe)."""
     # wire payload = rows AND indices (both all_gathered below)
+    if _CHAOS_HOOK is not None:
+        _CHAOS_HOOK("sparse_allreduce")
     _COMMS_LOGGER.append("sparse_allreduce",
                          _nbytes(rows) + _nbytes(indices), 0.0, 0, axis_name)
     rows_all = jax.lax.all_gather(rows, axis_name, axis=0, tiled=True)

@@ -521,13 +521,22 @@ class PipelineConfig:
 
 @dataclass
 class CheckpointConfig:
-    """Mirrors reference ``checkpoint`` block (tag validation, parallel write)."""
+    """Mirrors reference ``checkpoint`` block (tag validation, parallel
+    write), extended with the fault-tolerance knobs
+    (docs/fault_tolerance.md): a save dir the engine auto-saves to and
+    rolls back from, auto-resume on startup, keep-last-N garbage
+    collection, and manifest checksum verification on load."""
 
     tag_validation: str = "Warn"  # Ignore | Warn | Fail
     load_universal: bool = False
     use_node_local_storage: bool = False
     parallel_write_pipeline: bool = False
     async_save: bool = False
+    save_dir: Optional[str] = None   # enables auto-save / rollback / emergency saves
+    auto_resume: bool = False        # initialize() loads the newest valid tag
+    save_interval: int = 0           # auto-save every N steps (0 = off)
+    keep_last_n: int = 0             # GC old valid tags (0 = keep all)
+    verify_checksums: bool = True    # manifest CRC verification on load
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "CheckpointConfig":
@@ -542,9 +551,135 @@ class CheckpointConfig:
             if isinstance(d.get("parallel_write"), dict)
             else False,
             async_save=bool(_take(d, "async_save", False)),
+            save_dir=_take(d, "save_dir", None),
+            auto_resume=bool(_take(d, "auto_resume", False)),
+            save_interval=int(_take(d, "save_interval", 0)),
+            keep_last_n=int(_take(d, "keep_last_n", 0)),
+            verify_checksums=bool(_take(d, "verify_checksums", True)),
         )
         d.pop("parallel_write", None)
+        if out.save_interval < 0:
+            raise ConfigError(f"checkpoint.save_interval must be >= 0, got {out.save_interval}")
+        if out.keep_last_n < 0:
+            raise ConfigError(f"checkpoint.keep_last_n must be >= 0, got {out.keep_last_n}")
         _warn_unknown(d, "checkpoint")
+        return out
+
+
+@dataclass
+class DivergenceConfig:
+    """Divergence guards in the engine step path (resilience/divergence.py).
+
+    ``nan_action``: off | skip | rollback | halt — "skip" compiles the
+    non-finite check into the train step (old params kept on-device, zero
+    extra host syncs); rollback/halt fetch the loss each step.
+    ``spike_action``: off | warn | rollback | halt — loss exceeding
+    ``spike_factor`` x the rolling median of the last ``window`` finite
+    losses (after ``warmup_steps``).
+    """
+
+    nan_action: str = "off"
+    spike_action: str = "off"
+    spike_factor: float = 10.0
+    window: int = 20
+    warmup_steps: int = 5
+    # rollbacks that fail to progress past the previously-diverging step
+    # escalate to halt after this many attempts (a deterministic NaN
+    # replays bit-exactly — unbounded rollback would loop forever)
+    max_rollbacks: int = 2
+
+    @property
+    def wants_host_check(self) -> bool:
+        return self.nan_action in ("rollback", "halt") or self.spike_action != "off"
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "DivergenceConfig":
+        if not d:
+            return cls()
+        d = dict(d)
+        out = cls(
+            nan_action=str(_take(d, "nan_action", "off")).lower(),
+            spike_action=str(_take(d, "spike_action", "off")).lower(),
+            spike_factor=float(_take(d, "spike_factor", 10.0)),
+            window=int(_take(d, "window", 20)),
+            warmup_steps=int(_take(d, "warmup_steps", 5)),
+            max_rollbacks=int(_take(d, "max_rollbacks", 2)),
+        )
+        if out.max_rollbacks < 1:
+            raise ConfigError(
+                f"divergence.max_rollbacks must be >= 1, got {out.max_rollbacks}")
+        if out.nan_action not in ("off", "skip", "rollback", "halt"):
+            raise ConfigError(f"divergence.nan_action must be off|skip|rollback|halt, got {out.nan_action!r}")
+        if out.spike_action not in ("off", "warn", "rollback", "halt"):
+            raise ConfigError(f"divergence.spike_action must be off|warn|rollback|halt, got {out.spike_action!r}")
+        if out.spike_action != "off" and out.spike_factor <= 1.0:
+            raise ConfigError(f"divergence.spike_factor must exceed 1.0, got {out.spike_factor}")
+        _warn_unknown(d, "resilience.divergence")
+        return out
+
+
+@dataclass
+class ChaosConfig:
+    """Seeded fault injection (resilience/chaos.py FaultInjector). All
+    ``*_at_save`` are 1-based save counts, ``*_at_step`` match the engine's
+    ``global_steps`` at the start of a train_batch; -1 disables."""
+
+    enabled: bool = False
+    seed: int = 0
+    crash_before_commit_at_save: int = -1
+    crash_after_commit_at_save: int = -1
+    corrupt_shard_at_save: int = -1
+    sigterm_at_step: int = -1
+    crash_at_step: int = -1
+    exit_process: bool = False  # os._exit instead of raising InjectedFault
+    exit_code: int = 113
+    collective_fail_op: str = ""
+    collective_fail_at_call: int = -1
+    collective_delay_s: float = 0.0
+    collective_delay_every: int = 0
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ChaosConfig":
+        if not d:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_take(d, "enabled", False)),
+            seed=int(_take(d, "seed", 0)),
+            crash_before_commit_at_save=int(_take(d, "crash_before_commit_at_save", -1)),
+            crash_after_commit_at_save=int(_take(d, "crash_after_commit_at_save", -1)),
+            corrupt_shard_at_save=int(_take(d, "corrupt_shard_at_save", -1)),
+            sigterm_at_step=int(_take(d, "sigterm_at_step", -1)),
+            crash_at_step=int(_take(d, "crash_at_step", -1)),
+            exit_process=bool(_take(d, "exit_process", False)),
+            exit_code=int(_take(d, "exit_code", 113)),
+            collective_fail_op=str(_take(d, "collective_fail_op", "")),
+            collective_fail_at_call=int(_take(d, "collective_fail_at_call", -1)),
+            collective_delay_s=float(_take(d, "collective_delay_s", 0.0)),
+            collective_delay_every=int(_take(d, "collective_delay_every", 0)),
+        )
+        _warn_unknown(d, "resilience.chaos")
+        return out
+
+
+@dataclass
+class ResilienceConfig:
+    """The ``resilience`` block: divergence guards + chaos injection
+    (docs/fault_tolerance.md)."""
+
+    divergence: DivergenceConfig = field(default_factory=DivergenceConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ResilienceConfig":
+        if not d:
+            return cls()
+        d = dict(d)
+        out = cls(
+            divergence=DivergenceConfig.from_dict(_take(d, "divergence", None)),
+            chaos=ChaosConfig.from_dict(_take(d, "chaos", None)),
+        )
+        _warn_unknown(d, "resilience")
         return out
 
 
@@ -610,6 +745,7 @@ class Config:
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
 
     raw: Dict[str, Any] = field(default_factory=dict)
@@ -672,6 +808,7 @@ class Config:
             comms_logger=CommsLoggerConfig.from_dict(_take(d, "comms_logger", None)),
             pipeline=PipelineConfig.from_dict(_take(d, "pipeline", None)),
             checkpoint=CheckpointConfig.from_dict(_take(d, "checkpoint", None)),
+            resilience=ResilienceConfig.from_dict(_take(d, "resilience", None)),
             data_efficiency=DataEfficiencyConfig.from_dict(_take(d, "data_efficiency", None)),
             raw=raw,
         )

@@ -11,22 +11,45 @@ process supervisor: it launches the training command, watches for
 failure, and restarts it up to ``max_restarts`` times with
 ``DST_ELASTIC_RESTART=<n>`` exported so the trainee knows to resume from
 its latest checkpoint (resume-from-latest is the recovery mechanism —
-SURVEY §5.3; cross-mesh resume is already checkpoint-native). A restart
-honors an optional backoff and re-reads the world size from the
-environment, so a shrunk slice resumes with a recomputed elastic batch
-config (elasticity/elasticity.py compute_elastic_config).
+SURVEY §5.3; cross-mesh resume is already checkpoint-native). Restarts
+back off exponentially with jitter (bounded by ``max_backoff_s``); a
+worker that ran "healthily" (longer than ``healthy_after_s``) resets the
+backoff, so a restart storm after a long stable run starts gentle again.
+
+Every restart is classified (``exit:<rc>`` / ``signal:<name>``) and
+surfaced two ways (docs/fault_tolerance.md):
+ * the telemetry registry (``resilience/restarts`` plus
+   ``resilience/restart_reasons/<reason>``), and
+ * the worker's heartbeat file, overwritten with
+   ``{"state": "restarting", "restarts": n, "reason": ...}`` while the
+   worker is down — an external watchdog watching the heartbeat can tell
+   "restarting" from "hung" instead of paging on every relaunch window.
 """
 
 from __future__ import annotations
 
 import os
+import random
+import signal
 import subprocess
 import sys
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence
 
+from ..utils.fileio import write_json_atomic
 from ..utils.logging import logger
+
+
+def classify_exit(returncode: int) -> str:
+    """Human-readable restart reason from a worker's return code."""
+    if returncode < 0:
+        try:
+            name = signal.Signals(-returncode).name
+        except ValueError:
+            name = str(-returncode)
+        return f"signal:{name}"
+    return f"exit:{returncode}"
 
 
 @dataclass
@@ -34,6 +57,7 @@ class AgentReport:
     restarts: int
     returncode: int
     history: List[int] = field(default_factory=list)
+    reasons: List[str] = field(default_factory=list)
 
     @property
     def succeeded(self) -> bool:
@@ -46,45 +70,113 @@ class ElasticAgent:
 
     def __init__(self, cmd: Sequence[str], max_restarts: int = 3,
                  backoff_s: float = 1.0,
+                 backoff_multiplier: float = 2.0,
+                 max_backoff_s: float = 60.0,
+                 jitter: float = 0.25,
+                 healthy_after_s: Optional[float] = None,
+                 heartbeat_path: Optional[str] = None,
                  env: Optional[dict] = None,
-                 on_restart: Optional[Callable[[int], None]] = None):
+                 on_restart: Optional[Callable[[int], None]] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: Optional[random.Random] = None):
         self.cmd = list(cmd)
         self.max_restarts = max_restarts
         self.backoff_s = backoff_s
+        self.backoff_multiplier = backoff_multiplier
+        self.max_backoff_s = max_backoff_s
+        self.jitter = jitter
+        self.healthy_after_s = healthy_after_s
+        self.heartbeat_path = heartbeat_path
         self.env = dict(env if env is not None else os.environ)
         self.on_restart = on_restart
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+
+    # ------------------------------------------------------------------
+    def _write_status(self, state: str, restarts: int,
+                      reason: Optional[str] = None,
+                      next_delay_s: Optional[float] = None) -> None:
+        """Overwrite the worker's heartbeat file with the agent's view —
+        same atomic-rename discipline as telemetry.heartbeat.Heartbeat."""
+        if not self.heartbeat_path:
+            return
+        d = os.path.dirname(self.heartbeat_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        rec = {"state": state, "restarts": int(restarts),
+               "time": time.time()}
+        if reason is not None:
+            rec["reason"] = reason
+        if next_delay_s is not None:
+            rec["next_delay_s"] = round(float(next_delay_s), 3)
+        try:
+            write_json_atomic(self.heartbeat_path, rec)
+        except OSError as e:  # status is best-effort, never fatal
+            logger.warning(f"elastic agent: heartbeat write failed: {e}")
 
     def run(self) -> AgentReport:
         history: List[int] = []
+        reasons: List[str] = []
+        delay = self.backoff_s
         for attempt in range(self.max_restarts + 1):
             env = dict(self.env, DST_ELASTIC_RESTART=str(attempt))
+            self._write_status("running", attempt)
+            t0 = time.monotonic()
             proc = subprocess.run(self.cmd, env=env)
+            elapsed = time.monotonic() - t0
             history.append(proc.returncode)
             if proc.returncode == 0:
+                self._write_status("done", attempt)
                 return AgentReport(restarts=attempt, returncode=0,
-                                   history=history)
+                                   history=history, reasons=reasons)
+            reason = classify_exit(proc.returncode)
+            reasons.append(reason)
             logger.warning(
-                f"elastic agent: worker failed rc={proc.returncode} "
+                f"elastic agent: worker failed ({reason}) "
                 f"(attempt {attempt + 1}/{self.max_restarts + 1})")
             if attempt < self.max_restarts:
                 from ..resilience import record_restart
+                from ..telemetry.registry import get_registry
 
                 record_restart()
+                get_registry().counter(
+                    f"resilience/restart_reasons/{reason}").inc()
                 if self.on_restart is not None:
                     self.on_restart(attempt)
-                time.sleep(self.backoff_s)
+                if (self.healthy_after_s is not None
+                        and elapsed >= self.healthy_after_s):
+                    # a long stable run before this failure: fresh incident,
+                    # restart the backoff schedule from the bottom
+                    delay = self.backoff_s
+                d = delay * (1.0 + self._rng.uniform(0.0, self.jitter))
+                self._write_status("restarting", attempt + 1, reason=reason,
+                                   next_delay_s=d)
+                self._sleep(d)
+                delay = min(delay * self.backoff_multiplier,
+                            self.max_backoff_s)
+        self._write_status("failed", self.max_restarts,
+                           reason=reasons[-1] if reasons else None)
         return AgentReport(restarts=self.max_restarts,
-                           returncode=history[-1], history=history)
+                           returncode=history[-1], history=history,
+                           reasons=reasons)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     """CLI: ``python -m deepspeed_tpu.launcher.agent [--max-restarts N]
+    [--backoff S] [--max-backoff S] [--jitter F] [--heartbeat PATH]
     -- cmd args...``"""
     import argparse
 
     p = argparse.ArgumentParser(prog="deepspeed_tpu.launcher.agent")
     p.add_argument("--max-restarts", type=int, default=3)
     p.add_argument("--backoff", type=float, default=1.0)
+    p.add_argument("--backoff-multiplier", type=float, default=2.0)
+    p.add_argument("--max-backoff", type=float, default=60.0)
+    p.add_argument("--jitter", type=float, default=0.25)
+    p.add_argument("--healthy-after", type=float, default=None,
+                   help="runs longer than this reset the backoff (seconds)")
+    p.add_argument("--heartbeat", type=str, default=None,
+                   help="status file overwritten while the worker is down")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="training command (prefix with --)")
     args = p.parse_args(argv)
@@ -92,9 +184,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not cmd:
         p.error("no command given")
     report = ElasticAgent(cmd, max_restarts=args.max_restarts,
-                          backoff_s=args.backoff).run()
+                          backoff_s=args.backoff,
+                          backoff_multiplier=args.backoff_multiplier,
+                          max_backoff_s=args.max_backoff,
+                          jitter=args.jitter,
+                          healthy_after_s=args.healthy_after,
+                          heartbeat_path=args.heartbeat).run()
     logger.info(f"elastic agent: done restarts={report.restarts} "
-                f"rc={report.returncode}")
+                f"rc={report.returncode} reasons={report.reasons}")
     return report.returncode
 
 

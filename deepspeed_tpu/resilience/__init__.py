@@ -1,18 +1,34 @@
-"""Resilience primitives: retries, restart accounting, preemption capture.
+"""Resilience primitives: retries, restart accounting, preemption capture,
+divergence guards, and deterministic fault injection.
 
 TPU pods get preempted and collectives occasionally wedge; production
 training survives by retrying transient failures, restarting from the
-latest checkpoint (launcher/agent.py ElasticAgent), and draining cleanly
-on a preemption signal. Every such event is counted in the shared
-telemetry registry (``resilience/*`` series) so restart storms are
-visible in the same exporters as step time.
+latest checkpoint (launcher/agent.py ElasticAgent), draining cleanly on a
+preemption signal, and refusing to stream NaNs into the optimizer state.
+Every such event is counted in the shared telemetry registry
+(``resilience/*`` series) so restart storms are visible in the same
+exporters as step time. The chaos harness (:mod:`.chaos`) makes each
+failure mode a seeded, deterministic event so the recovery paths stay
+tested (tests/test_fault_tolerance.py, scripts/chaos_smoke.py).
 """
 
-from .retry import RetryError, RetryPolicy, retry_call  # noqa: F401
+from .retry import RetryBudget, RetryError, RetryPolicy, retry_call  # noqa: F401
 from .preemption import PreemptionGuard  # noqa: F401
+from .divergence import DivergenceError, DivergenceGuard  # noqa: F401
+from .chaos import (  # noqa: F401
+    CollectiveFault,
+    FaultInjector,
+    InjectedFault,
+    corrupt_tag,
+    get_fault_injector,
+    install_fault_injector,
+)
 from .counters import (  # noqa: F401
+    record_attempt,
+    record_emergency_save,
     record_failure,
     record_restart,
     record_retry,
+    record_rollback,
     restart_count_from_env,
 )

@@ -1,0 +1,234 @@
+"""Deterministic fault injection for exercising recovery paths.
+
+Production failure modes — a SIGTERM mid-save, a torn shard, a wedged
+collective — are rare and unreproducible in the wild, which makes the
+recovery code that handles them the least-tested code in the stack. The
+:class:`FaultInjector` turns each of them into a seeded, deterministic
+event so tests (tests/test_fault_tolerance.py) and the chaos smoke loop
+(scripts/chaos_smoke.py) can prove every recovery path:
+
+* ``crash_before_commit_at_save`` / ``crash_after_commit_at_save`` — die at
+  the Nth checkpoint save, on the chosen side of the atomic-rename commit
+  (runtime/checkpoint.py calls :meth:`on_save_phase` at both points);
+* ``corrupt_shard_at_save`` — after the Nth commit, flip bytes in a
+  seeded-random file inside the committed tag (manifest verification must
+  catch it on load);
+* ``sigterm_at_step`` / ``crash_at_step`` — raise SIGTERM (drains through
+  PreemptionGuard) or die outright before training step K;
+* ``collective_fail_op`` / ``collective_delay_s`` — fail or delay facade
+  collectives through the comm-facade hook (``comm.comm._CHAOS_HOOK``,
+  fired at trace time where the facade records the op).
+
+Faults raise :class:`InjectedFault` (a ``BaseException``) so retry helpers
+and broad ``except Exception`` recovery code never swallow an injected
+crash, or — with ``exit_process`` on — call ``os._exit(exit_code)`` so a
+supervising ElasticAgent sees a real worker death. Every injection is
+counted under ``resilience/chaos/<kind>`` in the telemetry registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import time
+from typing import Any, Dict, Optional
+
+from ..utils.logging import logger
+
+CHAOS_ENV = "DST_CHAOS"
+
+
+class InjectedFault(BaseException):
+    """A deliberately injected fault. Derives from BaseException so the
+    retry helper (which retries OSError/RuntimeError) and defensive
+    ``except Exception`` blocks can never absorb it — an injected crash
+    must behave like a real one."""
+
+    def __init__(self, kind: str):
+        super().__init__(f"injected fault: {kind}")
+        self.kind = kind
+
+
+class CollectiveFault(InjectedFault):
+    """An injected collective failure (flaky fabric simulation)."""
+
+
+class FaultInjector:
+    """Seeded fault schedule. All ``*_at_save`` indices are 1-based save
+    counts; ``*_at_step`` match the engine's ``global_steps`` value at the
+    start of a ``train_batch`` call. ``-1`` disables a fault."""
+
+    def __init__(self, config: Any = None, *,
+                 seed: int = 0,
+                 crash_before_commit_at_save: int = -1,
+                 crash_after_commit_at_save: int = -1,
+                 corrupt_shard_at_save: int = -1,
+                 sigterm_at_step: int = -1,
+                 crash_at_step: int = -1,
+                 exit_process: bool = False,
+                 exit_code: int = 113,
+                 collective_fail_op: str = "",
+                 collective_fail_at_call: int = -1,
+                 collective_delay_s: float = 0.0,
+                 collective_delay_every: int = 0):
+        fields = {
+            "seed": seed,
+            "crash_before_commit_at_save": crash_before_commit_at_save,
+            "crash_after_commit_at_save": crash_after_commit_at_save,
+            "corrupt_shard_at_save": corrupt_shard_at_save,
+            "sigterm_at_step": sigterm_at_step,
+            "crash_at_step": crash_at_step,
+            "exit_process": exit_process,
+            "exit_code": exit_code,
+            "collective_fail_op": collective_fail_op,
+            "collective_fail_at_call": collective_fail_at_call,
+            "collective_delay_s": collective_delay_s,
+            "collective_delay_every": collective_delay_every,
+        }
+        for name, default in fields.items():
+            setattr(self, name,
+                    getattr(config, name, default) if config is not None
+                    else default)
+        self.rng = random.Random(self.seed)
+        self.save_count = 0
+        self.injected: Dict[str, int] = {}
+        self._collective_calls: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> Optional["FaultInjector"]:
+        """Build from the ``DST_CHAOS`` env var (a JSON object of the
+        constructor's keyword fields), or None when unset/empty. This is
+        how a supervised worker process (scripts/chaos_smoke.py) receives
+        its fault schedule."""
+        raw = (env if env is not None else os.environ).get(CHAOS_ENV, "")
+        if not raw.strip():
+            return None
+        try:
+            spec = json.loads(raw)
+        except json.JSONDecodeError as e:
+            logger.warning(f"{CHAOS_ENV} is not valid JSON ({e}); chaos disabled")
+            return None
+        if not isinstance(spec, dict):
+            logger.warning(f"{CHAOS_ENV} must be a JSON object; chaos disabled")
+            return None
+        # accept (and strip) the config block's master switch so a raw
+        # ChaosConfig dict can be exported into DST_CHAOS verbatim
+        if not spec.pop("enabled", True):
+            return None
+        # unknown keys degrade like every other malformed input — warn and
+        # drop, never TypeError a supervised worker into a restart storm
+        known = {"seed", "crash_before_commit_at_save",
+                 "crash_after_commit_at_save", "corrupt_shard_at_save",
+                 "sigterm_at_step", "crash_at_step", "exit_process",
+                 "exit_code", "collective_fail_op",
+                 "collective_fail_at_call", "collective_delay_s",
+                 "collective_delay_every"}
+        unknown = set(spec) - known
+        if unknown:
+            logger.warning(f"{CHAOS_ENV}: ignoring unknown keys {sorted(unknown)}")
+        return cls(**{k: v for k, v in spec.items() if k in known})
+
+    # ------------------------------------------------------------------
+    def _count(self, kind: str) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+        from ..telemetry.registry import get_registry
+
+        get_registry().counter(f"resilience/chaos/{kind}").inc()
+
+    def _crash(self, kind: str) -> None:
+        self._count(kind)
+        logger.warning(f"chaos: injecting crash '{kind}'")
+        if self.exit_process:
+            # flush logging before dying like a kill -9'd worker would
+            os._exit(self.exit_code)
+        raise InjectedFault(kind)
+
+    # ------------------------------------------------------------------
+    # hooks (called by checkpoint engine / train engine / comm facade)
+    def on_save_phase(self, phase: str, tag: str) -> None:
+        if phase == "before_commit":
+            self.save_count += 1
+            if self.save_count == self.crash_before_commit_at_save:
+                self._crash("crash_before_commit")
+        elif phase == "after_commit":
+            if self.save_count == self.crash_after_commit_at_save:
+                self._crash("crash_after_commit")
+
+    def maybe_corrupt(self, tag_path: str) -> bool:
+        """Flip bytes in one seeded-random file of a committed tag.
+        Returns True when corruption was injected (the checkpoint engine
+        must not mark such a tag as verified)."""
+        if self.save_count != self.corrupt_shard_at_save:
+            return False
+        corrupt_tag(tag_path, rng=self.rng)
+        self._count("corrupt_shard")
+        return True
+
+    def on_step(self, step: int) -> None:
+        if step == self.sigterm_at_step:
+            self._count("sigterm_at_step")
+            logger.warning(f"chaos: raising SIGTERM at step {step}")
+            signal.raise_signal(signal.SIGTERM)
+        if step == self.crash_at_step:
+            self._crash("crash_at_step")
+
+    def on_collective(self, op: str) -> None:
+        n = self._collective_calls.get(op, 0) + 1
+        self._collective_calls[op] = n
+        if (self.collective_delay_s > 0 and self.collective_delay_every > 0
+                and n % self.collective_delay_every == 0):
+            self._count(f"collective_delay/{op}")
+            time.sleep(self.collective_delay_s)
+        if op == self.collective_fail_op and n == self.collective_fail_at_call:
+            self._count(f"collective_fail/{op}")
+            raise CollectiveFault(f"collective_fail:{op}")
+
+
+def corrupt_tag(tag_path: str, rng: Optional[random.Random] = None) -> str:
+    """XOR-flip 64 bytes in the middle of one (seeded-random) data file of
+    a checkpoint tag. Returns the corrupted file's path. Standalone so
+    tests can corrupt without a full injector."""
+    rng = rng or random.Random(0)
+    candidates = []
+    for dirpath, _d, filenames in os.walk(tag_path):
+        for name in filenames:
+            full = os.path.join(dirpath, name)
+            # corrupt payload, not the protocol files that detect it
+            if name in ("COMMITTED", "manifest.json"):
+                continue
+            if os.path.getsize(full) > 0:
+                candidates.append(full)
+    if not candidates:
+        raise ValueError(f"no corruptible files under {tag_path}")
+    target = rng.choice(sorted(candidates))
+    size = os.path.getsize(target)
+    off = max(0, size // 2 - 32)
+    with open(target, "r+b") as f:
+        f.seek(off)
+        chunk = f.read(min(64, size - off))
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    logger.warning(f"chaos: corrupted {target} at offset {off}")
+    return target
+
+
+# ----------------------------------------------------------------------
+_INJECTOR: Optional[FaultInjector] = None
+
+
+def get_fault_injector() -> Optional[FaultInjector]:
+    return _INJECTOR
+
+
+def install_fault_injector(inj: Optional[FaultInjector]) -> Optional[FaultInjector]:
+    """Install ``inj`` process-globally (None to clear) and point the comm
+    facade's chaos hook at it."""
+    global _INJECTOR
+    _INJECTOR = inj
+    from ..comm import comm as comm_mod
+
+    comm_mod._CHAOS_HOOK = inj.on_collective if inj is not None else None
+    return inj

@@ -16,6 +16,22 @@ def record_retry(op: str = "default") -> None:
     get_registry().counter(f"resilience/retries/{op}").inc()
 
 
+def record_attempt(op: str = "default") -> None:
+    """Count every retry_call attempt (first tries included), so attempt
+    volume and retry volume can be ratioed into a flakiness rate."""
+    get_registry().counter(f"resilience/attempts/{op}").inc()
+
+
+def record_rollback() -> None:
+    """Count a divergence-triggered rollback to the last checkpoint."""
+    get_registry().counter("resilience/rollbacks").inc()
+
+
+def record_emergency_save() -> None:
+    """Count a preemption-triggered emergency checkpoint."""
+    get_registry().counter("resilience/emergency_saves").inc()
+
+
 def record_failure(op: str = "default") -> None:
     get_registry().counter(f"resilience/failures/{op}").inc()
 

@@ -28,11 +28,26 @@ class PreemptionGuard:
                  on_preempt: Optional[Callable[[int], None]] = None):
         self.signals = tuple(signals)
         self.on_preempt = on_preempt
+        self.last_signal: Optional[int] = None  # which signal latched us
         self._stop = threading.Event()
+        self._pending: list = []  # signums not yet counted (see below)
         self._previous = {}
 
     @property
     def should_stop(self) -> bool:
+        # registry counting is deferred from the handler to this poll: the
+        # registry/Counter locks are plain (non-reentrant) threading.Locks,
+        # and a handler firing while the step path holds one would deadlock
+        # the main thread. List append is GIL-atomic; draining here runs in
+        # normal (interruptible-but-lock-safe) context.
+        while self._pending:
+            signum = self._pending.pop(0)
+            get_registry().counter("resilience/preemptions").inc()
+            try:
+                name = signal.Signals(signum).name
+            except ValueError:
+                name = str(signum)
+            get_registry().counter(f"resilience/preemptions/{name}").inc()
         return self._stop.is_set()
 
     def request_stop(self) -> None:
@@ -42,7 +57,8 @@ class PreemptionGuard:
     def _handler(self, signum, frame) -> None:
         logger.warning(f"preemption signal {signum} received; draining at "
                        f"the next step boundary")
-        get_registry().counter("resilience/preemptions").inc()
+        self.last_signal = signum
+        self._pending.append(signum)
         self._stop.set()
         if self.on_preempt is not None:
             self.on_preempt(signum)

@@ -1,4 +1,4 @@
-"""Sharded checkpoint save/load.
+"""Sharded checkpoint save/load with a crash-safe commit protocol.
 
 Capability parity with the reference's checkpoint stack:
   - engine save/load (``runtime/engine.py:3010 save_checkpoint`` /
@@ -16,6 +16,24 @@ Capability parity with the reference's checkpoint stack:
     deepspeed/utils/zero_to_fp32.py and engine._zero3_consolidated_16bit_state_dict
     (engine.py:3423).
 
+Crash safety (docs/fault_tolerance.md) — a preemption can land at any byte
+of a save, so every tag follows a write-to-temp -> fsync -> atomic-rename
+commit protocol:
+
+  1. the whole tag (orbax state tree, meta.json) is assembled under
+     ``save_dir/.tmp-<tag>-<pid>``, invisible to every reader;
+  2. a ``manifest.json`` of per-file CRC32 checksums and sizes is written,
+     then a ``COMMITTED`` marker, each fsynced;
+  3. one ``os.rename`` publishes the tag — the only mutation a reader can
+     ever observe is the atomic appearance of a complete, checksummed tag.
+
+``load`` verifies the marker + manifest and, when a tag is torn, corrupted
+or uncommitted, falls back to the newest valid tag (commit-time order —
+robust even when a crash landed between commit and the ``latest`` pointer
+update). ``keep_last_n`` garbage collection removes old *valid* tags and
+never deletes the only one. The ``latest`` pointer itself is written by
+rank 0 only, after a cross-process barrier, via temp-file + rename.
+
 The checkpoint-engine abstraction (reference
 runtime/checkpoint_engine/checkpoint_engine.py:9) maps to orbax's
 Checkpointer; async save (NebulaCheckpointEngine parity) uses orbax's async
@@ -27,14 +45,31 @@ from __future__ import annotations
 import json
 import os
 import shutil
-from typing import Any, Dict, Optional
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from ..resilience.retry import RetryBudget, RetryPolicy, retry_call
+from ..utils.fileio import fsync_dir as _fsync_dir
+from ..utils.fileio import write_json_atomic
 from ..utils.logging import log_dist, logger
 
 LATEST_FILE = "latest"
+COMMITTED_FILE = "COMMITTED"
+MANIFEST_FILE = "manifest.json"
+TMP_PREFIX = ".tmp-"
+
+# filesystem ops around a save/load hit GCS/NFS-style flakes in production;
+# short jittered retries absorb them (resilience/retry.py). Each save/load
+# operation shares ONE RetryBudget across its several fs ops, so a
+# persistently degraded backend fails the operation promptly instead of
+# stretching every sub-op to its per-call maximum.
+_FS_RETRY = RetryPolicy(max_attempts=3, backoff_s=0.2, max_backoff_s=2.0,
+                        jitter=0.5)
+_FS_BUDGET_PER_OP = 6
 
 
 def _ckpt_dir(save_dir: str, tag: str) -> str:
@@ -45,83 +80,400 @@ def _is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+def _chaos():
+    """The installed fault injector, or None (resilience/chaos.py)."""
+    from ..resilience.chaos import get_fault_injector
+
+    return get_fault_injector()
+
+
+# ----------------------------------------------------------------------
+# durable small-file IO
+
+def _write_json_durable(path: str, obj: Any) -> None:
+    """Commit-protocol JSON: temp + fsync + atomic rename."""
+    write_json_atomic(path, obj, fsync=True, indent=2)
+
+
+def _file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _walk_files(root: str) -> List[str]:
+    """Relative paths of every regular file under ``root``, sorted."""
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            full = os.path.join(dirpath, name)
+            out.append(os.path.relpath(full, root))
+    return sorted(out)
+
+
+# ----------------------------------------------------------------------
+# tag validity
+
+def build_manifest(path: str) -> Dict[str, Any]:
+    """Per-file checksum manifest over everything currently in ``path``
+    (the manifest and marker themselves excluded)."""
+    files: Dict[str, Dict[str, Any]] = {}
+    for rel in _walk_files(path):
+        if rel in (MANIFEST_FILE, COMMITTED_FILE):
+            continue
+        full = os.path.join(path, rel)
+        files[rel] = {"size": os.path.getsize(full),
+                      "crc32": _file_crc32(full)}
+    return {"version": 1, "files": files}
+
+
+def verify_tag(path: str, checksums: bool = True) -> Tuple[bool, str]:
+    """Is the tag at ``path`` a complete, committed checkpoint?
+
+    Returns (ok, reason). A tag dir written before this commit protocol
+    existed (state/ + meta.json, no marker) is accepted as legacy — the
+    atomic rename guarantees any *new-protocol* tag at its final path is
+    complete, so a markerless dir cannot be a torn new-protocol save.
+    """
+    if not os.path.isdir(path):
+        return False, "missing"
+    committed = os.path.join(path, COMMITTED_FILE)
+    manifest_path = os.path.join(path, MANIFEST_FILE)
+    if not os.path.isfile(committed):
+        if os.path.isdir(os.path.join(path, "state")):
+            logger.warning(f"checkpoint {path}: pre-protocol tag (no "
+                           f"{COMMITTED_FILE} marker) — accepting as legacy")
+            return True, "legacy"
+        return False, f"no {COMMITTED_FILE} marker and no state dir"
+    if not os.path.isfile(manifest_path):
+        return False, f"{COMMITTED_FILE} present but {MANIFEST_FILE} missing"
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return False, f"unreadable manifest: {e}"
+    for rel, info in manifest.get("files", {}).items():
+        full = os.path.join(path, rel)
+        if not os.path.isfile(full):
+            return False, f"missing file {rel}"
+        if os.path.getsize(full) != info["size"]:
+            return False, (f"size mismatch for {rel}: "
+                           f"{os.path.getsize(full)} != {info['size']}")
+        if checksums and _file_crc32(full) != info["crc32"]:
+            return False, f"checksum mismatch for {rel}"
+    return True, "ok"
+
+
+def _commit_time(path: str) -> float:
+    marker = os.path.join(path, COMMITTED_FILE)
+    try:
+        with open(marker) as f:
+            return float(json.load(f).get("time", 0.0))
+    except (OSError, ValueError, json.JSONDecodeError):
+        try:
+            return os.path.getmtime(path)
+        except OSError:
+            return 0.0
+
+
+def list_tags(save_dir: str) -> List[str]:
+    """Candidate tags under ``save_dir``, newest committed first (commit
+    time from the COMMITTED marker; dir mtime for legacy tags). Temp dirs
+    are never candidates."""
+    if not os.path.isdir(save_dir):
+        return []
+    cands = []
+    for name in os.listdir(save_dir):
+        if name.startswith(TMP_PREFIX) or name == LATEST_FILE:
+            continue
+        path = os.path.join(save_dir, name)
+        if os.path.isdir(path):
+            cands.append((_commit_time(path), name))
+    return [name for _t, name in sorted(cands, reverse=True)]
+
+
+def find_valid_tag(save_dir: str, checksums: bool = True) -> Optional[str]:
+    """Newest tag that passes :func:`verify_tag`. Scans commit-time order
+    rather than trusting the ``latest`` pointer — a crash between commit
+    and pointer update leaves the pointer stale, not the data."""
+    for tag in list_tags(save_dir):
+        ok, reason = verify_tag(_ckpt_dir(save_dir, tag), checksums=checksums)
+        if ok:
+            return tag
+        logger.warning(f"checkpoint tag '{tag}' skipped: {reason}")
+    return None
+
+
 class CheckpointEngine:
-    """Orbax-backed sharded checkpoint engine.
+    """Orbax-backed sharded checkpoint engine with atomic commits.
 
     Layout under ``save_dir/tag/``:
-      state/      — orbax tree of {params, opt_state, scaler, step, ...}
-      meta.json   — config snapshot + pytree structure info + client state
-    ``save_dir/latest`` holds the most recent tag (reference engine.py:3206).
+      state/         — orbax tree of {params, opt_state, scaler, step, ...}
+      meta.json      — config snapshot + pytree structure info + client state
+      manifest.json  — per-file {size, crc32} over state/ + meta.json
+      COMMITTED      — commit marker {tag, time, n_files}
+    ``save_dir/latest`` holds the most recent tag (reference engine.py:3206),
+    written by rank 0 after a barrier, via temp + rename.
     """
 
-    def __init__(self, async_save: bool = False):
+    def __init__(self, async_save: bool = False, keep_last_n: int = 0,
+                 verify_checksums: bool = True):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self._async_save = async_save
+        self.keep_last_n = int(keep_last_n)
+        self.verify_checksums = bool(verify_checksums)
         self._ckptr = ocp.StandardCheckpointer() if not async_save else ocp.AsyncCheckpointer(
             ocp.StandardCheckpointHandler())
+        # tags are immutable once committed: path -> commit time verified
+        # OK, so GC never re-checksums a tag it (or save) already verified
+        self._verified: Dict[str, float] = {}
+
+    @staticmethod
+    def _barrier() -> None:
+        if _is_multiprocess():
+            from ..comm.comm import barrier
+
+            barrier()
 
     # ------------------------------------------------------------------
-    def save(self, save_dir: str, tag: str, state: Dict[str, Any],
-             client_state: Optional[Dict[str, Any]] = None,
-             config_snapshot: Optional[Dict[str, Any]] = None) -> str:
-        path = _ckpt_dir(save_dir, tag)
-        os.makedirs(save_dir, exist_ok=True)
-        state_path = os.path.join(path, "state")
+    def _write_state(self, state_path: str, state: Dict[str, Any]) -> None:
         if os.path.exists(state_path):
             shutil.rmtree(state_path)
-        os.makedirs(path, exist_ok=True)
         self._ckptr.save(os.path.abspath(state_path), state)
         # orbax may finalize in the background even on the "sync" path (the
         # state dir appears as *.orbax-checkpoint-tmp until renamed) — wait
-        # so callers can read the checkpoint immediately after save()
+        # so the manifest below hashes the finalized files
         if hasattr(self._ckptr, "wait_until_finished"):
             self._ckptr.wait_until_finished()
-        import time as _time
-
         for _ in range(600):
             if os.path.isdir(state_path):
-                break
-            _time.sleep(0.05)
+                return
+            time.sleep(0.05)
+        raise RuntimeError(f"checkpoint finalize timed out: {state_path}")
+
+    @staticmethod
+    def _clean_stale_tmp(save_dir: str) -> None:
+        """Remove temp dirs abandoned by crashed saves (they are the torn
+        checkpoints this protocol turns into harmless garbage)."""
+        for name in os.listdir(save_dir):
+            if name.startswith(TMP_PREFIX):
+                logger.warning(f"removing stale checkpoint temp dir {name}")
+                shutil.rmtree(os.path.join(save_dir, name),
+                              ignore_errors=True)
+
+    def save(self, save_dir: str, tag: str, state: Dict[str, Any],
+             client_state: Optional[Dict[str, Any]] = None,
+             config_snapshot: Optional[Dict[str, Any]] = None) -> str:
+        tag = str(tag)
+        os.makedirs(save_dir, exist_ok=True)
+        rank0 = jax.process_index() == 0
+        final = _ckpt_dir(save_dir, tag)
+        # ONE shared temp dir across processes (orbax's multihost save is
+        # collective — every rank's shards must land in the directory the
+        # commit below publishes); rank 0 prepares it, a barrier keeps
+        # other ranks from writing into a dir being cleaned
+        tmp = os.path.join(save_dir, f"{TMP_PREFIX}{tag}")
+        if rank0:
+            self._clean_stale_tmp(save_dir)
+            os.makedirs(tmp, exist_ok=True)
+        self._barrier()
+        state_path = os.path.join(tmp, "state")
+        budget = RetryBudget(_FS_BUDGET_PER_OP)
+        if _is_multiprocess():
+            # the orbax multihost save is COLLECTIVE: one rank retrying it
+            # alone would desynchronize the processes and hang the barrier
+            # below — a failed collective write needs a restart, not a
+            # retry (resilience/retry.py's own contract)
+            self._write_state(state_path, state)
         else:
-            raise RuntimeError(f"checkpoint finalize timed out: {state_path}")
+            retry_call(self._write_state, state_path, state,
+                       policy=_FS_RETRY, op="checkpoint_save", budget=budget)
         meta = {
             "tag": tag,
             "client_state": client_state or {},
             "config": config_snapshot or {},
-            "version": 1,
+            "version": 2,
         }
-        if jax.process_index() == 0:
-            with open(os.path.join(path, "meta.json"), "w") as f:
-                json.dump(meta, f, indent=2, default=str)
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(str(tag))
-        log_dist(f"Saved checkpoint {path}")
-        return path
+        if rank0:
+            retry_call(_write_json_durable, os.path.join(tmp, "meta.json"),
+                       meta, policy=_FS_RETRY, op="checkpoint_fs",
+                       budget=budget)
+        # every rank's shards must be durable before rank 0 hashes them
+        # into the manifest and publishes the tag
+        self._barrier()
+
+        inj = _chaos()
+        if inj is not None:
+            inj.on_save_phase("before_commit", tag)
+
+        if rank0:
+            self._commit(tmp, final, budget)
+        self._barrier()
+
+        corrupted = False
+        if inj is not None:
+            # a crash here lands AFTER the durable commit: the tag must
+            # survive and auto-resume must find it even though the latest
+            # pointer below was never updated
+            inj.on_save_phase("after_commit", tag)
+            corrupted = inj.maybe_corrupt(final)
+        if rank0 and not corrupted:
+            # the just-committed tag was hashed while building its
+            # manifest — remember it as verified so GC never re-reads it
+            # (seeded only after the chaos window: an injected corruption
+            # must not ride the memo past GC's checksum gate)
+            self._verified[final] = _commit_time(final)
+
+        # 'latest' pointer: rank 0 only, after the barrier above (every
+        # process has finished its shard writes), via temp + atomic rename —
+        # a crash mid-update can no longer leave a truncated pointer
+        if rank0:
+            retry_call(self._write_latest, save_dir, tag,
+                       policy=_FS_RETRY, op="checkpoint_fs", budget=budget)
+            self._gc(save_dir, just_saved=tag)
+        from ..telemetry.registry import get_registry
+
+        get_registry().counter("checkpoint/saves").inc()
+        log_dist(f"Saved checkpoint {final} (committed)")
+        return final
+
+    def _commit(self, tmp: str, final: str,
+                budget: Optional[RetryBudget] = None) -> None:
+        """Manifest + marker + fsync + atomic publish."""
+        manifest = build_manifest(tmp)
+        retry_call(_write_json_durable, os.path.join(tmp, MANIFEST_FILE),
+                   manifest, policy=_FS_RETRY, op="checkpoint_fs",
+                   budget=budget)
+        marker = {"tag": os.path.basename(final), "time": time.time(),
+                  "n_files": len(manifest["files"])}
+        retry_call(_write_json_durable, os.path.join(tmp, COMMITTED_FILE),
+                   marker, policy=_FS_RETRY, op="checkpoint_fs",
+                   budget=budget)
+        _fsync_dir(tmp)
+        trash = None
+        if os.path.exists(final):
+            # replacing an existing tag: move the old one aside first (the
+            # new tag is already complete in tmp, so no crash window loses
+            # both), publish, then drop the old. The trash name carries
+            # TMP_PREFIX so a crash before the rmtree leaves it invisible
+            # to list_tags/GC and _clean_stale_tmp reaps it next save.
+            trash = os.path.join(
+                os.path.dirname(final) or ".",
+                f"{TMP_PREFIX}{os.path.basename(final)}-replaced")
+            shutil.rmtree(trash, ignore_errors=True)
+            os.rename(final, trash)
+        os.rename(tmp, final)
+        _fsync_dir(os.path.dirname(final) or ".")
+        if trash is not None:
+            shutil.rmtree(trash, ignore_errors=True)
+
+    @staticmethod
+    def _write_latest(save_dir: str, tag: str) -> None:
+        tmp = os.path.join(save_dir, LATEST_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(str(tag))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(save_dir, LATEST_FILE))
+
+    def _verified_for_keep(self, path: str) -> bool:
+        """Checksum verification with a memo: committed tags are immutable,
+        so a tag this engine verified once (or just wrote — save() seeds
+        the memo from the manifest it built) is never re-read. Keeps the
+        per-save GC cost at one checksum pass per NEW tag, not
+        keep_last_n x checkpoint-size of read I/O every save."""
+        t = _commit_time(path)
+        if self._verified.get(path) == t and t > 0:
+            return True
+        ok = verify_tag(path, checksums=self.verify_checksums)[0]
+        if ok:
+            self._verified[path] = t
+        return ok
+
+    def _gc(self, save_dir: str, just_saved: Optional[str] = None) -> None:
+        """Keep the newest ``keep_last_n`` *valid* tags. The tags being
+        counted toward the keep quota are CHECKSUM-verified, memoized (a
+        bit-flipped tag must not pass for the last good checkpoint and
+        license deleting the real one); invalid tags are left in place as
+        evidence; the only valid checkpoint is never deleted regardless
+        of config."""
+        if self.keep_last_n <= 0:
+            return
+        keep = max(self.keep_last_n, 1)
+        confirmed = 0
+        doomed: List[str] = []
+        for tag in list_tags(save_dir):
+            path = _ckpt_dir(save_dir, tag)
+            if confirmed < keep:
+                if self._verified_for_keep(path):
+                    confirmed += 1
+                # invalid within the keep window: skip, keep scanning
+            elif verify_tag(path, checksums=False)[0]:
+                doomed.append(tag)
+        if confirmed == 0:
+            return  # nothing verified: touch nothing
+        for tag in doomed:
+            if tag == just_saved:  # paranoia: never GC the tag just written
+                continue
+            logger.info(f"checkpoint GC: removing old tag '{tag}'")
+            self._verified.pop(_ckpt_dir(save_dir, tag), None)
+            shutil.rmtree(_ckpt_dir(save_dir, tag), ignore_errors=True)
+            from ..telemetry.registry import get_registry
+
+            get_registry().counter("checkpoint/gc_removed").inc()
 
     # ------------------------------------------------------------------
     def load(self, load_dir: str, tag: Optional[str] = None,
              template: Optional[Any] = None) -> Optional[Dict[str, Any]]:
         """Restore. ``template`` is a pytree of ShapeDtypeStruct (or arrays)
         with target shardings — loading re-places shards for the *current*
-        mesh, which is the universal-checkpoint reshape path."""
-        if tag is None:
-            latest = os.path.join(load_dir, LATEST_FILE)
-            if not os.path.isfile(latest):
-                logger.warning(f"No '{LATEST_FILE}' file in {load_dir}; nothing to load")
+        mesh, which is the universal-checkpoint reshape path.
+
+        With ``tag=None`` the newest valid tag is chosen (torn, corrupted
+        and uncommitted tags are verified against their manifest and
+        skipped). An explicit ``tag`` that fails verification returns None
+        — no silent substitution when the caller asked for a specific one.
+        """
+        if not os.path.isdir(load_dir):
+            logger.warning(f"checkpoint dir {load_dir} not found; nothing to load")
+            return None
+        if tag is not None:
+            ok, reason = verify_tag(_ckpt_dir(load_dir, str(tag)),
+                                    checksums=self.verify_checksums)
+            if not ok:
+                logger.warning(f"checkpoint tag '{tag}' invalid: {reason}")
+                from ..telemetry.registry import get_registry
+
+                get_registry().counter("checkpoint/invalid_tags").inc()
                 return None
-            with open(latest) as f:
-                tag = f.read().strip()
+            return self._restore(load_dir, str(tag), template)
+        chosen = find_valid_tag(load_dir, checksums=self.verify_checksums)
+        if chosen is None:
+            logger.warning(f"no valid checkpoint tag in {load_dir}")
+            return None
+        return self._restore(load_dir, chosen, template)
+
+    def _restore(self, load_dir: str, tag: str,
+                 template: Optional[Any]) -> Optional[Dict[str, Any]]:
         path = _ckpt_dir(load_dir, tag)
         state_path = os.path.join(path, "state")
         if not os.path.isdir(state_path):
             logger.warning(f"Checkpoint dir {state_path} not found")
             return None
-        if template is not None:
-            restored = self._ckptr.restore(os.path.abspath(state_path), target=template)
-        else:
-            restored = self._ckptr.restore(os.path.abspath(state_path))
+
+        def _do_restore():
+            if template is not None:
+                return self._ckptr.restore(os.path.abspath(state_path),
+                                           target=template)
+            return self._ckptr.restore(os.path.abspath(state_path))
+
+        restored = retry_call(_do_restore, policy=_FS_RETRY,
+                              op="checkpoint_load",
+                              budget=RetryBudget(_FS_BUDGET_PER_OP))
         meta_path = os.path.join(path, "meta.json")
         meta = {}
         if os.path.isfile(meta_path):

@@ -40,6 +40,7 @@ class DataLoader:
         self.collate_fn = collate_fn or _default_collate
         self.curriculum_fn = curriculum_fn
         self.epoch = 0
+        self._batch_index = 0  # batches consumed in the current epoch
         self._n = _dataset_len(dataset)
         if batch_size > self._n and drop_last:
             raise ValueError(f"batch_size {batch_size} exceeds dataset size {self._n}")
@@ -51,14 +52,58 @@ class DataLoader:
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
+        self._batch_index = 0
 
-    def __iter__(self) -> Iterator[Any]:
+    # ------------------------------------------------------------------
+    # checkpointable position (runtime/checkpoint.py commit protocol: the
+    # engine stores this in client_state so a resumed run replays the exact
+    # remaining batch order — the shuffle is a pure function of
+    # seed + epoch, so (epoch, batch_index) IS the pipeline position)
+    def state_dict(self) -> dict:
+        """Position of the NEXT batch to yield. A position at the end of
+        an epoch is normalized to (epoch+1, 0): a checkpoint taken right
+        after an epoch's last batch must resume into the next epoch, not
+        replay the one just finished."""
+        epoch, b = int(self.epoch), int(self._batch_index)
+        nb = len(self)
+        if nb > 0 and b >= nb:
+            epoch, b = epoch + 1, 0
+        return {"epoch": epoch, "batch_index": b, "seed": int(self.seed)}
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Restore position. Takes effect on the next ``iter()`` AND on a
+        live iterator (the engine's divergence rollback rewinds the data
+        stream without the training loop restarting its ``for`` loop —
+        the iterator re-reads the position before every yield)."""
+        if int(sd.get("seed", self.seed)) != self.seed:
+            from ..utils.logging import logger
+
+            logger.warning(
+                f"dataloader resume: checkpoint seed {sd.get('seed')} != "
+                f"configured seed {self.seed}; batch order will diverge")
+        self.epoch = int(sd.get("epoch", 0))
+        self._batch_index = int(sd.get("batch_index", 0))
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
         order = np.arange(self._n)
         if self.shuffle:
-            rng = np.random.default_rng(self.seed + self.epoch)
+            rng = np.random.default_rng(self.seed + epoch)
             rng.shuffle(order)
+        return order
+
+    def __iter__(self) -> Iterator[Any]:
         nb = len(self)
-        for b in range(nb):
+        # a fully-consumed epoch (or a fresh loader) starts from 0; a
+        # mid-epoch position restored by load_state_dict resumes there
+        if self._batch_index >= nb:
+            self._batch_index = 0
+        epoch = self.epoch
+        order = self._epoch_order(epoch)
+        while self._batch_index < nb:
+            if self.epoch != epoch:  # position rewound across epochs
+                epoch = self.epoch
+                order = self._epoch_order(epoch)
+            b = self._batch_index
             idx = order[b * self.batch_size:(b + 1) * self.batch_size]
             if len(idx) < self.batch_size:
                 if self.drop_last:
@@ -66,7 +111,8 @@ class DataLoader:
                 idx = np.concatenate([idx, order[: self.batch_size - len(idx)]])
             batch = self.collate_fn([_dataset_get(self.dataset, int(i)) for i in idx])
             if self.curriculum_fn is not None:
-                batch = self.curriculum_fn(self.epoch * nb + b, batch)
+                batch = self.curriculum_fn(epoch * nb + b, batch)
+            self._batch_index = b + 1
             yield self.shard(batch)
 
     def shard(self, batch: Any) -> Any:

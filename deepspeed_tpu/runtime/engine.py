@@ -309,7 +309,42 @@ class TrainEngine:
         self._comm_totals_prev: Dict[str, Dict[str, float]] = {}
         self._grad_comm_noted = False
         self._closed = False
-        self.ckpt_engine = CheckpointEngine(async_save=config.checkpoint.async_save)
+        self.ckpt_engine = CheckpointEngine(
+            async_save=config.checkpoint.async_save,
+            keep_last_n=config.checkpoint.keep_last_n,
+            verify_checksums=config.checkpoint.verify_checksums)
+
+        # -- fault tolerance (docs/fault_tolerance.md). When every knob is
+        # off, _ft_active stays False and the step path performs exactly
+        # the same host synchronizations as before — the guards' cost
+        # exists only when a guard does.
+        rcfg = config.resilience
+        self._step_hooks: list = []
+        self._nan_skip_traced = rcfg.divergence.nan_action == "skip"
+        self._divergence = None
+        if rcfg.divergence.wants_host_check:
+            from ..resilience.divergence import DivergenceGuard
+
+            self._divergence = DivergenceGuard(
+                nan_action=rcfg.divergence.nan_action,
+                spike_action=rcfg.divergence.spike_action,
+                spike_factor=rcfg.divergence.spike_factor,
+                window=rcfg.divergence.window,
+                warmup_steps=rcfg.divergence.warmup_steps)
+        self.preemption_guard = None
+        self._stop_reason: Optional[str] = None
+        self._dataloader = None  # bound loader whose position checkpoints carry
+        self._rollback_streak = 0   # rollbacks without progress past...
+        self._ft_high_step = 0      # ...this high-water step
+        self._ckpt_save_dir = config.checkpoint.save_dir
+        self._ft_active = (self._divergence is not None
+                           or bool(self._ckpt_save_dir
+                                   and config.checkpoint.save_interval > 0))
+        if rcfg.chaos.enabled:
+            from ..resilience.chaos import FaultInjector, install_fault_injector
+
+            inj = install_fault_injector(FaultInjector(rcfg.chaos))
+            self.register_step_hook(lambda _eng, step: inj.on_step(step))
 
         # compat micro-step accumulation state
         self._acc_grads: Optional[Any] = None
@@ -318,7 +353,6 @@ class TrainEngine:
         # optional traced transform applied to the compute-copy params
         # (compression QAT / pruning masks — compression/compress.py)
         self._param_transform: Optional[Callable[[Any], Any]] = None
-        self._step_hooks: list = []
 
         self._train_step_fn = None
         self._eval_step_fn = None
@@ -545,7 +579,8 @@ class TrainEngine:
 
             new_params, new_opt, new_scaler, gnorm, skipped = self._update(
                 params, opt_state, scaler_state, grads, scale,
-                clip=clip, fp16=fp16, dynamic=dynamic, optimizer=optimizer)
+                clip=clip, fp16=fp16, dynamic=dynamic, optimizer=optimizer,
+                nan_skip=self._nan_skip_traced)
             metrics = {
                 "loss": loss,
                 "grad_norm": gnorm,
@@ -558,11 +593,18 @@ class TrainEngine:
         return jax.jit(train_step, donate_argnums=donate)
 
     def _update(self, params, opt_state, scaler_state, grads, scale, *,
-                clip, fp16, dynamic, optimizer):
-        """Unscale, clip, step — shared by fused and compat paths."""
+                clip, fp16, dynamic, optimizer, nan_skip=False):
+        """Unscale, clip, step — shared by fused and compat paths.
+
+        ``nan_skip`` (divergence.nan_action == "skip") reuses the fp16
+        overflow machinery for full-precision runs: a non-finite gradient
+        tree keeps the old params/opt state ON DEVICE — the NaN guard
+        compiles into the step and costs zero extra host syncs."""
         cfg = self.config
         if fp16:
             grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+            finite = ls.grads_finite(grads)
+        elif nan_skip:
             finite = ls.grads_finite(grads)
         else:
             finite = jnp.asarray(True)
@@ -572,8 +614,9 @@ class TrainEngine:
             grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
         updates, new_opt = optimizer.update(grads, opt_state, params)
         new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
-        # overflow => keep old params/opt state (reference: skipped step)
-        if fp16:
+        # overflow / injected NaN => keep old params/opt state
+        # (reference: skipped step)
+        if fp16 or nan_skip:
             new_params = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(finite, n, o), new_params, params)
             new_opt = jax.tree_util.tree_map(
@@ -639,6 +682,8 @@ class TrainEngine:
         self._emit_step(metrics, wall_time_s=step_dt, log_step=report_boundary)
         self._note_skipped(metrics["skipped"])
         self._last_loss = metrics["loss"]
+        if self._ft_active or self.preemption_guard is not None:
+            self._after_step(metrics)
         if self.config.memory_breakdown and report_boundary:
             # reference see_memory_usage at engine phase boundaries
             # (runtime/utils.py); boundary-only so it never adds a host
@@ -647,6 +692,122 @@ class TrainEngine:
 
             see_memory_usage(f"step {self.global_steps}")
         return metrics
+
+    # ==================================================================
+    # fault tolerance (docs/fault_tolerance.md)
+    @property
+    def should_stop(self) -> bool:
+        """True once a preemption was handled (emergency checkpoint saved,
+        telemetry flushed) or a guard halted the run — the training loop's
+        drain signal."""
+        return self._stop_reason is not None
+
+    @property
+    def stop_reason(self) -> Optional[str]:
+        return self._stop_reason
+
+    def attach_preemption_guard(self, guard: Optional[Any] = None):
+        """Wire a PreemptionGuard into the step path: when its signal
+        latches, the NEXT step boundary saves an emergency checkpoint
+        (into ``checkpoint.save_dir``), flushes telemetry, and sets
+        :attr:`should_stop`. Pass an entered guard, or None to construct
+        one (caller still manages its context)."""
+        if guard is None:
+            from ..resilience.preemption import PreemptionGuard
+
+            guard = PreemptionGuard()
+        self.preemption_guard = guard
+        return guard
+
+    def bind_dataloader(self, loader: Any) -> None:
+        """Checkpoints now carry this loader's position (epoch + batch
+        index) in client_state, and load_checkpoint restores it — resume
+        replays the exact remaining data order. Bind before iterating."""
+        self._dataloader = loader
+
+    def _after_step(self, metrics: Dict[str, Any]) -> None:
+        """Step-boundary fault-tolerance checks. Never called when every
+        knob is off (the zero-extra-host-syncs contract)."""
+        step = self.global_steps
+        if step > self._ft_high_step:
+            # progress past the previous high-water step: any earlier
+            # divergence was transient, the rollback did its job
+            self._ft_high_step = step
+            self._rollback_streak = 0
+        if self._divergence is not None:
+            # the one host sync the divergence guard costs, documented
+            verdict = self._divergence.observe(step, float(metrics["loss"]))
+            if verdict is not None:
+                kind, action = verdict
+                from ..telemetry.registry import get_registry
+
+                get_registry().counter(f"resilience/divergence/{kind}").inc()
+                if action == "halt":
+                    from ..resilience.divergence import DivergenceError
+
+                    self._stop_reason = f"divergence:{kind}"
+                    raise DivergenceError(
+                        f"{kind} divergence at step {step} (action=halt)")
+                if action == "rollback":
+                    self._rollback_streak += 1
+                    limit = self.config.resilience.divergence.max_rollbacks
+                    if self._rollback_streak > limit:
+                        # bit-exact resume replays a deterministic fault
+                        # identically — rolling back again would loop
+                        # forever; escalate to halt
+                        from ..resilience.divergence import DivergenceError
+
+                        self._stop_reason = f"divergence:{kind}:rollback-loop"
+                        raise DivergenceError(
+                            f"{kind} divergence at step {step} persisted "
+                            f"through {limit} rollbacks (deterministic "
+                            f"fault?) — halting")
+                    self._rollback(kind)
+                    return  # don't checkpoint the rolled-back state twice
+                # "warn": the guard already logged and counted
+        if (self.preemption_guard is not None
+                and self.preemption_guard.should_stop
+                and self._stop_reason is None):
+            self._emergency_checkpoint()
+            self._stop_reason = "preempted"
+            return
+        if (self._ckpt_save_dir and self.config.checkpoint.save_interval > 0
+                and step % self.config.checkpoint.save_interval == 0):
+            self.save_checkpoint(self._ckpt_save_dir)
+
+    def _rollback(self, kind: str) -> None:
+        from ..resilience.counters import record_rollback
+        from ..resilience.divergence import DivergenceError
+
+        if not self._ckpt_save_dir:
+            raise DivergenceError(
+                f"{kind} divergence: rollback requested but "
+                f"checkpoint.save_dir is not configured")
+        bad_step = self.global_steps
+        client = self.load_checkpoint(self._ckpt_save_dir, auto=True)
+        if client is None:
+            raise DivergenceError(
+                f"{kind} divergence at step {bad_step}: no valid "
+                f"checkpoint to roll back to")
+        self._divergence.reset()
+        record_rollback()
+        logger.warning(f"divergence ({kind}) at step {bad_step}: rolled "
+                       f"back to step {self.global_steps}")
+
+    def _emergency_checkpoint(self) -> None:
+        """Preemption drain: checkpoint (if a save_dir is configured) and
+        flush every telemetry sink before the SIGKILL deadline."""
+        from ..resilience.counters import record_emergency_save
+
+        if self._ckpt_save_dir:
+            self.save_checkpoint(self._ckpt_save_dir)
+            record_emergency_save()
+            log_dist(f"emergency checkpoint at step {self.global_steps} "
+                     f"(preemption drain)")
+        else:
+            logger.warning("preempted with no checkpoint.save_dir — "
+                           "draining without an emergency checkpoint")
+        self.telemetry.close()
 
     def register_param_transform(self, fn: Optional[Callable[[Any], Any]]) -> None:
         """Install/replace a traced params transform applied at the
@@ -747,7 +908,8 @@ class TrainEngine:
                 return self._update(params, opt_state, scaler_state, grads, scale,
                                     clip=cfg.gradient_clipping, fp16=cfg.fp16.enabled,
                                     dynamic=cfg.fp16.enabled and cfg.fp16.dynamic_loss_scale,
-                                    optimizer=optimizer)
+                                    optimizer=optimizer,
+                                    nan_skip=self._nan_skip_traced)
 
             donate = (0, 1, 2, 3) if self._donate else ()
             self._apply_update_fn = jax.jit(apply_update, donate_argnums=donate)
@@ -784,6 +946,11 @@ class TrainEngine:
         self._emit_step({"loss": self._last_loss, "grad_norm": gnorm,
                          "loss_scale": self.scaler_state.scale, "skipped": skipped},
                         wall_time_s=wall, phase_times=phase_times)
+        if self._ft_active or self.preemption_guard is not None:
+            # the compat path is an optimizer-step boundary too: divergence
+            # guards, preemption drain and periodic auto-save all apply
+            self._after_step({"loss": self._last_loss, "grad_norm": gnorm,
+                              "skipped": skipped})
 
     # ==================================================================
     def eval_batch(self, batch: Any) -> Any:
@@ -1030,16 +1197,31 @@ class TrainEngine:
                         client_state: Optional[Dict[str, Any]] = None) -> str:
         tag = tag if tag is not None else f"global_step{self.global_steps}"
         validate_tag_consistency(str(tag), self.config.checkpoint.tag_validation)
+        client = {**(client_state or {}),
+                  "global_steps": self.global_steps,
+                  "micro_steps": self.micro_steps,
+                  "skipped_steps": self.skipped_steps}
+        if self._dataloader is not None and hasattr(self._dataloader,
+                                                    "state_dict"):
+            # data-pipeline position rides along so resume replays the
+            # exact remaining batch order (bit-exact resume contract)
+            client["dataloader"] = self._dataloader.state_dict()
         return self.ckpt_engine.save(
             save_dir, str(tag), self._state_dict(),
-            client_state={**(client_state or {}),
-                          "global_steps": self.global_steps,
-                          "micro_steps": self.micro_steps,
-                          "skipped_steps": self.skipped_steps},
+            client_state=client,
             config_snapshot=self.config.raw)
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
-                        load_optimizer_states: bool = True) -> Optional[Dict[str, Any]]:
+                        load_optimizer_states: bool = True,
+                        auto: bool = False) -> Optional[Dict[str, Any]]:
+        """Restore engine state. ``tag=None`` picks the newest VALID tag
+        (torn/uncommitted/corrupt tags are verified against their manifest
+        and skipped — see runtime/checkpoint.py). ``auto=True`` is the
+        resume-after-restart entry point: a missing/empty directory is a
+        quiet no-op instead of a warning, so first boot and restart share
+        one code path."""
+        if auto and not os.path.isdir(load_dir):
+            return None
         # struct-only template: never swaps offloaded state in from disk
         # just to learn the tree structure
         template = {
@@ -1076,6 +1258,9 @@ class TrainEngine:
         client = result["meta"].get("client_state", {})
         self.micro_steps = int(client.get("micro_steps", self.global_steps * self.gradient_accumulation_steps))
         self.skipped_steps = int(client.get("skipped_steps", 0))
+        if (self._dataloader is not None and "dataloader" in client
+                and hasattr(self._dataloader, "load_state_dict")):
+            self._dataloader.load_state_dict(client["dataloader"])
         return client
 
     def save_16bit_model(self, save_dir: str, filename: str = "model_fp16.npz") -> str:

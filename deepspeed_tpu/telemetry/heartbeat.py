@@ -15,7 +15,6 @@ complementary mechanisms:
 
 from __future__ import annotations
 
-import json
 import os
 import statistics
 import time
@@ -70,7 +69,14 @@ class StallDetector:
 
 
 class Heartbeat:
-    """Atomic per-step liveness file for external watchdogs."""
+    """Atomic per-step liveness file for external watchdogs.
+
+    Carries a ``state`` field so watchers can distinguish a live trainer
+    (``"running"``) from the supervising ElasticAgent's relaunch window
+    (``"restarting"`` — launcher/agent.py overwrites the same file with
+    restart count + reason while the worker is down) instead of treating
+    every restart gap as a hang.
+    """
 
     def __init__(self, path: str):
         self.path = path
@@ -79,7 +85,7 @@ class Heartbeat:
             os.makedirs(d, exist_ok=True)
 
     def beat(self, step: int) -> None:
-        tmp = self.path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"step": int(step), "time": time.time()}, f)
-        os.replace(tmp, self.path)
+        from ..utils.fileio import write_json_atomic
+
+        write_json_atomic(self.path, {"step": int(step), "time": time.time(),
+                                      "state": "running"})

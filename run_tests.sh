@@ -15,11 +15,21 @@ pytest_rc=$?
 smoke_rc=0
 if [ "$#" -eq 0 ]; then
     # full-suite runs only: a targeted ./run_tests.sh tests/test_x.py
-    # shouldn't pay the smoke loop's engine build
+    # shouldn't pay the smoke loops' engine builds
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
         XLA_FLAGS="--xla_force_host_platform_device_count=8" \
         python scripts/telemetry_smoke.py
     smoke_rc=$?
+
+    # chaos smoke: seeded kill mid-train + ElasticAgent auto-resume; the
+    # final loss must be bit-identical to an uninterrupted run
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python scripts/chaos_smoke.py
+    chaos_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$chaos_rc
+    fi
 fi
 
 if [ "$pytest_rc" -ne 0 ]; then

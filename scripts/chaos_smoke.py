@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Chaos smoke test: a supervised train loop is killed mid-run by a seeded
+fault, auto-resumes from its last committed checkpoint, and must finish
+with EXACTLY the loss of an uninterrupted run.
+
+Two runs of the same worker command (both subprocesses, identical seeds):
+  1. control — no chaos, trains straight to --steps, writes the final loss;
+  2. chaos   — ``DST_CHAOS`` makes the FaultInjector ``os._exit`` the worker
+     at step K (first generation only); the ElasticAgent restarts it with
+     ``DST_ELASTIC_RESTART=1``; the restarted worker auto-resumes from the
+     newest valid checkpoint (data-loader position + RNG restored from
+     client_state) and finishes.
+
+The two final losses must match bit-for-bit — that is the whole
+fault-tolerance contract in one number. Run by run_tests.sh after the
+telemetry smoke; also usable standalone:
+
+    JAX_PLATFORMS=cpu python scripts/chaos_smoke.py [--steps N] [--kill-at K]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ----------------------------------------------------------------------
+# worker: the training loop under test
+
+def worker(args) -> int:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.resilience import FaultInjector, install_fault_injector
+
+    def loss_fn(params, batch, rng):
+        x, y = batch["x"], batch["y"]
+        h = jax.nn.relu(x @ params["w0"] + params["b0"])
+        p = h @ params["w1"] + params["b1"]
+        return jnp.mean((p - y) ** 2)
+
+    k0, k1 = jax.random.split(jax.random.PRNGKey(7))
+    params = {
+        "w0": jax.random.normal(k0, (8, 16), jnp.float32) * 0.3,
+        "b0": jnp.zeros((16,), jnp.float32),
+        "w1": jax.random.normal(k1, (16, 4), jnp.float32) * 0.3,
+        "b1": jnp.zeros((4,), jnp.float32),
+    }
+    rng = np.random.default_rng(3)
+    dataset = {"x": rng.normal(size=(128, 8)).astype(np.float32),
+               "y": rng.normal(size=(128, 4)).astype(np.float32)}
+
+    cfg = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "steps_per_print": 1000,
+        "zero_optimization": {"stage": 1},
+        "checkpoint": {
+            "save_dir": args.ckpt,
+            "auto_resume": True,
+            "save_interval": 1,
+            "keep_last_n": 3,
+        },
+    }
+    engine, _, loader, _ = dst.initialize(loss_fn=loss_fn, params=params,
+                                          config=cfg, training_data=dataset)
+    # env-driven chaos, generation 0 only: the restarted worker resumes at
+    # the very step the schedule kills, so re-arming it would crash-loop
+    # until the agent's restart budget runs out
+    if int(os.environ.get("DST_ELASTIC_RESTART", "0")) == 0:
+        inj = FaultInjector.from_env()
+        if inj is not None:
+            install_fault_injector(inj)
+            engine.register_step_hook(lambda _e, step: inj.on_step(step))
+
+    last = None
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+    for batch in RepeatingLoader(loader):
+        if engine.global_steps >= args.steps:
+            break
+        last = engine.train_batch(batch)
+    final = float(last["loss"])
+    with open(args.loss_out, "w") as f:
+        json.dump({"final_loss": final, "steps": engine.global_steps,
+                   "restart_generation":
+                       int(os.environ.get("DST_ELASTIC_RESTART", "0"))}, f)
+    engine.close()
+    print(f"chaos smoke worker: done at step {engine.global_steps} "
+          f"loss={final:.6f}")
+    return 0
+
+
+# ----------------------------------------------------------------------
+# parent: control run, chaos run, compare
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--kill-at", type=int, default=4,
+                    help="worker os._exit()s entering this step (gen 0 only)")
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--ckpt", default=None)
+    ap.add_argument("--loss-out", default=None)
+    args = ap.parse_args()
+
+    if args.worker:
+        return worker(args)
+
+    from deepspeed_tpu.launcher.agent import ElasticAgent
+
+    base = tempfile.mkdtemp(prefix="dst_chaos_smoke_")
+    me = os.path.abspath(__file__)
+
+    def run(tag: str, chaos_env: str) -> dict:
+        ckpt = os.path.join(base, tag, "ckpt")
+        loss_out = os.path.join(base, tag, "loss.json")
+        os.makedirs(ckpt, exist_ok=True)
+        cmd = [sys.executable, me, "--worker", "--steps", str(args.steps),
+               "--ckpt", ckpt, "--loss-out", loss_out]
+        env = dict(os.environ)
+        if chaos_env:
+            env["DST_CHAOS"] = chaos_env
+        else:
+            env.pop("DST_CHAOS", None)
+        agent = ElasticAgent(
+            cmd, max_restarts=2, backoff_s=0.1, jitter=0.0, env=env,
+            heartbeat_path=os.path.join(base, tag, "heartbeat.json"))
+        report = agent.run()
+        if not report.succeeded:
+            raise RuntimeError(f"{tag} run failed: rc={report.returncode} "
+                               f"history={report.history}")
+        with open(loss_out) as f:
+            out = json.load(f)
+        out["restarts"] = report.restarts
+        out["reasons"] = report.reasons
+        return out
+
+    control = run("control", "")
+    chaos_spec = json.dumps({"crash_at_step": args.kill_at,
+                             "exit_process": True, "exit_code": 117})
+    chaos = run("chaos", chaos_spec)
+
+    print(f"chaos smoke: control loss={control['final_loss']:.8f} "
+          f"(steps={control['steps']}, restarts={control['restarts']})")
+    print(f"chaos smoke: chaos   loss={chaos['final_loss']:.8f} "
+          f"(steps={chaos['steps']}, restarts={chaos['restarts']}, "
+          f"reasons={chaos['reasons']})")
+    failures = 0
+    if control["restarts"] != 0:
+        print("FAIL: control run restarted")
+        failures += 1
+    if chaos["restarts"] < 1:
+        print("FAIL: chaos run was never killed (injection did not fire)")
+        failures += 1
+    if chaos["steps"] != args.steps or control["steps"] != args.steps:
+        print("FAIL: runs did not reach the target step")
+        failures += 1
+    if chaos["final_loss"] != control["final_loss"]:
+        print(f"FAIL: final loss diverged after auto-resume: "
+              f"{chaos['final_loss']!r} != {control['final_loss']!r}")
+        failures += 1
+    if failures:
+        print(f"chaos smoke: {failures} violation(s); artifacts in {base}")
+        return 1
+    print("chaos smoke: OK — killed at step "
+          f"{args.kill_at}, auto-resumed, loss identical to uninterrupted run")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,6 +69,50 @@ def test_elastic_scale_down_resume(tmp_path, monkeypatch):
     assert l < losses[0], f"resumed training regressed: {l} vs {losses}"
 
 
+def test_elastic_resume_new_mesh_from_fault_injected_checkpoint(
+        tmp_path, monkeypatch):
+    """The topology-independent-layout claim under failure: a checkpoint
+    whose save process CRASHED right after the atomic commit (latest
+    pointer never written) must still resume — onto a *different* mesh
+    shape — via the newest-valid-tag scan."""
+    import os
+    import pytest
+
+    from deepspeed_tpu.resilience import (FaultInjector, InjectedFault,
+                                          install_fault_injector)
+    from deepspeed_tpu.runtime.checkpoint import find_valid_tag
+
+    e8, batch8 = _engine(8)
+    for i in range(2):
+        e8.train_batch(shard_batch(_batch(batch8, i), e8.topo))
+    install_fault_injector(FaultInjector(crash_after_commit_at_save=1))
+    try:
+        with pytest.raises(InjectedFault):
+            e8.save_checkpoint(str(tmp_path))
+    finally:
+        install_fault_injector(None)
+    # committed but unpointed: the tag survives, 'latest' does not exist
+    assert not os.path.isfile(tmp_path / "latest")
+    assert find_valid_tag(str(tmp_path)) == "global_step2"
+
+    reset_topology()
+    import deepspeed_tpu.parallel.mesh as mesh_mod
+
+    devs = jax.devices()[:4]
+    orig_build = mesh_mod.Topology.build.__func__
+
+    def build4(cls, mesh_config=None, devices=None, zero_inner=1):
+        return orig_build(cls, mesh_config, devices or devs, zero_inner)
+
+    monkeypatch.setattr(mesh_mod.Topology, "build", classmethod(build4))
+    e4, batch4 = _engine(4)
+    assert e4.topo.world_size == 4
+    client = e4.load_checkpoint(str(tmp_path))  # newest-valid scan
+    assert client is not None and e4.global_steps == 2
+    l = float(e4.train_batch(shard_batch(_batch(batch4, 5), e4.topo))["loss"])
+    assert np.isfinite(l)
+
+
 def test_elastic_agent_restarts_until_success(tmp_path):
     """DSElasticAgent parity: worker crashes twice, then succeeds after
     restarts; DST_ELASTIC_RESTART tells the trainee which attempt it is."""
