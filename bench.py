@@ -17,7 +17,6 @@ valid JSON line on stdout, exit 0.
 
 from __future__ import annotations
 
-import functools
 import json
 import os
 import subprocess
@@ -130,39 +129,23 @@ def _child_main():
     peak = peak_flops_per_chip(jax.devices()[0].device_kind)
     mfu = tok_per_sec * flops_per_token / peak
 
-    # Steady-state rate: K engine steps inside ONE compiled lax.scan — no
-    # per-step host dispatch at all. Through the axon relay each
-    # train_batch call pays a host->device round trip that a co-located
-    # production host doesn't; the delta between this and the per-call
-    # number above IS that dispatch tax. Both are reported.
+    # Steady-state rate: K engine steps through the fused multi-step
+    # driver (engine.train_steps: ONE compiled, donated lax.scan per
+    # block — no per-step host dispatch at all). Through the axon relay
+    # each train_batch call pays a host->device round trip that a
+    # co-located production host doesn't; the delta between this and the
+    # per-call number above IS that dispatch tax. Both are reported.
     scan_ms = scan_mfu = None
     scan_flag = os.environ.get("DST_BENCH_SCAN", "1")
     try:
       if (on_tpu and scan_flag == "1") or scan_flag == "force":
-        step_fn = engine._train_step_fn
         K = 10
-
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-        def k_steps(params, opt, scaler, rng, batch):
-            def body(carry, _):
-                p, o, s, r = carry
-                p, o, s, r, metrics = step_fn(p, o, s, r, batch)
-                return (p, o, s, r), metrics["loss"]
-
-            carry, losses = jax.lax.scan(
-                body, (params, opt, scaler, rng), None, length=K)
-            return carry, losses
-
-        carry = (engine.params, engine.opt_state, engine.scaler_state,
-                 engine.rng)
-        carry, losses = k_steps(*carry, batch)          # compile + warm
-        float(losses[-1])
+        out = engine.train_steps([batch] * K)           # compile + warm
+        float(out["losses"][-1])
         t0 = time.perf_counter()
-        carry, losses = k_steps(*carry, batch)
-        float(losses[-1])
+        out = engine.train_steps([batch] * K)
+        float(out["losses"][-1])
         scan_dt = time.perf_counter() - t0
-        (engine.params, engine.opt_state, engine.scaler_state,
-         engine.rng) = carry
         scan_ms = scan_dt / K * 1e3
         scan_mfu = tokens_per_step * K / scan_dt * flops_per_token / peak
     except Exception as e:  # noqa: BLE001 — optional metric must never

@@ -106,8 +106,11 @@ def initialize(*,
 
     dataloader = None
     if training_data is not None:
+        # prefetch_depth > 0: a producer thread runs collate + sharded
+        # device_put ahead of the training loop (docs/performance.md)
         dataloader = DataLoader(training_data, cfg.train_batch_size, topology,
-                                seed=cfg.train_seed, collate_fn=collate_fn)
+                                seed=cfg.train_seed, collate_fn=collate_fn,
+                                prefetch_depth=cfg.dataloader.prefetch_depth)
         # checkpoints carry the loader position (epoch + batch index) so a
         # resumed run replays the exact remaining batch order
         engine.bind_dataloader(dataloader)
@@ -116,6 +119,17 @@ def initialize(*,
         # from the newest VALID checkpoint — torn/corrupt tags are skipped
         # by the manifest verification; a missing dir is first boot
         engine.load_checkpoint(cfg.checkpoint.save_dir, auto=True)
+    if cfg.compile.aot_warmup and dataloader is not None:
+        # AOT-compile the fused step in the background (after auto-resume:
+        # the loader's restored position decides the warmup batch shape),
+        # overlapped with the prefetch pipeline's warm fill; the first
+        # train_batch joins it (docs/performance.md)
+        try:
+            struct = dataloader.batch_struct()
+            if struct is not None:
+                engine.warmup_async(struct)
+        except Exception as e:  # warmup is best-effort, never fatal
+            logger.warning(f"AOT warmup skipped: {e}")
     return engine, engine.optimizer, dataloader, engine.lr_schedule
 
 

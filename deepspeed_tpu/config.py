@@ -442,6 +442,69 @@ class TelemetryConfig:
 
 
 @dataclass
+class DataLoaderConfig:
+    """The ``dataloader`` block: async input-pipeline knobs
+    (docs/performance.md — TPU-native analog of the reference's
+    pinned-memory staged loaders).
+
+    ``prefetch_depth`` batches are collated + uploaded by a producer
+    thread ahead of the training loop (0 = synchronous inline loading;
+    2 = double buffering, the default). ``initialize()`` threads this
+    into the :class:`~deepspeed_tpu.runtime.dataloader.DataLoader` it
+    builds; checkpoints stay FT-safe — the loader position always
+    reflects consumed batches, never producer read-ahead."""
+
+    prefetch_depth: int = 2
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "DataLoaderConfig":
+        if not d:
+            return cls()
+        d = dict(d)
+        out = cls(prefetch_depth=int(_take(d, "prefetch_depth", 2)))
+        if out.prefetch_depth < 0:
+            raise ConfigError(
+                f"dataloader.prefetch_depth must be >= 0, got {out.prefetch_depth}")
+        _warn_unknown(d, "dataloader")
+        return out
+
+
+@dataclass
+class CompileConfig:
+    """The ``compile`` block: XLA compilation-cache + warmup knobs
+    (docs/performance.md).
+
+    ``cache_dir`` enables JAX's persistent compilation cache there (time-
+    to-first-step across process restarts drops to cache-deserialize
+    time). ``aot_warmup`` makes ``initialize()`` AOT-compile the fused
+    train step (``lower().compile()``) in a background thread, overlapped
+    with the input pipeline's warm fill; the resulting executable serves
+    the steady-state steps directly. ``warn_on_recompile`` logs (once)
+    when a new batch shape misses the train-step jit cache — every new
+    shape compiles a new program; the counter ``train/recompiles`` tracks
+    it either way."""
+
+    cache_dir: Optional[str] = None
+    min_compile_time_s: float = 0.0
+    aot_warmup: bool = True
+    warn_on_recompile: bool = True
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "CompileConfig":
+        if not d:
+            return cls()
+        d = dict(d)
+        out = cls(
+            cache_dir=_take(d, "cache_dir", None),
+            min_compile_time_s=float(_take(d, "min_compile_time_s", 0.0)),
+            aot_warmup=bool(_take(d, "aot_warmup", True)),
+            warn_on_recompile=bool(_take(d, "warn_on_recompile", True)),
+        )
+        _warn_unknown(d, "compile")
+        return out
+
+
+@dataclass
 class FlopsProfilerConfig:
     """Mirrors reference ``profiling/config.py``."""
 
@@ -741,6 +804,8 @@ class Config:
     activation_checkpointing: ActivationCheckpointingConfig = field(default_factory=ActivationCheckpointingConfig)
     monitor: MonitorConfig = field(default_factory=MonitorConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    dataloader: DataLoaderConfig = field(default_factory=DataLoaderConfig)
+    compile: CompileConfig = field(default_factory=CompileConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
@@ -804,6 +869,8 @@ class Config:
                 _take(d, "tensorboard", None), _take(d, "csv_monitor", None), _take(d, "wandb", None)
             ),
             telemetry=TelemetryConfig.from_dict(_take(d, "telemetry", None)),
+            dataloader=DataLoaderConfig.from_dict(_take(d, "dataloader", None)),
+            compile=CompileConfig.from_dict(_take(d, "compile", None)),
             flops_profiler=FlopsProfilerConfig.from_dict(_take(d, "flops_profiler", None)),
             comms_logger=CommsLoggerConfig.from_dict(_take(d, "comms_logger", None)),
             pipeline=PipelineConfig.from_dict(_take(d, "pipeline", None)),
@@ -819,7 +886,7 @@ class Config:
         for k in ("amp", "zero_allow_untested_optimizer", "zero_force_ds_cpu_optimizer",
                   "gradient_accumulation_dtype", "dataloader_drop_last", "data_types",
                   "compression_training", "autotuning", "elasticity", "nebula",
-                  "curriculum_learning", "sparse_attention", "hybrid_engine", "compile"):
+                  "curriculum_learning", "sparse_attention", "hybrid_engine"):
             d.pop(k, None)
         _warn_unknown(d, "<top-level>")
         return cfg

@@ -1,0 +1,68 @@
+"""Persistent XLA compilation-cache wiring (docs/performance.md).
+
+JAX ships a content-addressed on-disk cache of compiled executables; with
+it enabled, time-to-first-step across process restarts (elastic resume,
+preemption comebacks, dev iteration) drops from a full XLA compile to a
+cache deserialize. This module is the one place the knobs are set, so the
+engine, ``initialize()`` and standalone scripts configure it identically.
+
+The cache also turns AOT warmup (``TrainEngine.warmup``) into a strict
+win even when the jit call path later re-requests the program: the warmup
+compile writes the cache entry and the jit call reads it back instead of
+compiling a second time.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..utils.logging import logger
+
+_LOCK = threading.Lock()
+_CONFIGURED_DIR: Optional[str] = None
+
+
+def enable_persistent_cache(cache_dir: str,
+                            min_compile_time_s: float = 0.0) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    ``min_compile_time_s=0`` caches every program (the right call for
+    training jobs, where even small programs recompile on every restart);
+    raise it to skip trivially cheap compiles. Idempotent per directory;
+    returns False (with a warning) when the running JAX cannot honor the
+    knobs instead of failing the caller."""
+    global _CONFIGURED_DIR
+    with _LOCK:
+        if _CONFIGURED_DIR == cache_dir:
+            return True
+        import jax
+
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              float(min_compile_time_s))
+            # cache small executables too — a training job's step program
+            # is cheap to store and expensive to recompile
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception as e:  # older/newer jax without these knobs
+            logger.warning(f"persistent compilation cache unavailable: {e}")
+            return False
+        # JAX latches the cache as initialized-disabled at the FIRST compile
+        # of the process; any compile before this call (sharded param init,
+        # another engine) would make the config update above a silent no-op.
+        # Resetting the cache state makes the next compile re-initialize it
+        # against the directory just configured.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # private API moved — cache still works when the
+            pass           # config landed before the first compile
+        _CONFIGURED_DIR = cache_dir
+        logger.info(f"persistent XLA compilation cache at {cache_dir}")
+        return True
+
+
+def configured_cache_dir() -> Optional[str]:
+    return _CONFIGURED_DIR

@@ -7,10 +7,22 @@ per-rank sampler, the loader yields *global* batches placed as sharded
 ``jax.Array``s over the mesh's batch axes — each host only materializes the
 shard it feeds (via ``jax.make_array_from_process_local_data``), which is the
 multi-host analog of DistributedSampler rank slicing.
+
+Async input pipeline (docs/performance.md): with ``prefetch_depth > 0`` a
+producer thread runs collate + curriculum + sharding-aware ``device_put``
+into a bounded queue, so batch N+1 is already resident on device while the
+step on batch N runs — the TPU analog of the reference's pinned-memory
+staged loaders. The checkpointable position (``state_dict``) always reports
+the CONSUMER's position, never the producer's read-ahead: a mid-epoch resume
+replays exactly the batches the training loop had not yet received.
 """
 
 from __future__ import annotations
 
+import queue as queue_mod
+import threading
+import time
+import weakref
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import jax
@@ -25,12 +37,20 @@ class DataLoader:
     ``dataset`` may be any sequence (or numpy arrays pytree with a leading
     sample dim). Yields pytrees of jax.Arrays with global leading dim
     ``batch_size`` sharded over the mesh batch axes.
+
+    ``prefetch_depth > 0`` turns on the background pipeline: that many
+    batches are kept in flight (collated + uploaded) ahead of the consumer.
+    ``collate_fn``/``curriculum_fn`` then run on the producer thread and
+    must be thread-safe. ``data_wait_s`` accumulates the host time the
+    consumer spent waiting for (sync: producing) each batch — the
+    engine's ``data_wait_ms`` ledger reads deltas of it.
     """
 
     def __init__(self, dataset: Any, batch_size: int, topo: Topology, *,
                  shuffle: bool = True, seed: int = 0, drop_last: bool = True,
                  collate_fn: Optional[Callable[[list], Any]] = None,
-                 curriculum_fn: Optional[Callable[[int, Any], Any]] = None):
+                 curriculum_fn: Optional[Callable[[int, Any], Any]] = None,
+                 prefetch_depth: int = 0):
         self.dataset = dataset
         self.batch_size = batch_size
         self.topo = topo
@@ -39,9 +59,18 @@ class DataLoader:
         self.drop_last = drop_last
         self.collate_fn = collate_fn or _default_collate
         self.curriculum_fn = curriculum_fn
+        self.prefetch_depth = int(prefetch_depth)
         self.epoch = 0
         self._batch_index = 0  # batches consumed in the current epoch
         self._n = _dataset_len(dataset)
+        # position generation: bumped whenever the position is rewound out
+        # from under a live iterator (rollback / resume); the prefetch
+        # consumer restarts its producer when it observes a bump
+        self._position_gen = 0
+        # weakly held: a strong reference would keep an abandoned iterator
+        # reachable forever and its finalizer's GC leg could never fire
+        self._active_prefetch: Optional[weakref.ref] = None
+        self.data_wait_s = 0.0  # cumulative host-ledger counter
         if batch_size > self._n and drop_last:
             raise ValueError(f"batch_size {batch_size} exceeds dataset size {self._n}")
 
@@ -53,6 +82,7 @@ class DataLoader:
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
         self._batch_index = 0
+        self._position_gen += 1
 
     # ------------------------------------------------------------------
     # checkpointable position (runtime/checkpoint.py commit protocol: the
@@ -63,7 +93,12 @@ class DataLoader:
         """Position of the NEXT batch to yield. A position at the end of
         an epoch is normalized to (epoch+1, 0): a checkpoint taken right
         after an epoch's last batch must resume into the next epoch, not
-        replay the one just finished."""
+        replay the one just finished.
+
+        Under an active prefetch pipeline this is the CONSUMER position —
+        batches the producer has read ahead but the training loop has not
+        yet received are NOT counted as consumed, so a resume replays
+        them."""
         epoch, b = int(self.epoch), int(self._batch_index)
         nb = len(self)
         if nb > 0 and b >= nb:
@@ -74,7 +109,9 @@ class DataLoader:
         """Restore position. Takes effect on the next ``iter()`` AND on a
         live iterator (the engine's divergence rollback rewinds the data
         stream without the training loop restarting its ``for`` loop —
-        the iterator re-reads the position before every yield)."""
+        the sync iterator re-reads the position before every yield; the
+        prefetch iterator drains its queue and restarts the producer at
+        the restored position)."""
         if int(sd.get("seed", self.seed)) != self.seed:
             from ..utils.logging import logger
 
@@ -83,6 +120,7 @@ class DataLoader:
                 f"configured seed {self.seed}; batch order will diverge")
         self.epoch = int(sd.get("epoch", 0))
         self._batch_index = int(sd.get("batch_index", 0))
+        self._position_gen += 1
 
     def _epoch_order(self, epoch: int) -> np.ndarray:
         order = np.arange(self._n)
@@ -91,12 +129,52 @@ class DataLoader:
             rng.shuffle(order)
         return order
 
+    def _assemble(self, epoch: int, b: int, order: np.ndarray, nb: int,
+                  *, pad_partial: bool) -> Optional[Any]:
+        """Index-slice + collate + curriculum for batch ``b`` of ``epoch``
+        on the host — no device placement. A trailing partial batch is
+        dropped (None) when ``drop_last`` holds and ``pad_partial`` is
+        False; otherwise it is padded by wrapping to the epoch head."""
+        idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+        if len(idx) < self.batch_size:
+            if self.drop_last and not pad_partial:
+                return None
+            idx = np.concatenate([idx, order[: self.batch_size - len(idx)]])
+        batch = self.collate_fn([_dataset_get(self.dataset, int(i)) for i in idx])
+        if self.curriculum_fn is not None:
+            batch = self.curriculum_fn(epoch * nb + b, batch)
+        return batch
+
+    def _produce(self, epoch: int, b: int, order: np.ndarray,
+                 nb: int) -> Optional[Any]:
+        """Collate + curriculum + device placement for batch ``b`` of
+        ``epoch``. Returns None for a dropped trailing partial batch.
+        Pure function of its arguments (no loader-position mutation), so
+        the producer thread and the sync iterator share it."""
+        batch = self._assemble(epoch, b, order, nb, pad_partial=False)
+        if batch is None:
+            return None
+        return self.shard(batch)
+
     def __iter__(self) -> Iterator[Any]:
+        if self._active_prefetch is not None:
+            active = self._active_prefetch()
+            if active is not None:
+                active.close()
+            self._active_prefetch = None
         nb = len(self)
         # a fully-consumed epoch (or a fresh loader) starts from 0; a
         # mid-epoch position restored by load_state_dict resumes there
         if self._batch_index >= nb:
             self._batch_index = 0
+        if self.prefetch_depth > 0:
+            it = _PrefetchIterator(self, self.prefetch_depth)
+            self._active_prefetch = weakref.ref(it)
+            return it
+        return self._sync_iter()
+
+    def _sync_iter(self) -> Iterator[Any]:
+        nb = len(self)
         epoch = self.epoch
         order = self._epoch_order(epoch)
         while self._batch_index < nb:
@@ -104,42 +182,225 @@ class DataLoader:
                 epoch = self.epoch
                 order = self._epoch_order(epoch)
             b = self._batch_index
-            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
-            if len(idx) < self.batch_size:
-                if self.drop_last:
-                    break
-                idx = np.concatenate([idx, order[: self.batch_size - len(idx)]])
-            batch = self.collate_fn([_dataset_get(self.dataset, int(i)) for i in idx])
-            if self.curriculum_fn is not None:
-                batch = self.curriculum_fn(epoch * nb + b, batch)
+            t0 = time.perf_counter()
+            batch = self._produce(epoch, b, order, nb)
+            self.data_wait_s += time.perf_counter() - t0
+            if batch is None:
+                break
             self._batch_index = b + 1
-            yield self.shard(batch)
+            yield batch
+
+    def batch_struct(self) -> Optional[Any]:
+        """ShapeDtypeStruct tree (with shardings) of the next batch this
+        loader would yield — the abstract signature the engine's AOT
+        warmup lowers against, at the cost of one collate and zero
+        device transfers. Does not advance the loader position."""
+        nb = len(self)
+        if nb == 0 or self._n == 0:
+            return None
+        b = self._batch_index if self._batch_index < nb else 0
+        order = self._epoch_order(self.epoch)
+        batch = self._assemble(self.epoch, b, order, nb, pad_partial=True)
+        batch = jax.tree_util.tree_map(np.asarray, batch)
+        cache: dict = {}
+
+        def struct(x):
+            sh = cache.get(x.ndim)
+            if sh is None:
+                sh = _ndim_sharding(self.topo, x.ndim)
+                cache[x.ndim] = sh
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+        return jax.tree_util.tree_map(struct, batch)
 
     def shard(self, batch: Any) -> Any:
-        """Place a host-global numpy batch as sharded jax.Arrays."""
-        sharding_cache = {}
+        """Place a host-global numpy batch as sharded jax.Arrays with ONE
+        ``device_put`` dispatch for the whole pytree (a batched transfer),
+        instead of one dispatch per leaf."""
+        return shard_batch(batch, self.topo)
 
-        def place(x):
-            x = np.asarray(x)
-            sh = sharding_cache.get(x.ndim)
-            if sh is None:
-                sh = self.topo.batch_sharding(x.ndim) if x.ndim > 1 else self.topo.data_sharding(max(x.ndim, 1))
-                sharding_cache[x.ndim] = sh
-            return jax.device_put(x, sh)
 
-        return jax.tree_util.tree_map(place, batch)
+def _ndim_sharding(topo: Topology, ndim: int):
+    if ndim > 1:
+        return topo.batch_sharding(ndim)
+    return topo.data_sharding(max(ndim, 1))
+
+
+_END_OF_EPOCH = "__end_of_epoch__"
+_PRODUCER_ERROR = "__error__"
+
+
+def _queue_put(q: queue_mod.Queue, stop: threading.Event, item) -> bool:
+    """Bounded put that stays responsive to the stop event (a plain
+    blocking put on a full queue would deadlock close())."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue_mod.Full:
+            continue
+    return False
+
+
+def _producer_loop(loader: "DataLoader", q: queue_mod.Queue,
+                   stop: threading.Event, gen: int, epoch: int, b: int,
+                   nb: int) -> None:
+    """Prefetch producer body: walk the epoch order from (epoch, b),
+    enqueuing produced batches until the epoch ends or ``stop`` is set.
+    Module-level on purpose — holding the iterator would pin it for the
+    thread's lifetime (see _PrefetchIterator._start_producer)."""
+    try:
+        order = loader._epoch_order(epoch)
+        while b < nb and not stop.is_set():
+            batch = loader._produce(epoch, b, order, nb)
+            if batch is None:  # dropped trailing partial batch
+                break
+            if not _queue_put(q, stop, (gen, epoch, b + 1, batch)):
+                return
+            b += 1
+        _queue_put(q, stop, (gen, _END_OF_EPOCH, 0, None))
+    except Exception as e:  # surface producer crashes to the consumer
+        _queue_put(q, stop, (gen, _PRODUCER_ERROR, 0, e))
+
+
+class _PrefetchIterator:
+    """Consumer half of the background input pipeline.
+
+    A producer thread walks the epoch order from the loader's position,
+    running collate + curriculum + ``device_put`` (async upload) and
+    enqueuing ``(generation, epoch, next_index, batch)`` into a bounded
+    queue of ``depth`` slots — double-buffered at depth 2. The consumer
+    commits the loader position only when it dequeues a batch, so
+    ``state_dict`` never observes read-ahead. A position rewound out from
+    under the iterator (rollback / resume — the loader bumps
+    ``_position_gen``) drains the queue, stops the producer and restarts
+    it at the restored position."""
+
+    _END = _END_OF_EPOCH
+
+    def __init__(self, loader: DataLoader, depth: int):
+        self.loader = loader
+        self.depth = max(1, int(depth))
+        self._closed = False
+        self._queue: queue_mod.Queue = None  # type: ignore[assignment]
+        self._stop: threading.Event = None   # type: ignore[assignment]
+        self._thread: Optional[threading.Thread] = None
+        self._finalizer: Optional[weakref.finalize] = None
+        self._gen = -1
+        self._start_producer()
+
+    # -- producer -------------------------------------------------------
+    def _start_producer(self) -> None:
+        loader = self.loader
+        self._gen = loader._position_gen
+        self._queue = queue_mod.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        nb = len(loader)
+        epoch = loader.epoch
+        b = loader._batch_index
+        if b >= nb:  # restart at a consumed-epoch position: next epoch's 0
+            b = 0
+        # the thread target is a MODULE-LEVEL function holding the loader,
+        # not this iterator: a bound-method target would keep the iterator
+        # alive for the thread's lifetime and the GC leg of the finalizer
+        # below could never run for an abandoned iterator
+        self._thread = threading.Thread(
+            target=_producer_loop,
+            args=(loader, self._queue, self._stop, self._gen, epoch, b, nb),
+            name="dst-prefetch", daemon=True)
+        # an abandoned iterator must still stop its producer — a daemon
+        # thread killed mid-device_put at interpreter teardown aborts the
+        # process from XLA's C++ side. finalize() runs on GC AND at exit.
+        if self._finalizer is not None:
+            self._finalizer.detach()
+        self._finalizer = weakref.finalize(
+            self, _shutdown_producer, self._stop, self._queue, self._thread)
+        self._thread.start()
+
+    # -- consumer -------------------------------------------------------
+    def __iter__(self) -> "_PrefetchIterator":
+        return self
+
+    def __next__(self) -> Any:
+        if self._closed:
+            raise StopIteration
+        loader = self.loader
+        while True:
+            if self._gen != loader._position_gen:
+                self._restart()
+            t0 = time.perf_counter()
+            item = self._queue.get()
+            loader.data_wait_s += time.perf_counter() - t0
+            gen, epoch, next_b, batch = item
+            if gen != self._gen:  # stale leftover from before a restart
+                continue
+            if epoch == _PRODUCER_ERROR:
+                self.close()
+                raise batch
+            if epoch == self._END:
+                if self._gen != loader._position_gen:
+                    continue  # rewound during the final get — restart
+                self.close()
+                raise StopIteration
+            # commit the consumer position (same semantics as the sync
+            # path's pre-yield `_batch_index = b + 1`)
+            loader.epoch = epoch
+            loader._batch_index = next_b
+            return batch
+
+    def _restart(self) -> None:
+        """Rewind observed: drop everything in flight and restart the
+        producer from the loader's (restored) position."""
+        self._stop_and_drain()
+        self._start_producer()
+
+    def _stop_and_drain(self) -> None:
+        if self._finalizer is not None:
+            self._finalizer.detach()
+            self._finalizer = None
+        _shutdown_producer(self._stop, self._queue, self._thread)
+        self._thread = None
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop_and_drain()
+        ref = self.loader._active_prefetch
+        if ref is not None and ref() is self:
+            self.loader._active_prefetch = None
+
+
+def _shutdown_producer(stop: threading.Event, q: queue_mod.Queue,
+                       thread: Optional[threading.Thread]) -> None:
+    """Stop a producer thread and let it exit cleanly (module-level so
+    weakref.finalize holds no reference back to the iterator)."""
+    stop.set()
+    while True:  # unblock a producer stuck on a full queue
+        try:
+            q.get_nowait()
+        except queue_mod.Empty:
+            break
+    if thread is not None and thread.is_alive():
+        thread.join(timeout=30.0)
 
 
 def shard_batch(batch: Any, topo: Topology) -> Any:
     """Place a host numpy batch pytree as sharded jax.Arrays over the mesh's
-    batch axes (standalone helper mirroring DataLoader.shard)."""
+    batch axes in one batched ``device_put`` dispatch (standalone helper
+    mirroring DataLoader.shard)."""
+    batch = jax.tree_util.tree_map(np.asarray, batch)
+    cache: dict = {}
 
-    def place(x):
-        x = np.asarray(x)
-        sh = topo.batch_sharding(x.ndim) if x.ndim > 1 else topo.data_sharding(max(x.ndim, 1))
-        return jax.device_put(x, sh)
+    def sh_for(x):
+        sh = cache.get(x.ndim)
+        if sh is None:
+            sh = _ndim_sharding(topo, x.ndim)
+            cache[x.ndim] = sh
+        return sh
 
-    return jax.tree_util.tree_map(place, batch)
+    shardings = jax.tree_util.tree_map(sh_for, batch)
+    return jax.device_put(batch, shardings)
 
 
 def _dataset_len(ds: Any) -> int:
@@ -193,6 +454,12 @@ def prefetch(iterator: Iterable, size: int = 2,
     reference loaders' pin_memory + non_blocking copies;
     flax.jax_utils.prefetch_to_device pattern).
 
+    For :class:`DataLoader` sources prefer ``prefetch_depth`` on the loader
+    itself — it adds a true producer THREAD (host collate overlaps device
+    compute) and keeps the checkpointable position consumer-accurate. This
+    wrapper stays for arbitrary iterators: it only overlaps the async
+    device_put upload, not the host-side iterator work.
+
     With ``sharding`` given, each queued batch is tree-mapped through
     ``jax.device_put`` at enqueue time — device_put is async, so the queue
     holds device arrays whose uploads are already enqueued and the
@@ -219,7 +486,6 @@ def prefetch(iterator: Iterable, size: int = 2,
                 queue.append(_place(next(it)))
             except StopIteration:
                 return
-
     enqueue(size)
     while queue:
         yield queue.popleft()

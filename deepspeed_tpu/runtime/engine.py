@@ -29,8 +29,10 @@ dynamic loss scaling (runtime/fp16/loss_scaler.py parity in
 from __future__ import annotations
 
 import os
+import threading
+import time
 from functools import partial
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -71,6 +73,39 @@ def _cast_tree(tree: Any, dtype) -> Any:
         return x
 
     return jax.tree_util.tree_map(cast, tree)
+
+
+def _jit_cache_size(fn: Any) -> int:
+    """Compiled-entry count of a jitted callable (0 when unbuilt or the
+    running JAX hides the counter)."""
+    if fn is None:
+        return 0
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return 0
+
+
+def _batch_abstract(batch: Any) -> Any:
+    """ShapeDtypeStruct tree for AOT lowering: jax.Arrays keep their
+    sharding, ShapeDtypeStructs pass through, host arrays lower with
+    unspecified placement."""
+    def leaf(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        sharding = getattr(x, "sharding", None)
+        x = np.asarray(x) if not hasattr(x, "shape") else x
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    return jax.tree_util.tree_map(leaf, batch)
+
+
+def _batch_signature(batch: Any) -> tuple:
+    """Hashable (shape, dtype) signature of a batch pytree — the part of
+    the jit cache key a dataloader can change between steps."""
+    return tuple(
+        (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", type(x).__name__)))
+        for x in jax.tree_util.tree_leaves(batch))
 
 
 def global_norm(tree: Any) -> jnp.ndarray:
@@ -272,6 +307,14 @@ class TrainEngine:
         self.micro_steps = 0
         self.skipped_steps = 0  # via the lazy property below
         self.rng = jax.random.PRNGKey(config.train_seed)
+        # commit the small carried states (scaler, rng) to the replicated
+        # sharding they come back with after a step: uncommitted first-call
+        # avals would miss the jit cache on step 2 and compile the whole
+        # train step a SECOND time (trace-stability contract: one compile
+        # per program — tests/test_perf_pipeline.py pins it)
+        repl = self.topo.replicated()
+        self.scaler_state = jax.device_put(self.scaler_state, repl)
+        self.rng = jax.device_put(self.rng, repl)
 
         # -- bookkeeping / observability
         self.timers = SynchronizedWallClockTimer()
@@ -358,6 +401,35 @@ class TrainEngine:
         self._eval_step_fn = None
         self._micro_grad_fn = None
         self._apply_update_fn = None
+
+        # -- async/compiled dispatch machinery (docs/performance.md)
+        self._train_step_raw = None          # unjitted step body (scanned by train_steps)
+        self._train_steps_fns: Dict[int, Any] = {}  # k -> jitted k-step scan
+        self._train_step_aot = None          # AOT executable from warmup()
+        self._warmup_thread: Optional[threading.Thread] = None
+        self._loader_iter = None             # persistent iterator for train_steps(k)
+        self._loader_iter_src = None
+        self._steps_fallback_logged: set = set()
+        # recompile guard: batch signatures seen per compiled program
+        self._seen_batch_sigs: Dict[str, set] = {}
+        self._recompile_warned = False
+        # trace counters: the step bodies bump these at TRACE time (the
+        # Python in a jitted function only runs while JAX (re)traces it),
+        # so each count is one program construction — the honest
+        # "compiles per program" number the trace-stability tests pin.
+        # (pjit's _cache_size() over-counts: it keys fastpath entries on
+        # argument committed-ness and can hold 2 entries for 1 executable.)
+        from collections import Counter as _Counter
+
+        self._trace_counts: Dict[str, int] = _Counter()
+        # host-overhead ledger clocks
+        self._last_call_end_t: Optional[float] = None
+        self._data_wait_prev_s = 0.0
+        if config.compile.cache_dir:
+            from .compile_cache import enable_persistent_cache
+
+            enable_persistent_cache(config.compile.cache_dir,
+                                    config.compile.min_compile_time_s)
 
     # ==================================================================
     # properties (parity with engine.py:468-:869 accessors)
@@ -546,6 +618,7 @@ class TrainEngine:
         optimizer = self.optimizer
 
         def train_step(params, opt_state, scaler_state, rng, batch):
+            self._trace_counts["train_step"] += 1  # runs at trace time only
             scale = scaler_state.scale if fp16 else jnp.ones([], jnp.float32)
 
             def micro(carry, mb):
@@ -589,8 +662,76 @@ class TrainEngine:
             }
             return new_params, new_opt, new_scaler, rng, metrics
 
+        self._train_step_raw = train_step
         donate = (0, 1, 2) if self._donate else ()
-        return jax.jit(train_step, donate_argnums=donate)
+        return jax.jit(train_step, donate_argnums=donate,
+                       out_shardings=self._step_out_shardings())
+
+    def _step_out_shardings(self):
+        """Output shardings pinning the engine state to exactly the
+        shardings it entered with. Left unspecified, GSPMD may hand the
+        carried state back under an equivalent-but-unequal sharding
+        representation, and the NEXT call's avals miss the jit cache —
+        the whole step program compiles a second time (trace-stability
+        contract, tests/test_perf_pipeline.py)."""
+        repl = self.topo.replicated()
+        scaler_sh = jax.tree_util.tree_map(lambda _: repl, self.scaler_state)
+        metrics_sh = {"loss": repl, "grad_norm": repl, "loss_scale": repl,
+                      "skipped": repl}
+        return (self.param_shardings, self.opt_state_shardings, scaler_sh,
+                repl, metrics_sh)
+
+    def _ensure_train_step_fn(self):
+        """The jitted single-step program, building it on first use. Joins
+        a pending AOT warmup thread first so a warmup-compiled executable
+        (and its persistent-cache entry) is never raced by a second
+        compile of the same program."""
+        if self._warmup_thread is not None:
+            self._warmup_thread.join()
+            self._warmup_thread = None
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        return self._train_step_fn
+
+    # ==================================================================
+    # AOT warmup (docs/performance.md): compile the fused step during
+    # initialize(), overlapped with the input pipeline's warm fill
+    def warmup(self, batch: Any) -> bool:
+        """AOT-compile the fused train step against ``batch`` — a real
+        batch or a ``jax.ShapeDtypeStruct`` tree (see
+        ``DataLoader.batch_struct``; no data movement needed). The
+        compiled executable serves subsequent ``train_batch`` calls whose
+        batch signature matches, and with the persistent compilation
+        cache enabled the compile is also written to disk, so even a
+        signature miss only pays a cache read. Returns False (warned,
+        engine fully functional on the lazy-jit path) on any failure."""
+        if self._offload_device != "none" or self._param_offload_device != "none":
+            logger.warning("AOT warmup skipped: offload parks state between"
+                           " steps (no stable arguments to lower against)")
+            return False
+        try:
+            if self._train_step_fn is None:
+                self._train_step_fn = self._build_train_step()
+            struct = _batch_abstract(batch)
+            lowered = self._train_step_fn.lower(
+                self.params, self.opt_state, self.scaler_state, self.rng,
+                struct)
+            self._train_step_aot = lowered.compile()
+            return True
+        except Exception as e:  # noqa: BLE001 — warmup must never kill init
+            logger.warning(f"AOT warmup failed (lazy jit path unaffected): {e}")
+            return False
+
+    def warmup_async(self, batch: Any) -> threading.Thread:
+        """Run :meth:`warmup` in a background thread (XLA compilation
+        releases the GIL), overlapping the compile with the caller's own
+        warm-up work — e.g. the prefetch pipeline's first fills. The first
+        ``train_batch``/``train_steps`` joins it."""
+        t = threading.Thread(target=self.warmup, args=(batch,),
+                             name="dst-aot-warmup", daemon=True)
+        self._warmup_thread = t
+        t.start()
+        return t
 
     def _update(self, params, opt_state, scaler_state, grads, scale, *,
                 clip, fp16, dynamic, optimizer, nan_skip=False):
@@ -638,10 +779,11 @@ class TrainEngine:
         """One full optimizer step over a global batch of
         ``train_batch_size`` samples (parity with PipelineEngine.train_batch
         semantics for the non-pipelined engine)."""
+        t_entry = time.perf_counter()
         for hook in self._step_hooks:
             hook(self, self.global_steps)
-        if self._train_step_fn is None:
-            self._train_step_fn = self._build_train_step()
+        fn = self._ensure_train_step_fn()
+        self._note_batch_sig(batch)
         self.tput.start()
         if self._offload_device == "nvme":
             # disk -> host staging via the aio engine (reference
@@ -657,14 +799,33 @@ class TrainEngine:
             # measured BEFORE the donated call while the argument buffers
             # are alive (no XLA compile — see _measure_step_flops)
             self._measure_step_flops(batch)
-        self.params, self.opt_state, self.scaler_state, self.rng, metrics = self._train_step_fn(
-            self.params, self.opt_state, self.scaler_state, self.rng, batch)
+        out = None
+        if self._train_step_aot is not None:
+            # warmup's AOT executable: same program, dispatched without the
+            # jit cache lookup. Any argument mismatch (new batch signature,
+            # different sharding) falls back to the lazy jit path for good.
+            try:
+                out = self._train_step_aot(
+                    self.params, self.opt_state, self.scaler_state, self.rng,
+                    batch)
+            except Exception as e:  # noqa: BLE001 — aval check precedes execution
+                logger.warning(f"AOT train step no longer matches the inputs "
+                               f"({e}); using the jit path")
+                self._train_step_aot = None
+        if out is None:
+            out = fn(self.params, self.opt_state, self.scaler_state, self.rng,
+                     batch)
+        self.params, self.opt_state, self.scaler_state, self.rng, metrics = out
         self._params_to_offload()
         if self._offload_device == "nvme":
             self._nvme_swapper.swap_out(self.opt_state)
             self.opt_state = None
         elif self._offload_device == "cpu":
             self.opt_state = jax.device_put(self.opt_state, self._opt_host_shardings)
+        # host ledger: everything from entry to here ran on the host while
+        # the device was free to execute (dispatch is async) — the per-step
+        # dispatch tax the async pipeline + train_steps(k) amortize
+        t_dispatched = time.perf_counter()
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
         # sync_obj blocks the host until the step completes — honest per-step
@@ -679,7 +840,16 @@ class TrainEngine:
             self.config.wall_clock_breakdown or want_stats
             or report_boundary) else None
         step_dt = self.tput.stop(sync_obj=sync, report_speed=True)
-        self._emit_step(metrics, wall_time_s=step_dt, log_step=report_boundary)
+        host = None
+        if want_stats:
+            host = {"host_ms": (t_dispatched - t_entry) * 1e3,
+                    "data_wait_ms": self._consume_data_wait_ms(),
+                    "dispatch_gap_ms": ((t_entry - self._last_call_end_t) * 1e3
+                                        if self._last_call_end_t is not None
+                                        else None)}
+        self._emit_step(metrics, wall_time_s=step_dt, log_step=report_boundary,
+                        host=host)
+        self._last_call_end_t = time.perf_counter()
         self._note_skipped(metrics["skipped"])
         self._last_loss = metrics["loss"]
         if self._ft_active or self.preemption_guard is not None:
@@ -692,6 +862,246 @@ class TrainEngine:
 
             see_memory_usage(f"step {self.global_steps}")
         return metrics
+
+    # ==================================================================
+    # compiled multi-step driver (docs/performance.md)
+    def train_steps_eligible(self) -> Tuple[bool, Optional[str]]:
+        """Whether ``train_steps`` may fuse k steps into one compiled
+        program, with the blocking reason when it may not. Anything that
+        must interleave HOST work between optimizer steps forces the
+        per-step path."""
+        if self._offload_device != "none" or self._param_offload_device != "none":
+            return False, "zero-offload swaps state around every step"
+        if self._step_hooks:
+            return False, "per-step hooks registered"
+        if self.preemption_guard is not None:
+            return False, "preemption-latch polling needs per-step boundaries"
+        if self._divergence is not None:
+            return False, "host-side divergence guard fetches the loss each step"
+        if self._pipelined:
+            return False, "pipelined engine schedules micro-batches itself"
+        return True, None
+
+    def train_steps(self, batches: Union[int, Sequence[Any]]) -> Dict[str, Any]:
+        """Run k optimizer steps as ONE jitted, donated ``lax.scan`` —
+        dispatch cost amortized k×, zero host work between the inner
+        steps. Bit-exact with k calls to :meth:`train_batch` (the scan
+        body IS the single-step program).
+
+        ``batches`` is a sequence of k equal-shaped global batches (e.g.
+        pulled from a prefetching loader), or an int k to pull them from
+        the bound dataloader (cycling epochs like ``RepeatingLoader``).
+
+        When the engine is ineligible (:meth:`train_steps_eligible` —
+        offload, per-step hooks, preemption polling, host divergence
+        guards), falls back to per-step ``train_batch`` calls with the
+        reason logged once. Returns the last step's metrics plus
+        ``losses``, the per-step loss vector."""
+        if isinstance(batches, int):
+            k, batches = int(batches), None  # pulled below, path-dependent
+        else:
+            batches = list(batches)
+            k = len(batches)
+        if k <= 0:
+            raise ValueError("train_steps: no batches")
+        eligible, reason = self.train_steps_eligible()
+        if not eligible or k == 1:
+            if not eligible and reason not in self._steps_fallback_logged:
+                self._steps_fallback_logged.add(reason)
+                log_dist(f"train_steps: fused multi-step path ineligible "
+                         f"({reason}); running {k} per-step train_batch calls")
+            # pull lazily, one batch per step: the ineligible reasons are
+            # exactly the ones that can checkpoint/rollback BETWEEN the
+            # inner steps (preemption drain, divergence), and the loader
+            # position those paths capture must reflect actual consumption,
+            # not a k-batch read-ahead
+            losses = []
+            metrics: Dict[str, Any] = {}
+            for i in range(k):
+                if batches is not None:
+                    b = batches[i]
+                else:
+                    pulled = self._pull_batches(1)
+                    if not pulled:  # loader is empty
+                        break
+                    b = pulled[0]
+                metrics = self.train_batch(b)
+                losses.append(metrics["loss"])
+            if not losses:
+                raise ValueError("train_steps: no batches")
+            out = dict(metrics)
+            out["losses"] = jnp.stack([jnp.asarray(l) for l in losses])
+            return out
+        if batches is None:
+            batches = self._pull_batches(k)
+            k = len(batches)
+            if k == 0:
+                raise ValueError("train_steps: no batches")
+            if k == 1:  # loader could only supply one batch
+                metrics = self.train_batch(batches[0])
+                out = dict(metrics)
+                out["losses"] = jnp.stack([jnp.asarray(metrics["loss"])])
+                return out
+
+        t_entry = time.perf_counter()
+        gap_ms = ((t_entry - self._last_call_end_t) * 1e3
+                  if self._last_call_end_t is not None else None)
+        self._ensure_train_step_fn()  # also builds _train_step_raw
+        fn = self._train_steps_fns.get(k)
+        if fn is None:
+            fn = self._build_train_steps(k)
+            self._train_steps_fns[k] = fn
+        # the k batches enter the program as a tuple and are stacked INTO
+        # the scan's leading dim inside the compiled program — stacking on
+        # the host side would pay one dispatch per leaf per block, exactly
+        # the tax this driver exists to amortize
+        batch_tuple = tuple(batches)
+        self._note_batch_sig(batch_tuple, program=f"train_steps_{k}")
+        want_stats = self.telemetry.wants_step_records
+        if want_stats and self._step_flops is None:
+            self._measure_step_flops(batches[0])
+        prev_steps = self.global_steps
+        self.tput.start()
+        self.params, self.opt_state, self.scaler_state, self.rng, ms = fn(
+            self.params, self.opt_state, self.scaler_state, self.rng,
+            batch_tuple)
+        t_dispatched = time.perf_counter()
+        self.global_steps += k
+        self.micro_steps += k * self.gradient_accumulation_steps
+        metrics = {"loss": ms["loss"][-1], "grad_norm": ms["grad_norm"][-1],
+                   "loss_scale": ms["loss_scale"][-1],
+                   "skipped": ms["skipped"][-1]}
+        sync = metrics["loss"] if (self.config.wall_clock_breakdown
+                                   or want_stats) else None
+        block_dt = self.tput.stop(sync_obj=sync, report_speed=False)
+        # keep the throughput aggregates honest: stop() booked one step of
+        # batch_size; this block ran k of them
+        self.tput.step_count = self.global_steps
+        self.tput.total_samples += self.train_batch_size * (k - 1)
+        host = None
+        if want_stats:
+            host = {"host_ms": (t_dispatched - t_entry) * 1e3,
+                    "data_wait_ms": self._consume_data_wait_ms(),
+                    "dispatch_gap_ms": gap_ms}
+        self._emit_step(metrics, wall_time_s=block_dt, log_step=False,
+                        host=host, n_steps=k)
+        self._last_call_end_t = time.perf_counter()
+        self._note_skipped(ms["skipped"].sum())
+        self._last_loss = metrics["loss"]
+        # periodic auto-save: a block can cross (or land on) a save
+        # boundary; preemption/divergence never reach here (ineligible)
+        iv = self.config.checkpoint.save_interval
+        if (self._ckpt_save_dir and iv > 0
+                and self.global_steps // iv != prev_steps // iv):
+            self.save_checkpoint(self._ckpt_save_dir)
+        out = dict(metrics)
+        out["losses"] = ms["loss"]
+        return out
+
+    def _build_train_steps(self, k: int):
+        raw = self._train_step_raw
+
+        def k_step(params, opt_state, scaler_state, rng, batch_tuple):
+            self._trace_counts[f"train_steps_{k}"] += 1  # trace time only
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *batch_tuple)
+
+            def body(carry, mb):
+                p, o, s, r = carry
+                p, o, s, r, m = raw(p, o, s, r, mb)
+                return (p, o, s, r), m
+
+            (p, o, s, r), ms = jax.lax.scan(
+                body, (params, opt_state, scaler_state, rng), stacked)
+            return p, o, s, r, ms
+
+        donate = (0, 1, 2) if self._donate else ()
+        return jax.jit(k_step, donate_argnums=donate,
+                       out_shardings=self._step_out_shardings())
+
+    def _pull_batches(self, k: int) -> List[Any]:
+        """k batches from the bound dataloader via a persistent iterator,
+        advancing epochs like RepeatingLoader when one ends mid-pull."""
+        src = self._dataloader
+        if src is None:
+            raise ValueError(
+                "train_steps(k) needs a bound dataloader (bind_dataloader) "
+                "or an explicit sequence of batches")
+        if self._loader_iter is None or self._loader_iter_src is not src:
+            self._loader_iter = iter(src)
+            self._loader_iter_src = src
+        out: List[Any] = []
+        fresh_restarts = 0
+        while len(out) < k:
+            try:
+                out.append(next(self._loader_iter))
+                fresh_restarts = 0
+            except StopIteration:
+                if fresh_restarts:  # empty loader — don't spin forever
+                    break
+                fresh_restarts += 1
+                if hasattr(src, "set_epoch"):
+                    src.set_epoch(getattr(src, "epoch", 0) + 1)
+                self._loader_iter = iter(src)
+        return out
+
+    # ==================================================================
+    # trace accounting (docs/performance.md#recompile-guard)
+    def trace_count(self, name: str = "train_step") -> int:
+        """Times the named program body was traced (each trace constructs
+        a new program and, modulo the compilation cache, a new XLA
+        compile). 1 at steady state; >1 means shape/type churn retraced
+        it. Names: ``train_step``, ``eval_step``, ``train_steps_<k>``."""
+        return int(self._trace_counts.get(name, 0))
+
+    def train_step_cache_size(self) -> int:
+        """Entry count of the fused train step's pjit call cache. NOTE:
+        fastpath entries key on argument committed-ness too, so this can
+        exceed :meth:`trace_count` by one without any recompile; use
+        trace_count for the one-compile-per-program contract."""
+        return _jit_cache_size(self._train_step_fn)
+
+    def eval_step_cache_size(self) -> int:
+        return _jit_cache_size(self._eval_step_fn)
+
+    def _note_batch_sig(self, batch: Any, program: str = "train_step") -> None:
+        """Recompile guard: a batch signature (leaf shapes/dtypes) this
+        program has not seen misses its jit cache and compiles a whole new
+        XLA program. Count it (``train/recompiles``) and warn once with
+        the remedy. Signatures are per program — the k-step driver and the
+        single-step program legitimately see different shapes."""
+        sig = _batch_signature(batch)
+        seen = self._seen_batch_sigs.setdefault(program, set())
+        if sig in seen:
+            return
+        first = not seen
+        seen.add(sig)
+        if first:
+            return
+        from ..telemetry.registry import get_registry
+
+        get_registry().counter("train/recompiles").inc()
+        if self.config.compile.warn_on_recompile and not self._recompile_warned:
+            self._recompile_warned = True
+            logger.warning(
+                f"train step RETRACED: new batch signature {sig} missed the "
+                f"jit cache (curriculum_fn changing seq length? ragged last "
+                f"batch?). Every distinct shape compiles a new XLA program — "
+                f"pad batches to a small fixed set of bucket shapes "
+                f"(docs/performance.md#recompile-guard). Further recompiles "
+                f"are counted in train/recompiles without this warning.")
+
+    def _consume_data_wait_ms(self) -> Optional[float]:
+        """Delta of the bound loader's cumulative data-wait ledger since
+        the last step record (host time the consumer spent waiting for /
+        producing batches)."""
+        dl = self._dataloader
+        cur = getattr(dl, "data_wait_s", None) if dl is not None else None
+        if cur is None:
+            return None
+        d = float(cur) - self._data_wait_prev_s
+        self._data_wait_prev_s = float(cur)
+        return d * 1e3 if d >= 0 else None
 
     # ==================================================================
     # fault tolerance (docs/fault_tolerance.md)
@@ -724,6 +1134,9 @@ class TrainEngine:
         index) in client_state, and load_checkpoint restores it — resume
         replays the exact remaining data order. Bind before iterating."""
         self._dataloader = loader
+        self._loader_iter = None
+        self._loader_iter_src = None
+        self._data_wait_prev_s = float(getattr(loader, "data_wait_s", 0.0) or 0.0)
 
     def _after_step(self, metrics: Dict[str, Any]) -> None:
         """Step-boundary fault-tolerance checks. Never called when every
@@ -813,8 +1226,16 @@ class TrainEngine:
         """Install/replace a traced params transform applied at the
         compute-cast boundary (compression QAT, pruning masks). Invalidates
         compiled step functions — call sparingly (schedule boundaries)."""
+        if self._warmup_thread is not None:
+            # an in-flight AOT warmup would re-install a pre-transform
+            # executable AFTER the reset below; let it land first
+            self._warmup_thread.join()
+            self._warmup_thread = None
         self._param_transform = fn
         self._train_step_fn = None
+        self._train_step_raw = None
+        self._train_steps_fns = {}
+        self._train_step_aot = None
         self._micro_grad_fn = None
         self._eval_step_fn = None
 
@@ -961,6 +1382,7 @@ class TrainEngine:
     def _jitted_eval(self):
         if self._eval_step_fn is None:
             def eval_step(params, batch, rng):
+                self._trace_counts["eval_step"] += 1  # trace time only
                 return self.loss_fn(self._compute_copy(params), batch, rng)
 
             self._eval_step_fn = jax.jit(eval_step)
@@ -973,7 +1395,9 @@ class TrainEngine:
     def _emit_step(self, metrics: Dict[str, Any],
                    wall_time_s: Optional[float] = None,
                    log_step: Optional[bool] = None,
-                   phase_times: Optional[Dict[str, float]] = None) -> None:
+                   phase_times: Optional[Dict[str, float]] = None,
+                   host: Optional[Dict[str, Optional[float]]] = None,
+                   n_steps: int = 1) -> None:
         """Step-boundary observability: the human log line plus — when any
         telemetry sink is configured (JSONL/Prometheus/monitor) — one
         StepStats span record through the unified pipeline. Replaces the
@@ -994,15 +1418,18 @@ class TrainEngine:
         if not self.telemetry.wants_step_records:
             return
         self.telemetry.record_step(
-            self._build_step_stats(metrics, wall_time_s, phase_times))
+            self._build_step_stats(metrics, wall_time_s, phase_times,
+                                   host=host, n_steps=n_steps))
 
     def _build_step_stats(self, metrics: Dict[str, Any],
                           wall_time_s: Optional[float],
-                          phase_times: Optional[Dict[str, float]] = None):
+                          phase_times: Optional[Dict[str, float]] = None,
+                          host: Optional[Dict[str, Optional[float]]] = None,
+                          n_steps: int = 1):
         from ..telemetry import StepStats
 
         dt = float(wall_time_s) if wall_time_s else 0.0
-        tokens = self._count_batch_tokens()
+        tokens = self._count_batch_tokens() * n_steps
         comm, comm_s = self._comm_step_delta()
         if self.telemetry.enabled:
             from ..utils.memory import device_memory_stats, host_rss_gb
@@ -1015,12 +1442,18 @@ class TrainEngine:
             memory = dict(self.tput.last_memory)
         mfu = 0.0
         if dt > 0 and self._step_flops and self._get_peak_flops():
-            mfu = self._step_flops / dt / self._get_peak_flops()
+            mfu = self._step_flops * n_steps / dt / self._get_peak_flops()
+        host = host or {}
         return StepStats(
             step=self.global_steps,
+            n_steps=n_steps,
             wall_time_s=dt,
             tokens_per_s=tokens / dt if dt > 0 else 0.0,
-            samples_per_s=self.train_batch_size / dt if dt > 0 else 0.0,
+            samples_per_s=(self.train_batch_size * n_steps / dt
+                           if dt > 0 else 0.0),
+            host_ms=host.get("host_ms"),
+            data_wait_ms=host.get("data_wait_ms"),
+            dispatch_gap_ms=host.get("dispatch_gap_ms"),
             mfu=mfu,
             loss=float(metrics["loss"]) if metrics.get("loss") is not None else None,
             grad_norm=float(metrics["grad_norm"]) if metrics.get("grad_norm") is not None else None,
@@ -1166,6 +1599,9 @@ class TrainEngine:
         if self._closed:
             return
         self._closed = True
+        if self._warmup_thread is not None:
+            self._warmup_thread.join()
+            self._warmup_thread = None
         self.telemetry.close()
         from ..telemetry import get_telemetry, set_telemetry
 

@@ -14,6 +14,16 @@ compat ``forward()``/``backward()``/``step()`` path can time the phases
 separately from the host. ``comm`` carries the CommsLogger's per-op
 breakdown (bytes always; latencies once
 :func:`deepspeed_tpu.comm.measure_comm_latencies` has backfilled them).
+
+Host-overhead ledger (docs/performance.md): ``host_ms`` is the host time
+from step entry to dispatch-complete (hooks, collate-side work, transfer +
+execute dispatch — everything that serializes the Python loop but not the
+device), ``data_wait_ms`` the host time spent waiting for / producing
+input batches since the previous record, and ``dispatch_gap_ms`` the gap
+between the previous step call returning and this one entering. A record
+may cover ``n_steps`` optimizer steps when the engine ran a compiled
+multi-step block (``train_steps(k)``); throughput fields are already
+scaled, per-step host overhead is ``(host_ms + data_wait_ms) / n_steps``.
 """
 
 from __future__ import annotations
@@ -47,6 +57,10 @@ STEP_RECORD_SCHEMA: Dict[str, tuple] = {
     "comm": ((dict,), True),
     "memory": ((dict,), True),
     "stalled": ((bool,), True),
+    "n_steps": ((int,), False),
+    "host_ms": ((float, int), False),
+    "data_wait_ms": ((float, int), False),
+    "dispatch_gap_ms": ((float, int), False),
 }
 
 
@@ -68,6 +82,12 @@ class StepStats:
     backward_s: Optional[float] = None
     optimizer_s: Optional[float] = None
     comm_s: Optional[float] = None
+    # optimizer steps covered by this record (>1 for train_steps(k) blocks)
+    n_steps: int = 1
+    # host-overhead ledger (see module docstring)
+    host_ms: Optional[float] = None
+    data_wait_ms: Optional[float] = None
+    dispatch_gap_ms: Optional[float] = None
     # per-op comm breakdown: {op: {"count": int, "bytes": int, "time_s": float}}
     comm: Dict[str, Dict[str, float]] = field(default_factory=dict)
     # device-memory watermarks from utils/memory.py (hbm_peak_gb, ...)

@@ -92,11 +92,23 @@ class Telemetry:
         """Run stall detection, update the registry, fan out to sinks.
         Returns the emitted record dict."""
         if self.stall_detector is not None:
+            # normalized per optimizer step: mixing per-step records with
+            # train_steps(k) blocks must not read as a k x stall
             stats.stalled = self.stall_detector.observe(
-                stats.step, stats.wall_time_s)
+                stats.step,
+                stats.wall_time_s / max(1, int(getattr(stats, "n_steps", 1) or 1)))
+        n = max(1, int(getattr(stats, "n_steps", 1) or 1))
         r = self.registry
-        r.counter("train/steps").inc()
-        r.histogram("train/step_time_s").observe(stats.wall_time_s)
+        r.counter("train/steps").inc(n)
+        r.histogram("train/step_time_s").observe(stats.wall_time_s / n)
+        # host-overhead ledger, normalized per optimizer step so per-step
+        # and train_steps(k) records land in comparable distributions
+        if stats.host_ms is not None:
+            r.histogram("train/host_ms").observe(stats.host_ms / n)
+        if stats.data_wait_ms is not None:
+            r.histogram("train/data_wait_ms").observe(stats.data_wait_ms / n)
+        if stats.dispatch_gap_ms is not None:
+            r.histogram("train/dispatch_gap_ms").observe(stats.dispatch_gap_ms)
         if stats.tokens_per_s:
             r.gauge("train/tokens_per_s").set(stats.tokens_per_s)
         if stats.mfu:

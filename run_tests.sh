@@ -30,6 +30,17 @@ if [ "$#" -eq 0 ]; then
     if [ "$smoke_rc" -eq 0 ]; then
         smoke_rc=$chaos_rc
     fi
+
+    # host-overhead perf smoke (CPU evidence lane, docs/performance.md):
+    # steady-state host overhead with prefetch + train_steps(8) must stay
+    # >= 2x lower than the synchronous per-step path, with zero
+    # shape-churn recompiles. The bench sizes its own device mesh.
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/host_overhead_bench.py --check
+    perf_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$perf_rc
+    fi
 fi
 
 if [ "$pytest_rc" -ne 0 ]; then

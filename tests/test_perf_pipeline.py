@@ -1,0 +1,381 @@
+"""Async input pipeline + compiled multi-step driver (docs/performance.md).
+
+Pins the PR-4 perf contracts:
+  * trace stability — exactly ONE compile of train_step (and eval_step)
+    across >= 3 steps, counted via the jit cache;
+  * bit-exactness — ``train_steps(k)`` == k calls to ``train_batch``
+    (losses AND params), so the fused driver is a pure dispatch
+    optimization;
+  * prefetch semantics — the background pipeline yields the exact batch
+    sequence of the sync loader, reports CONSUMER positions to
+    checkpoints, resumes mid-epoch bit-exact, and drains its read-ahead
+    on rollback;
+  * recompile guard — a new batch shape is counted and warned once;
+  * eligibility — offload / hooks / guards force the per-step fallback.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.runtime.dataloader import DataLoader, RepeatingLoader
+from deepspeed_tpu.telemetry.registry import MetricsRegistry, set_registry
+from simple_model import init_mlp_params, make_batch, mlp_loss, random_dataset
+
+
+def _cfg(**over):
+    cfg = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 1000,
+        "compile": {"aot_warmup": False},  # tests pin the lazy-jit path
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _make_engine(**over):
+    params = init_mlp_params(jax.random.PRNGKey(0))
+    engine, _, _, _ = dst.initialize(loss_fn=mlp_loss, params=params,
+                                     config=_cfg(**over))
+    return engine
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def _batches_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+
+
+# ----------------------------------------------------------------------
+# trace stability
+
+def test_train_step_compiles_exactly_once_across_steps():
+    engine = _make_engine()
+    batch = make_batch(16)
+    for _ in range(4):
+        engine.train_batch(batch)
+    assert engine.trace_count("train_step") == 1, (
+        f"train_step retraced: {engine.trace_count('train_step')} traces")
+
+
+def test_eval_step_compiles_exactly_once_across_steps():
+    engine = _make_engine()
+    batch = make_batch(16)
+    for _ in range(3):
+        engine.eval_batch(batch)
+    assert engine.trace_count("eval_step") == 1
+
+
+def test_train_steps_block_compiles_once_per_k():
+    engine = _make_engine()
+    batch = make_batch(16)
+    for _ in range(3):
+        engine.train_steps([batch, batch])
+    assert engine.trace_count("train_steps_2") == 1
+
+
+# ----------------------------------------------------------------------
+# bit-exactness of the fused multi-step driver
+
+@pytest.mark.parametrize("k", [2, 8])
+def test_train_steps_bit_exact_vs_per_step(k):
+    data = random_dataset(n=16 * k)
+    batches = None
+    per, fused = _make_engine(), _make_engine()
+    loader = DataLoader(data, 16, per.topo, seed=3, prefetch_depth=0)
+    batches = list(loader)
+
+    per_losses = [per.train_batch(b)["loss"] for b in batches]
+    out = fused.train_steps(batches)
+
+    assert [float(l) for l in per_losses] == [float(l) for l in out["losses"]]
+    for a, b in zip(_leaves(per.params), _leaves(fused.params)):
+        assert np.array_equal(a, b), "params diverged between the two paths"
+    assert fused.global_steps == per.global_steps == k
+
+
+def test_train_steps_pulls_from_bound_loader_and_advances_position():
+    data = random_dataset(n=64)
+    params = init_mlp_params(jax.random.PRNGKey(0))
+    engine, _, loader, _ = dst.initialize(
+        loss_fn=mlp_loss, params=params, config=_cfg(), training_data=data)
+    out = engine.train_steps(3)
+    assert len(out["losses"]) == 3
+    assert loader.state_dict()["batch_index"] == 3
+    # crossing the epoch boundary cycles like RepeatingLoader
+    engine.train_steps(2)
+    assert engine.global_steps == 5
+    assert loader.state_dict() == {"epoch": 1, "batch_index": 1,
+                                   "seed": loader.seed}
+    engine.close()
+
+
+# ----------------------------------------------------------------------
+# eligibility / fallback
+
+def test_train_steps_falls_back_with_step_hooks():
+    engine = _make_engine()
+    calls = []
+    engine.register_step_hook(lambda _e, step: calls.append(step))
+    ok, reason = engine.train_steps_eligible()
+    assert not ok and "hook" in reason
+    out = engine.train_steps([make_batch(16)] * 3)
+    assert engine.global_steps == 3
+    assert calls == [0, 1, 2]  # per-step path ran the hooks
+    assert len(out["losses"]) == 3
+
+
+def test_train_steps_falls_back_with_divergence_guard():
+    engine = _make_engine(resilience={"divergence": {"spike_action": "warn"}})
+    ok, reason = engine.train_steps_eligible()
+    assert not ok and "divergence" in reason
+    engine.train_steps([make_batch(16)] * 2)
+    assert engine.global_steps == 2
+
+
+def test_train_steps_falls_back_with_offload():
+    engine = _make_engine()
+    # the virtual-CPU test platform has no pinned-host memory space, so a
+    # config-driven offload engine silently degrades to "none"; pin the
+    # eligibility contract directly against an offloading engine state
+    engine._offload_device = "cpu"
+    ok, reason = engine.train_steps_eligible()
+    assert not ok and "offload" in reason
+
+
+# ----------------------------------------------------------------------
+# recompile guard
+
+def test_recompile_guard_counts_new_batch_shapes():
+    set_registry(MetricsRegistry())
+    from deepspeed_tpu.telemetry.registry import get_registry
+
+    engine = _make_engine()
+    engine.train_batch(make_batch(16))
+    engine.train_batch(make_batch(16))
+    assert get_registry().counter("train/recompiles").value == 0
+    # a new leading dim is a new program
+    engine.train_batch(make_batch(8))
+    assert get_registry().counter("train/recompiles").value == 1
+    assert engine.trace_count("train_step") == 2
+    # the same shapes again are cache hits, not new recompiles
+    engine.train_batch(make_batch(16))
+    engine.train_batch(make_batch(8))
+    assert get_registry().counter("train/recompiles").value == 1
+    assert engine.trace_count("train_step") == 2
+
+
+# ----------------------------------------------------------------------
+# prefetch pipeline semantics
+
+def test_prefetch_yields_same_sequence_as_sync(topo8):
+    data = random_dataset(n=128)
+    sync = DataLoader(data, 16, topo8, seed=11, prefetch_depth=0)
+    pre = DataLoader(data, 16, topo8, seed=11, prefetch_depth=3)
+    sync_seq = list(sync)
+    pre_seq = list(pre)
+    assert len(sync_seq) == len(pre_seq) == 8
+    for a, b in zip(sync_seq, pre_seq):
+        assert _batches_equal(a, b)
+
+
+def test_prefetch_state_dict_reports_consumer_not_producer(topo8):
+    data = random_dataset(n=128)
+    dl = DataLoader(data, 16, topo8, seed=11, prefetch_depth=4)
+    it = iter(dl)
+    next(it)
+    next(it)
+    # the producer has read ahead up to 4 more batches by now; the
+    # checkpointable position must still be the 2 consumed ones
+    assert dl.state_dict()["batch_index"] == 2
+    it.close()
+
+
+def test_prefetch_mid_epoch_resume_bit_exact(topo8):
+    data = random_dataset(n=128)
+    ref = list(DataLoader(data, 16, topo8, seed=11, prefetch_depth=0))
+    dl = DataLoader(data, 16, topo8, seed=11, prefetch_depth=2)
+    it = iter(dl)
+    for _ in range(3):
+        next(it)
+    snap = dl.state_dict()
+    it.close()
+
+    fresh = DataLoader(data, 16, topo8, seed=11, prefetch_depth=2)
+    fresh.load_state_dict(snap)
+    resumed = list(fresh)
+    assert len(resumed) == 5
+    for a, b in zip(resumed, ref[3:]):
+        assert _batches_equal(a, b)
+
+
+def test_prefetch_live_iterator_rollback_drains_queue(topo8):
+    """load_state_dict on a loader with an ACTIVE prefetch queue (the
+    divergence-rollback path) must discard every read-ahead batch and
+    replay from the restored position."""
+    data = random_dataset(n=128)
+    ref = list(DataLoader(data, 16, topo8, seed=11, prefetch_depth=0))
+    dl = DataLoader(data, 16, topo8, seed=11, prefetch_depth=3)
+    it = iter(dl)
+    for _ in range(6):
+        next(it)
+    dl.load_state_dict({"epoch": 0, "batch_index": 2, "seed": 11})
+    got = [next(it) for _ in range(4)]
+    for a, b in zip(got, ref[2:6]):
+        assert _batches_equal(a, b)
+    assert dl.state_dict()["batch_index"] == 6
+    it.close()
+
+
+def test_prefetch_rollback_across_epochs(topo8):
+    data = random_dataset(n=64)  # 4 batches/epoch
+    dl = DataLoader(data, 16, topo8, seed=11, prefetch_depth=2)
+    rep = iter(RepeatingLoader(dl))
+    seen = [next(rep) for _ in range(6)]  # into epoch 1
+    assert dl.epoch == 1
+    dl.load_state_dict({"epoch": 0, "batch_index": 2, "seed": 11})
+    replayed = next(rep)
+    assert _batches_equal(replayed, seen[2])
+
+
+def test_prefetch_producer_error_surfaces_in_consumer(topo8):
+    data = random_dataset(n=64)
+
+    def bad_curriculum(step, batch):
+        if step >= 2:
+            raise RuntimeError("curriculum boom")
+        return batch
+
+    dl = DataLoader(data, 16, topo8, seed=11, prefetch_depth=2,
+                    curriculum_fn=bad_curriculum)
+    it = iter(dl)
+    with pytest.raises(RuntimeError, match="curriculum boom"):
+        for _ in range(4):
+            next(it)
+
+
+def test_prefetch_engine_checkpoint_roundtrip(tmp_path):
+    """Engine-level FT interplay: a checkpoint taken mid-epoch under an
+    active prefetch queue resumes into a bit-exact continuation (params,
+    losses and data order all identical to an uninterrupted run)."""
+    data = random_dataset(n=96)
+    cfg = _cfg(checkpoint={"save_dir": str(tmp_path)})
+
+    def run(steps, resume=False, engine_holder={}):
+        params = init_mlp_params(jax.random.PRNGKey(0))
+        engine, _, loader, _ = dst.initialize(
+            loss_fn=mlp_loss, params=params, config=dict(cfg),
+            training_data=data)
+        it = iter(loader)
+        if resume:
+            engine.load_checkpoint(str(tmp_path))
+        losses = [float(engine.train_batch(next(it))["loss"])
+                  for _ in range(steps)]
+        return engine, losses
+
+    # uninterrupted 6-step reference
+    ref_engine, ref_losses = run(6)
+    # interrupted at 3 + checkpoint + fresh-process resume for 3 more
+    e1, first = run(3)
+    e1.save_checkpoint(str(tmp_path))
+    e2, rest = run(3, resume=True)
+    assert first + rest == ref_losses
+    for a, b in zip(_leaves(ref_engine.params), _leaves(e2.params)):
+        assert np.array_equal(a, b)
+    for e in (ref_engine, e1, e2):
+        e.close()
+
+
+# ----------------------------------------------------------------------
+# config threading + single-dispatch shard
+
+def test_initialize_threads_prefetch_depth():
+    data = random_dataset(n=64)
+    params = init_mlp_params(jax.random.PRNGKey(0))
+    _, _, dl_default, _ = dst.initialize(loss_fn=mlp_loss, params=params,
+                                         config=_cfg(), training_data=data)
+    assert dl_default.prefetch_depth == 2  # the config default
+    _, _, dl_off, _ = dst.initialize(
+        loss_fn=mlp_loss, params=params,
+        config=_cfg(dataloader={"prefetch_depth": 0}), training_data=data)
+    assert dl_off.prefetch_depth == 0
+
+
+def test_shard_places_whole_tree_correctly(topo8):
+    dl = DataLoader(random_dataset(n=32), 16, topo8, seed=0)
+    batch = {"x": np.ones((16, 8), np.float32),
+             "y": np.arange(16, dtype=np.int32)}
+    placed = dl.shard(batch)
+    assert placed["x"].sharding.spec[0] == ("data",)  # batch dim over data
+    assert placed["y"].sharding.spec[0] == ("data",)
+    assert np.array_equal(np.asarray(placed["x"]), batch["x"])
+    assert np.array_equal(np.asarray(placed["y"]), batch["y"])
+
+
+# ----------------------------------------------------------------------
+# AOT warmup
+
+def test_warmup_aot_matches_lazy_jit_bit_exact():
+    data = random_dataset(n=64)
+    lazy = _make_engine()
+    warmed = _make_engine()
+    loader = DataLoader(data, 16, warmed.topo, seed=3, prefetch_depth=0)
+    assert warmed.warmup(loader.batch_struct())
+    assert warmed._train_step_aot is not None
+    batches = list(loader)
+    for b in batches:
+        la = lazy.train_batch(b)["loss"]
+        lw = warmed.train_batch(b)["loss"]
+        assert float(la) == float(lw)
+    for a, b in zip(_leaves(lazy.params), _leaves(warmed.params)):
+        assert np.array_equal(a, b)
+    # the AOT executable served every step: the jit call cache stayed cold
+    assert warmed.train_step_cache_size() == 0
+
+
+def test_warmup_falls_back_on_signature_change():
+    engine = _make_engine()
+    engine.warmup(make_batch(16))
+    engine.train_batch(make_batch(8))  # mismatched aval -> lazy jit path
+    assert engine._train_step_aot is None
+    assert engine.train_step_cache_size() == 1
+
+
+# ----------------------------------------------------------------------
+# telemetry ledger
+
+def test_host_overhead_ledger_in_step_records(tmp_path):
+    import json
+
+    out = tmp_path / "telemetry"
+    data = random_dataset(n=64)
+    params = init_mlp_params(jax.random.PRNGKey(0))
+    engine, _, loader, _ = dst.initialize(
+        loss_fn=mlp_loss, params=params,
+        config=_cfg(telemetry={"enabled": True, "output_dir": str(out)}),
+        training_data=data)
+    it = iter(loader)
+    for _ in range(3):
+        engine.train_batch(next(it))
+    engine.train_steps(2)
+    engine.close()
+
+    from deepspeed_tpu.telemetry import validate_step_record
+
+    records = [json.loads(l) for l in open(out / "steps.jsonl")]
+    assert len(records) == 4  # 3 per-step + 1 fused block
+    for rec in records:
+        assert validate_step_record(rec) == []
+        assert rec["host_ms"] is not None and rec["host_ms"] >= 0
+        assert rec["data_wait_ms"] is not None
+    assert [r["n_steps"] for r in records] == [1, 1, 1, 2]
+    assert records[-1]["step"] == 5
