@@ -21,6 +21,7 @@ from .parallel.mesh import Topology, get_topology, initialize_topology, set_topo
 from .runtime.engine import TrainEngine
 from .runtime.dataloader import DataLoader, RepeatingLoader  # noqa: F401
 from . import comm  # noqa: F401
+from . import serving  # noqa: F401
 from . import telemetry  # noqa: F401
 from .comm.comm import init_distributed  # noqa: F401
 from .telemetry import MetricsRegistry, StepStats, get_telemetry  # noqa: F401

@@ -415,6 +415,9 @@ class TelemetryConfig:
     stall_window: int = 20
     stall_warmup_steps: int = 2
     heartbeat_path: Optional[str] = None
+    # serving-request span records (docs/serving.md); None defaults to
+    # <output_dir>/requests.jsonl, "" disables the sink
+    requests_jsonl_path: Optional[str] = None
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryConfig":
@@ -433,6 +436,7 @@ class TelemetryConfig:
             stall_window=int(_take(d, "stall_window", 20)),
             stall_warmup_steps=int(_take(d, "stall_warmup_steps", 2)),
             heartbeat_path=_take(d, "heartbeat_path", None),
+            requests_jsonl_path=_take(d, "requests_jsonl_path", None),
         )
         if out.stall_factor <= 1.0:
             raise ConfigError(
@@ -700,6 +704,8 @@ class ChaosConfig:
     collective_fail_at_call: int = -1
     collective_delay_s: float = 0.0
     collective_delay_every: int = 0
+    serving_tick_fail_at: int = -1
+    serving_tick_fail_every: int = 0
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ChaosConfig":
@@ -720,6 +726,8 @@ class ChaosConfig:
             collective_fail_at_call=int(_take(d, "collective_fail_at_call", -1)),
             collective_delay_s=float(_take(d, "collective_delay_s", 0.0)),
             collective_delay_every=int(_take(d, "collective_delay_every", 0)),
+            serving_tick_fail_at=int(_take(d, "serving_tick_fail_at", -1)),
+            serving_tick_fail_every=int(_take(d, "serving_tick_fail_every", 0)),
         )
         _warn_unknown(d, "resilience.chaos")
         return out
@@ -743,6 +751,74 @@ class ResilienceConfig:
             chaos=ChaosConfig.from_dict(_take(d, "chaos", None)),
         )
         _warn_unknown(d, "resilience")
+        return out
+
+
+@dataclass
+class ServingConfig:
+    """The ``serving`` block: knobs for the request front-end over the
+    ragged engine (docs/serving.md).
+
+    ``policy`` selects the admission/preemption policy (``"slo"`` —
+    priority tiers + earliest-deadline-first + KV-pressure preemption —
+    or ``"fcfs"``, the strict-arrival-order baseline).  ``max_queue``
+    bounds the admission queue: submissions beyond it are REJECTED
+    immediately (explicit backpressure).  ``reserve_output_blocks``
+    charges admission for the whole remaining output, so an admitted
+    request cannot exhaust the KV pool mid-decode; turning it off admits
+    more aggressively and relies on mid-tick preemption to recover.
+    ``tick_retry_limit`` is the per-request budget for re-queue-on-tick-
+    fault before the request is failed.  ``stuck_tick_timeout_s`` arms
+    the watchdog (0 disables it)."""
+
+    max_queue: int = 256
+    policy: str = "slo"
+    kv_pressure: float = 0.90
+    reject_expired: bool = True
+    preemption: bool = True
+    reserve_output_blocks: bool = True
+    default_max_new_tokens: int = 128
+    poll_interval_s: float = 0.002
+    drain_timeout_s: float = 120.0
+    stuck_tick_timeout_s: float = 30.0
+    tick_retry_limit: int = 1
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
+        if not d:
+            return cls()
+        d = dict(d)
+        out = cls(
+            max_queue=int(_take(d, "max_queue", 256)),
+            policy=str(_take(d, "policy", "slo")),
+            kv_pressure=float(_take(d, "kv_pressure", 0.90)),
+            reject_expired=bool(_take(d, "reject_expired", True)),
+            preemption=bool(_take(d, "preemption", True)),
+            reserve_output_blocks=bool(_take(d, "reserve_output_blocks", True)),
+            default_max_new_tokens=int(_take(d, "default_max_new_tokens", 128)),
+            poll_interval_s=float(_take(d, "poll_interval_s", 0.002)),
+            drain_timeout_s=float(_take(d, "drain_timeout_s", 120.0)),
+            stuck_tick_timeout_s=float(_take(d, "stuck_tick_timeout_s", 30.0)),
+            tick_retry_limit=int(_take(d, "tick_retry_limit", 1)),
+        )
+        if out.policy not in ("slo", "fcfs"):
+            raise ConfigError(
+                f"serving.policy must be 'slo' or 'fcfs', got '{out.policy}'")
+        if out.max_queue < 1:
+            raise ConfigError(
+                f"serving.max_queue must be >= 1, got {out.max_queue}")
+        if not 0.0 <= out.kv_pressure <= 1.0:
+            raise ConfigError(
+                f"serving.kv_pressure must be in [0, 1], got {out.kv_pressure}")
+        if out.tick_retry_limit < 0:
+            raise ConfigError(
+                f"serving.tick_retry_limit must be >= 0, got "
+                f"{out.tick_retry_limit}")
+        if out.default_max_new_tokens < 1:
+            raise ConfigError(
+                f"serving.default_max_new_tokens must be >= 1, got "
+                f"{out.default_max_new_tokens}")
+        _warn_unknown(d, "serving")
         return out
 
 
@@ -811,6 +887,7 @@ class Config:
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
 
     raw: Dict[str, Any] = field(default_factory=dict)
@@ -876,6 +953,7 @@ class Config:
             pipeline=PipelineConfig.from_dict(_take(d, "pipeline", None)),
             checkpoint=CheckpointConfig.from_dict(_take(d, "checkpoint", None)),
             resilience=ResilienceConfig.from_dict(_take(d, "resilience", None)),
+            serving=ServingConfig.from_dict(_take(d, "serving", None)),
             data_efficiency=DataEfficiencyConfig.from_dict(_take(d, "data_efficiency", None)),
             raw=raw,
         )

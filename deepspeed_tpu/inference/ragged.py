@@ -65,6 +65,14 @@ def _use_pallas_paged(head_dim: int, block: int, dtype,
 
 # ----------------------------------------------------------------------
 # host-side state (reference: ragged/blocked_allocator.py, ragged_manager.py)
+
+class PoolExhausted(RuntimeError):
+    """The KV page pool cannot satisfy a schedule's block demand.
+    A dedicated type so recovery code (the serving driver preempts a
+    decode and retries) can distinguish this RECOVERABLE condition from
+    arbitrary device RuntimeErrors — substring-matching the message
+    would misfire on e.g. XLA's 'Resource exhausted' device OOM."""
+
 class BlockedAllocator:
     """Refcounted free-list allocator over ``n_blocks`` KV pages
     (reference blocked_allocator.py — same capability, python list instead
@@ -83,7 +91,8 @@ class BlockedAllocator:
 
     def allocate(self, n: int) -> List[int]:
         if n > len(self._free):
-            raise RuntimeError(f"KV pool exhausted: need {n}, have {len(self._free)}")
+            raise PoolExhausted(
+                f"KV pool exhausted: need {n}, have {len(self._free)}")
         out, self._free = self._free[:n], self._free[n:]
         for b in out:
             self._ref[b] = 1
@@ -210,6 +219,58 @@ class PrefixCache:
     def drop_all(self, allocator: BlockedAllocator) -> None:
         while self._entries:
             self._evict_one(allocator)
+
+
+def block_balance_report(engine) -> Dict[str, Any]:
+    """Audit the engine's KV-page accounting: every page must be exactly
+    one of free / sequence-held / cache-held, and the allocator's
+    refcount for each held page must equal the number of holders
+    (sequence occurrences + prefix-cache entry references).
+
+    Returns ``{"free": int, "held": int, "problems": [str, ...]}`` —
+    ``problems`` empty means zero leaks and exact refcount balance. The
+    serving drain check and the cancellation tests assert on this; it is
+    pure host-side dict walking (never touches the device)."""
+    alloc = engine.allocator
+    free = set(alloc._free)
+    held = set(alloc._ref)
+    problems: List[str] = []
+    if len(free) != len(alloc._free):
+        problems.append("duplicate pages in the free list")
+    overlap = free & held
+    if overlap:
+        problems.append(f"pages both free and referenced: "
+                        f"{sorted(overlap)[:8]}")
+    vanished = set(range(alloc.n_blocks)) - free - held
+    if vanished:
+        problems.append(f"pages leaked (not free, not referenced): "
+                        f"{sorted(vanished)[:8]}")
+    expected: Dict[int, int] = {}
+    for seq in engine.seqs.values():
+        for b in seq.blocks:
+            expected[int(b)] = expected.get(int(b), 0) + 1
+    if engine.prefix_cache is not None:
+        for b, n in engine.prefix_cache._block_refs.items():
+            expected[int(b)] = expected.get(int(b), 0) + n
+    for b in sorted(held | set(expected)):
+        have, want = alloc._ref.get(b, 0), expected.get(b, 0)
+        if have != want:
+            problems.append(f"page {b}: allocator refcount {have} != "
+                            f"{want} holders")
+    return {"free": len(free), "held": len(held), "problems": problems}
+
+
+def assert_block_balance(engine, expect_free: Optional[int] = None) -> None:
+    """Raise AssertionError on any block-accounting imbalance (and, when
+    given, on ``free != expect_free``)."""
+    rep = block_balance_report(engine)
+    if rep["problems"]:
+        raise AssertionError("KV block balance violated: "
+                             + "; ".join(rep["problems"]))
+    if expect_free is not None and rep["free"] != expect_free:
+        raise AssertionError(
+            f"KV free-page count {rep['free']} != expected {expect_free} "
+            f"({rep['held']} pages still referenced)")
 
 
 def _prompt_lookup(ctx: Sequence[int], ngram: int, k: int) -> List[int]:
@@ -349,6 +410,10 @@ class RaggedInferenceEngine:
                              if cfg.enable_prefix_cache else None)
         self.seqs: Dict[int, SequenceDescriptor] = {}
         self._free_slots = list(range(cfg.max_seqs))
+        # uids whose next admission is a RESUME (post-preempt/discard):
+        # their fresh descriptors must not re-record TTFT/latency — the
+        # serving layer's request spans carry the true end-to-end numbers
+        self._resume_uids: set = set()
         self.max_pages = cfg.max_context // cfg.kv_block_size
         # paged KV pool: per-layer tuples of [n_blocks + 1, hkv, block, hd]
         # (last page = scratch sink for masked-out batch lanes; duplicate
@@ -433,6 +498,15 @@ class RaggedInferenceEngine:
             free += self.prefix_cache.reclaimable_blocks(self.allocator)
         return free
 
+    def blocks_needed(self, n_tokens: int) -> int:
+        """KV pages a fresh sequence of ``n_tokens`` is charged at
+        admission (its pages at full length, +1 write scratch). The ONE
+        place this formula lives: the serving layer's admission oracle
+        and submit-time over-pool reject must agree with the allocator,
+        or admission either over-rejects feasible requests or admits
+        requests that hit PoolExhausted mid-decode every tick."""
+        return -(-int(n_tokens) // self.config.kv_block_size) + 1
+
     def can_schedule(self, uids: Sequence[int], lengths: Sequence[int]) -> bool:
         """Whether prompts of the given lengths fit (slots + kv blocks) —
         reference engine_v2.can_schedule :179."""
@@ -445,7 +519,7 @@ class RaggedInferenceEngine:
                 total = seq.seen + length
                 need_blocks += max(0, -(-total // bs) - len(seq.blocks))
             else:
-                need_blocks += -(-length // bs) + 1
+                need_blocks += self.blocks_needed(length)
         return (len(new) <= len(self._free_slots)
                 and need_blocks <= self._available_blocks())
 
@@ -468,6 +542,43 @@ class RaggedInferenceEngine:
                                               seq.seen, self.allocator)
                 self.allocator.free(seq.blocks)
                 self._free_slots.append(seq.slot)
+
+    def preempt(self, uid: int) -> List[int]:
+        """Release ``uid``'s slot + KV blocks WITHOUT retiring it as a
+        completed request (no latency record) — the serving layer's
+        eviction hook. Full KV blocks are published into the prefix cache
+        first (when enabled), so the preempted prompt + generated tokens
+        re-prefill mostly from cached pages on resume. Returns the
+        KV-backed token stream (tokens actually prefilled/decoded; a
+        mid-prefill tail that never reached the KV pool is excluded)."""
+        seq = self.seqs.get(uid)
+        if seq is None:
+            return []
+        toks = list(seq.tokens[:seq.seen])
+        seq.t_created = None          # suppress request-retired telemetry
+        self.flush([uid])
+        self._resume_uids.add(uid)
+        return toks
+
+    def discard(self, uid: int) -> None:
+        """Drop ``uid`` releasing its blocks + slot while publishing
+        NOTHING into the prefix cache — the recovery hook for a failed
+        step whose KV integrity is unknown (``seen`` may have advanced
+        without the scatter landing). Zero-leak either way."""
+        seq = self.seqs.pop(uid, None)
+        if seq is None:
+            return
+        self.allocator.free(seq.blocks)
+        self._free_slots.append(seq.slot)
+        self._resume_uids.add(uid)
+
+    def clear_resume(self, uid: int) -> None:
+        """Forget a ``preempt()``/``discard()`` resume marker for a uid
+        that will never be re-admitted (it went terminal in the serving
+        layer). Without this, a LATER unrelated sequence reusing the uid
+        would silently skip its TTFT/latency telemetry, and the marker
+        set would grow without bound under preempt-then-cancel churn."""
+        self._resume_uids.discard(uid)
 
     def trim(self, uid: int, length: int) -> None:
         """Rewind ``uid`` to its first ``length`` tokens, freeing now-unused
@@ -538,10 +649,12 @@ class RaggedInferenceEngine:
                 if not self._free_slots:
                     raise RuntimeError("no free sequence slots; flush() first")
                 now = time.perf_counter()
-                self.seqs[uid] = SequenceDescriptor(uid=uid,
-                                                    slot=self._free_slots.pop(),
-                                                    t_admitted=now,
-                                                    t_created=now)
+                resumed = uid in self._resume_uids
+                self._resume_uids.discard(uid)
+                self.seqs[uid] = SequenceDescriptor(
+                    uid=uid, slot=self._free_slots.pop(),
+                    t_admitted=None if resumed else now,
+                    t_created=None if resumed else now)
             seq = self.seqs[uid]
             seq.tokens.extend(int(t) for t in toks)
             if new:
@@ -728,7 +841,7 @@ class RaggedInferenceEngine:
         if short > self.allocator.free_blocks and self.prefix_cache is not None:
             self.prefix_cache.evict_for(self.allocator, short)
         if short > self.allocator.free_blocks:
-            raise RuntimeError(
+            raise PoolExhausted(
                 f"KV pool exhausted: need {short} blocks, have "
                 f"{self.allocator.free_blocks}; flush() finished "
                 "sequences first")

@@ -19,6 +19,7 @@ from .chaos import (  # noqa: F401
     CollectiveFault,
     FaultInjector,
     InjectedFault,
+    TickFault,
     corrupt_tag,
     get_fault_injector,
     install_fault_injector,

@@ -17,7 +17,11 @@ event so tests (tests/test_fault_tolerance.py) and the chaos smoke loop
   PreemptionGuard) or die outright before training step K;
 * ``collective_fail_op`` / ``collective_delay_s`` — fail or delay facade
   collectives through the comm-facade hook (``comm.comm._CHAOS_HOOK``,
-  fired at trace time where the facade records the op).
+  fired at trace time where the facade records the op);
+* ``serving_tick_fail_at`` / ``serving_tick_fail_every`` — fail serving
+  engine ticks (:class:`TickFault`, a *recoverable* RuntimeError: the
+  ServingEngine's request-level retry-or-fail path is the code under
+  test, so unlike the faults above it must be catchable).
 
 Faults raise :class:`InjectedFault` (a ``BaseException``) so retry helpers
 and broad ``except Exception`` recovery code never swallow an injected
@@ -55,6 +59,15 @@ class CollectiveFault(InjectedFault):
     """An injected collective failure (flaky fabric simulation)."""
 
 
+class TickFault(RuntimeError):
+    """An injected SERVING-TICK failure. Deliberately a plain
+    ``RuntimeError`` — unlike :class:`InjectedFault` — because it
+    simulates the *recoverable* class of device-step errors (transient
+    XLA failure, allocator hiccup) that the serving driver is REQUIRED to
+    absorb: the recovery path under test is the catcher, so the fault
+    must be catchable. Process-killing faults stay BaseException."""
+
+
 class FaultInjector:
     """Seeded fault schedule. All ``*_at_save`` indices are 1-based save
     counts; ``*_at_step`` match the engine's ``global_steps`` value at the
@@ -72,7 +85,9 @@ class FaultInjector:
                  collective_fail_op: str = "",
                  collective_fail_at_call: int = -1,
                  collective_delay_s: float = 0.0,
-                 collective_delay_every: int = 0):
+                 collective_delay_every: int = 0,
+                 serving_tick_fail_at: int = -1,
+                 serving_tick_fail_every: int = 0):
         fields = {
             "seed": seed,
             "crash_before_commit_at_save": crash_before_commit_at_save,
@@ -86,6 +101,8 @@ class FaultInjector:
             "collective_fail_at_call": collective_fail_at_call,
             "collective_delay_s": collective_delay_s,
             "collective_delay_every": collective_delay_every,
+            "serving_tick_fail_at": serving_tick_fail_at,
+            "serving_tick_fail_every": serving_tick_fail_every,
         }
         for name, default in fields.items():
             setattr(self, name,
@@ -125,7 +142,8 @@ class FaultInjector:
                  "sigterm_at_step", "crash_at_step", "exit_process",
                  "exit_code", "collective_fail_op",
                  "collective_fail_at_call", "collective_delay_s",
-                 "collective_delay_every"}
+                 "collective_delay_every", "serving_tick_fail_at",
+                 "serving_tick_fail_every"}
         unknown = set(spec) - known
         if unknown:
             logger.warning(f"{CHAOS_ENV}: ignoring unknown keys {sorted(unknown)}")
@@ -174,6 +192,18 @@ class FaultInjector:
             signal.raise_signal(signal.SIGTERM)
         if step == self.crash_at_step:
             self._crash("crash_at_step")
+
+    def on_serving_tick(self, tick: int) -> None:
+        """Fail serving ticks: at exactly ``serving_tick_fail_at``
+        (1-based tick count) and/or every ``serving_tick_fail_every``-th
+        tick. Raises :class:`TickFault` — the recoverable class: the
+        serving driver's retry-or-fail path is the code under test."""
+        if (tick == self.serving_tick_fail_at
+                or (self.serving_tick_fail_every > 0
+                    and tick % self.serving_tick_fail_every == 0)):
+            self._count("serving_tick_fail")
+            logger.warning(f"chaos: failing serving tick {tick}")
+            raise TickFault(f"injected serving tick fault at tick {tick}")
 
     def on_collective(self, op: str) -> None:
         n = self._collective_calls.get(op, 0) + 1

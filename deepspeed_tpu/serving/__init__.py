@@ -1,0 +1,27 @@
+"""Serving layer: request lifecycle, SLO-aware continuous-batching
+scheduling, and a streaming front-end over the ragged engine.
+
+This is the FastGen/MII serving surface the reference exposes
+(``mii/batching/ragged_batching.py``, the DeepSpeed-FastGen blog's
+throughput-under-SLA methodology) promoted into a first-class subsystem:
+:class:`Request` descriptors with a validated state machine, pluggable
+admission/preemption policies (FCFS baseline + SLO-aware
+earliest-deadline-first), and a :class:`ServingEngine` that owns the
+background tick loop, backpressure, cancellation, graceful drain and
+fault recovery. See docs/serving.md.
+"""
+
+from .request import (  # noqa: F401
+    InvalidTransition,
+    Request,
+    RequestState,
+    TERMINAL_STATES,
+)
+from .scheduler import (  # noqa: F401
+    CapacityView,
+    FCFSPolicy,
+    SLOPolicy,
+    SchedulerPolicy,
+    make_policy,
+)
+from .server import ServingEngine  # noqa: F401

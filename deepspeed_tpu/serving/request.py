@@ -1,0 +1,193 @@
+"""Request descriptor and lifecycle state machine for the serving layer.
+
+A :class:`Request` is the unit the serving front-end schedules: one
+prompt, one output stream, one SLO. The state machine is the contract
+every scheduler policy and the driver loop must respect:
+
+    QUEUED -> PREFILL -> DECODE -> FINISHED
+       |         |          |
+       |         +----------+--> QUEUED     (preemption / tick-fault retry)
+       |         |          |
+       +---------+----------+--> CANCELLED  (user cancel; fault budget spent)
+       |
+       +--> REJECTED                        (full queue; hopeless deadline)
+
+FINISHED / CANCELLED / REJECTED are terminal; any other transition is a
+programming error and raises :class:`InvalidTransition` instead of
+silently corrupting accounting. The re-queue edge (preemption) carries
+the tokens generated so far: on re-admission the engine prefills
+``prompt + emitted`` — with the prefix cache on, mostly from cached KV
+pages — and greedy decode continues the stream bit-exactly.
+
+The reference's serving front-end (MII / FastGen,
+``mii/batching/ragged_batching.py``) tracks the same lifecycle across
+several ad-hoc queues; here it is one explicit, validated enum.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"        # submitted, not yet admitted to the engine
+    PREFILL = "prefill"      # admitted; prompt KV being built (SplitFuse)
+    DECODE = "decode"        # prompt done; generating one token per tick
+    FINISHED = "finished"    # max_new_tokens or EOS reached
+    CANCELLED = "cancelled"  # user cancel or fault budget exhausted
+    REJECTED = "rejected"    # never admitted (full queue / hopeless SLO)
+
+
+TERMINAL_STATES = frozenset(
+    {RequestState.FINISHED, RequestState.CANCELLED, RequestState.REJECTED})
+
+_VALID_TRANSITIONS = {
+    RequestState.QUEUED: {RequestState.PREFILL, RequestState.CANCELLED,
+                          RequestState.REJECTED},
+    RequestState.PREFILL: {RequestState.DECODE, RequestState.QUEUED,
+                           RequestState.CANCELLED},
+    RequestState.DECODE: {RequestState.FINISHED, RequestState.QUEUED,
+                          RequestState.CANCELLED},
+    RequestState.FINISHED: set(),
+    RequestState.CANCELLED: set(),
+    RequestState.REJECTED: set(),
+}
+
+
+class InvalidTransition(RuntimeError):
+    """An illegal request state transition (driver/scheduler bug)."""
+
+
+_uid_counter = itertools.count(1)
+
+
+@dataclass
+class Request:
+    """One serving request: prompt in, token stream out, SLO attached.
+
+    ``priority`` — larger is more important; the SLO policy admits higher
+    tiers first and preempts lower tiers under KV pressure. ``deadline_s``
+    / ``ttft_deadline_s`` are RELATIVE to submission; absolute clocks are
+    derived at submit time. ``on_token`` is invoked from the driver thread
+    once per emitted token — it must be cheap and must not call back into
+    the serving engine (deadlock: the driver holds the engine lock).
+    """
+
+    prompt: List[int]
+    max_new_tokens: int = 128
+    eos_token_id: Optional[int] = None
+    priority: int = 0
+    deadline_s: Optional[float] = None       # end-to-end SLO, from submit
+    ttft_deadline_s: Optional[float] = None  # first-token SLO, from submit
+    on_token: Optional[Callable[[int], None]] = None
+    uid: int = field(default_factory=lambda: next(_uid_counter))
+
+    # -- lifecycle bookkeeping (driver-owned; read-only for callers) ----
+    state: RequestState = RequestState.QUEUED
+    tokens: List[int] = field(default_factory=list)   # emitted so far
+    error: Optional[str] = None
+    preemptions: int = 0
+    retries: int = 0          # tick-fault re-queues (distinct from preempts)
+    t_submit: Optional[float] = None     # perf_counter clocks
+    t_admit: Optional[float] = None      # last admission (re-set on resume)
+    t_first_admit: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_finish: Optional[float] = None
+
+    def __post_init__(self):
+        self.prompt = [int(t) for t in self.prompt]
+        if not self.prompt:
+            raise ValueError("Request needs a non-empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        self._done = threading.Event()
+        # driver-internal: the next token to feed the engine (produced by
+        # the previous tick's logits, not yet admitted as context)
+        self._pending_token: Optional[int] = None
+        self._cancel_requested = False
+
+    # -- state machine --------------------------------------------------
+    def transition(self, new: RequestState) -> None:
+        if new not in _VALID_TRANSITIONS[self.state]:
+            raise InvalidTransition(
+                f"request {self.uid}: illegal transition "
+                f"{self.state.name} -> {new.name}")
+        self.state = new
+        if new in TERMINAL_STATES:
+            self.t_finish = time.perf_counter()
+            self._done.set()
+
+    @property
+    def is_terminal(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    @property
+    def is_live(self) -> bool:
+        """Admitted to the engine (holds a slot + KV blocks)."""
+        return self.state in (RequestState.PREFILL, RequestState.DECODE)
+
+    # -- deadlines ------------------------------------------------------
+    def absolute_deadline(self) -> Optional[float]:
+        if self.deadline_s is None or self.t_submit is None:
+            return None
+        return self.t_submit + self.deadline_s
+
+    def in_slo(self, now: Optional[float] = None) -> Optional[bool]:
+        """Whether the request met its SLO (None when it carries none).
+        For a finished request this judges the finish time; for a live
+        one, whether the SLO is still achievable as of ``now``."""
+        dl = self.absolute_deadline()
+        verdicts = []
+        if dl is not None:
+            t = self.t_finish if self.t_finish is not None else \
+                (now if now is not None else time.perf_counter())
+            verdicts.append(t <= dl)
+        if self.ttft_deadline_s is not None and self.t_submit is not None:
+            t = self.t_first_token
+            if t is None:
+                t = now if now is not None else time.perf_counter()
+            verdicts.append(t <= self.t_submit + self.ttft_deadline_s)
+        if not verdicts:
+            return None
+        return all(verdicts)
+
+    # -- results --------------------------------------------------------
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until terminal. Returns False on timeout."""
+        return self._done.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Wait and return the emitted tokens. Raises on non-FINISHED
+        terminal states (cancelled / rejected requests have no result)."""
+        if not self.wait(timeout):
+            raise TimeoutError(f"request {self.uid} still {self.state.name}")
+        if self.state is not RequestState.FINISHED:
+            raise RuntimeError(
+                f"request {self.uid} ended {self.state.name}"
+                + (f": {self.error}" if self.error else ""))
+        return list(self.tokens)
+
+    # -- spans ----------------------------------------------------------
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.t_submit is None or self.t_first_admit is None:
+            return None
+        return self.t_first_admit - self.t_submit
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_submit is None or self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.t_submit is None or self.t_finish is None:
+            return None
+        return self.t_finish - self.t_submit

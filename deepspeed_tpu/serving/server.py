@@ -1,0 +1,635 @@
+"""The serving front-end: request lifecycle over the ragged engine.
+
+``ServingEngine`` is the production surface the FastGen/MII blogs
+describe — live request arrival, SLO-aware continuous batching,
+streaming responses — promoted out of the benchmark script's throwaway
+loop (scripts/tpu_serve_bench.py pre-PR5) into a real subsystem:
+
+* ``submit()`` with bounded-queue backpressure: a full queue rejects
+  explicitly (state REJECTED) instead of buffering unboundedly while
+  TTFTs rot;
+* a background driver thread runs one engine tick at a time — the
+  policy (:mod:`.scheduler`) picks the request set, the engine's
+  Dynamic-SplitFuse packing fits it into the one static step shape;
+* ``stream()`` yields tokens as the driver emits them;
+* ``cancel()`` at any lifecycle stage releases the engine state it
+  holds (slot + KV pages) with zero leaked blocks;
+* preempted requests resume bit-exactly: the driver re-prefills
+  ``prompt + emitted`` (the prefix cache makes this cheap) and greedy
+  decode continues the identical stream;
+* a tick fault (device error, injected chaos) discards the touched
+  engine state — never publishing suspect KV into the prefix cache —
+  and re-queues each touched request until its retry budget is spent;
+* ``drain()`` stops admission and serves out the backlog; a
+  :class:`~deepspeed_tpu.resilience.preemption.PreemptionGuard` latch
+  triggers the same graceful drain (finish live work, reject the queue)
+  so a cloud preemption never tears down mid-request;
+* a watchdog thread flags stuck ticks (``serving/stuck_ticks``) when a
+  device call wedges past ``stuck_tick_timeout_s``.
+
+Serving decodes greedily (argmax on the engine's returned logits):
+bit-exact preempt-resume and fault-retry require the continuation to be
+a pure function of the token stream. Sampling belongs in the engine's
+own ``generate``/``stream`` paths.
+
+Telemetry: per-request spans (queue_wait, TTFT, tokens/s — see
+:class:`~deepspeed_tpu.telemetry.spans.RequestStats`) plus queue-depth /
+KV-occupancy gauges and admitted/rejected/preempted counters, all
+through the shared registry (docs/observability.md, docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..inference.ragged import PoolExhausted
+from ..utils.logging import log_dist, logger
+from .request import Request, RequestState
+from .scheduler import CapacityView, SchedulerPolicy, make_policy
+
+
+class ServingEngine:
+    """SLO-aware continuous-batching front-end over a
+    :class:`~deepspeed_tpu.inference.ragged.RaggedInferenceEngine`."""
+
+    def __init__(self, engine, config: Any = None,
+                 policy: Optional[SchedulerPolicy] = None,
+                 preemption_guard: Any = None,
+                 start: bool = True):
+        from ..config import ServingConfig
+
+        if config is None:
+            config = ServingConfig()
+        elif isinstance(config, dict):
+            config = ServingConfig.from_dict(config)
+        self.config = config
+        self._engine = engine
+        self.policy = policy if policy is not None else make_policy(
+            config.policy, **(dict(kv_pressure=config.kv_pressure,
+                                   reject_expired=config.reject_expired,
+                                   preemption=config.preemption)
+                              if config.policy == "slo" else {}))
+        self._guard = preemption_guard
+        self._lock = threading.RLock()
+        self._queue: List[Request] = []
+        self._live: Dict[int, Request] = {}
+        self._requests: Dict[int, Request] = {}   # uid -> non-terminal req
+        self._accepting = True
+        self._span_backlog: List[Request] = []   # retired, span not yet emitted
+        self._last_gauges: Optional[tuple] = None
+        self._stop_evt = threading.Event()
+        self._tick_count = 0
+        self._in_tick = False
+        self._tick_started = 0.0
+        self._stuck_reported = False
+        self._driver: Optional[threading.Thread] = None
+        self._watchdog: Optional[threading.Thread] = None
+        log_dist(f"ServingEngine: policy={self.policy.name} "
+                 f"max_queue={config.max_queue} "
+                 f"preemption={getattr(self.policy, 'preemption', False)}")
+        if start:
+            self.start()
+
+    # -- telemetry (resolved per call: pipeline may install later) -------
+    @property
+    def _telemetry(self):
+        from ..telemetry import get_telemetry
+
+        return get_telemetry()
+
+    def _count(self, name: str, n: float = 1.0) -> None:
+        self._telemetry.registry.counter(f"serving/{name}").inc(n)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        if self._driver is not None:
+            return
+        self._driver = threading.Thread(target=self._drive, daemon=True,
+                                        name="serving-driver")
+        self._driver.start()
+        if self.config.stuck_tick_timeout_s > 0:
+            self._watchdog = threading.Thread(target=self._watch, daemon=True,
+                                              name="serving-watchdog")
+            self._watchdog.start()
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               priority: int = 0,
+               deadline_s: Optional[float] = None,
+               ttft_deadline_s: Optional[float] = None,
+               on_token=None) -> Request:
+        """Enqueue a request. Returns immediately; the request may come
+        back already REJECTED (backpressure — full queue, serving closed,
+        or a prompt the engine can never hold). Callers stream via
+        ``on_token`` or block on ``request.result()``."""
+        req = Request(prompt=list(prompt),
+                      max_new_tokens=(max_new_tokens if max_new_tokens
+                                      is not None
+                                      else self.config.default_max_new_tokens),
+                      eos_token_id=eos_token_id, priority=priority,
+                      deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+                      on_token=on_token)
+        req.t_submit = time.perf_counter()
+        with self._lock:
+            if not self._accepting:
+                self._reject(req, "serving closed to new requests")
+            elif (len(req.prompt) + req.max_new_tokens
+                    > self._engine.config.max_context):
+                # would deadlock FCFS at the head of the queue forever
+                self._reject(req, "prompt + max_new_tokens exceeds "
+                                  "engine max_context")
+            elif (self._engine.blocks_needed(len(req.prompt)
+                                             + req.max_new_tokens)
+                    > self._engine.allocator.n_blocks):
+                # same deadlock via the KV pool: a request that can never
+                # hold all its pages at once can never finish — it would
+                # head-of-line-block FCFS (and thrash mid-decode recovery
+                # under any policy) forever
+                self._reject(req, "prompt + max_new_tokens exceeds "
+                                  "engine KV pool capacity")
+            elif len(self._queue) >= self.config.max_queue:
+                self._reject(req, "admission queue full")
+            else:
+                self._requests[req.uid] = req
+                self._queue.append(req)
+        self._flush_spans()
+        return req
+
+    def stream(self, prompt: Sequence[int], **kwargs):
+        """Generator yielding tokens as the driver emits them. Breaking
+        out (or ``close()``-ing the generator) cancels the request."""
+        if "on_token" in kwargs:
+            raise ValueError("stream() owns the on_token callback")
+        q: "queue_mod.Queue[int]" = queue_mod.Queue()
+        req = self.submit(prompt, on_token=q.put, **kwargs)
+        if req.state is RequestState.REJECTED:
+            raise RuntimeError(f"request rejected: {req.error}")
+        try:
+            emitted = 0
+            while True:
+                try:
+                    yield q.get(timeout=0.05)
+                    emitted += 1
+                except queue_mod.Empty:
+                    if req.is_terminal:
+                        break
+            while emitted < len(req.tokens):   # tokens raced the sentinel
+                yield q.get_nowait()
+                emitted += 1
+            if req.state is RequestState.REJECTED:
+                # shed after admission to the queue (deadline expiry,
+                # drain, preemption latch) — must not read as a
+                # successful empty/partial generation
+                raise RuntimeError(f"request rejected: {req.error}")
+            if req.state is RequestState.CANCELLED and req.error:
+                raise RuntimeError(f"request failed: {req.error}")
+        finally:
+            if not req.is_terminal:
+                self.cancel(req)
+
+    def cancel(self, req) -> bool:
+        """Cancel by Request or uid. QUEUED requests die immediately;
+        live ones are released by the driver at the next tick boundary.
+        Returns False for unknown/already-terminal requests."""
+        with self._lock:
+            if not isinstance(req, Request):
+                req = self._requests.get(int(req))
+            if req is None or req.is_terminal:
+                return False
+            req._cancel_requested = True
+            if req.state is RequestState.QUEUED:
+                self._queue.remove(req)
+                self._retire(req, RequestState.CANCELLED)
+        self._flush_spans()
+        return True
+
+    def drain(self, timeout: Optional[float] = None,
+              reject_queued: bool = False) -> bool:
+        """Stop accepting new requests and serve out the backlog. With
+        ``reject_queued`` the queue is rejected instead of served (the
+        preemption-latch shutdown shape). Returns True when every request
+        reached a terminal state within ``timeout``."""
+        with self._lock:
+            self._accepting = False
+            if reject_queued:
+                for req in list(self._queue):
+                    self._queue.remove(req)
+                    self._reject(req, "rejected at drain")
+        self._flush_spans()
+        deadline = time.perf_counter() + (
+            timeout if timeout is not None else self.config.drain_timeout_s)
+        while time.perf_counter() < deadline:
+            with self._lock:
+                if not self._queue and not self._live:
+                    return True
+            time.sleep(0.002)
+        with self._lock:
+            return not self._queue and not self._live
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Graceful shutdown: drain, cancel whatever would not finish,
+        stop the driver + watchdog threads."""
+        drained = self.drain(timeout=timeout)
+        if not drained:
+            with self._lock:
+                stuck = list(self._queue) + list(self._live.values())
+            for req in stuck:
+                self.cancel(req)
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 5.0:
+                with self._lock:
+                    if not self._queue and not self._live:
+                        break
+                time.sleep(0.002)
+        self._stop_evt.set()
+        for t in (self._driver, self._watchdog):
+            if t is not None:
+                t.join(timeout=5.0)
+        self._driver = self._watchdog = None
+        self._flush_spans()
+        self._update_gauges()
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def live_requests(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def block_leaks(self) -> List[str]:
+        """Allocator block-balance problems (empty = zero leak). Valid
+        when idle (post-drain); mid-tick reads race the driver."""
+        from ..inference.ragged import block_balance_report
+
+        return block_balance_report(self._engine)["problems"]
+
+    # -- driver ----------------------------------------------------------
+    def _drive(self) -> None:
+        poll = self.config.poll_interval_s
+        while not self._stop_evt.is_set():
+            if (self._guard is not None and self._guard.should_stop
+                    and self._accepting):
+                logger.warning("ServingEngine: preemption latched — "
+                               "draining (finishing live requests, "
+                               "rejecting the queue)")
+                with self._lock:
+                    self._accepting = False
+                    for req in list(self._queue):
+                        self._queue.remove(req)
+                        self._reject(req, "preemption drain")
+                self._flush_spans()
+            try:
+                # start-time/flag writes must precede _in_tick: the
+                # watchdog samples these fields without the lock, and the
+                # reverse order lets it judge a fresh tick against the
+                # previous tick's stale clock after an idle stretch
+                self._tick_started = time.perf_counter()
+                self._stuck_reported = False
+                self._in_tick = True
+                did_work = self._tick()
+            except Exception:
+                # a driver-loop bug must not silently wedge every caller
+                logger.exception("ServingEngine: driver tick crashed")
+                did_work = False
+            finally:
+                self._in_tick = False
+            if not did_work:
+                self._stop_evt.wait(poll)
+
+    def _watch(self) -> None:
+        timeout = self.config.stuck_tick_timeout_s
+        while not self._stop_evt.wait(min(1.0, timeout / 4)):
+            if (self._in_tick and not self._stuck_reported
+                    and time.perf_counter() - self._tick_started > timeout):
+                self._stuck_reported = True
+                self._count("stuck_ticks")
+                logger.warning(
+                    f"ServingEngine: tick {self._tick_count} stuck for "
+                    f"> {timeout:.0f}s (device call wedged?)")
+
+    def _tick(self) -> bool:
+        """One driver iteration: cancellations, admission (+ preemption),
+        one engine ``put()``, token dispatch. Returns False when idle."""
+        with self._lock:
+            self._process_cancellations()
+            self._admit()
+            uids, toks = self._build_feed()
+        if not uids:
+            self._flush_spans()
+            self._update_gauges()
+            return False
+        self._tick_count += 1
+        self._count("ticks")
+        try:
+            from ..resilience.chaos import get_fault_injector
+
+            inj = get_fault_injector()
+            if inj is not None:
+                inj.on_serving_tick(self._tick_count)
+            uids, logits = self._put_with_recovery(uids, toks)
+        except Exception as e:   # InjectedFault crashes (BaseException) pass
+            self._on_tick_fault(uids, e)
+            self._flush_spans()
+            return True
+        with self._lock:
+            self._dispatch(uids, logits)
+        self._flush_spans()
+        self._update_gauges()
+        return True
+
+    # -- tick phases (lock held) ----------------------------------------
+    def _process_cancellations(self) -> None:
+        for uid, req in list(self._live.items()):
+            if req._cancel_requested:
+                self._release_engine_state(uid, publish=True)
+                del self._live[uid]
+                self._retire(req, RequestState.CANCELLED)
+
+    def _admit(self) -> None:
+        now = time.perf_counter()
+        capacity = CapacityView(self._engine,
+                                reserve_output=self.config.reserve_output_blocks,
+                                live=list(self._live.values()))
+        for req in self.policy.admission_order(list(self._queue), now):
+            if req._cancel_requested:
+                # requeued (fault retry / mid-tick eviction) with a
+                # cancel pending: die here, not after another prefill
+                self._queue.remove(req)
+                self._retire(req, RequestState.CANCELLED)
+                continue
+            reason = self.policy.should_reject(req, now)
+            if reason is not None:
+                self._queue.remove(req)
+                self._reject(req, reason)
+                continue
+            if not capacity.fits(req):
+                victims = self.policy.preemption_victims(
+                    req, list(self._live.values()), capacity, now)
+                for victim in victims:
+                    self._preempt(victim)
+                    capacity.uncharge_live(victim)
+                if not victims or not capacity.fits(req):
+                    if self.policy.head_of_line_blocking:
+                        break
+                    continue
+            self._queue.remove(req)
+            req.transition(RequestState.PREFILL)
+            req.t_admit = now
+            if req.t_first_admit is None:
+                req.t_first_admit = now
+            req._pending_token = None
+            self._live[req.uid] = req
+            capacity.charge(req)
+            self._count("admitted")
+
+    def _preempt(self, victim: Request) -> None:
+        self._release_engine_state(victim.uid, publish=True)
+        self._live.pop(victim.uid, None)
+        victim.transition(RequestState.QUEUED)
+        victim.preemptions += 1
+        victim._pending_token = None
+        self._queue.append(victim)
+        self._count("preempted")
+        logger.info(f"ServingEngine: preempted request {victim.uid} "
+                    f"(priority {victim.priority}, "
+                    f"{len(victim.tokens)} tokens in)")
+
+    def _build_feed(self) -> Tuple[List[int], List[List[int]]]:
+        """Assemble this tick's ``put()`` arguments: full resume context
+        for freshly admitted requests, empty continuation chunks for
+        mid-prefill ones, one pending decode token each for the rest."""
+        uids: List[int] = []
+        toks: List[List[int]] = []
+        for uid, req in self._live.items():
+            seq = self._engine.seqs.get(uid)
+            if seq is None:
+                uids.append(uid)
+                toks.append(req.prompt + req.tokens)
+            elif seq.pending > 0:
+                uids.append(uid)
+                toks.append([])
+            elif req._pending_token is not None:
+                uids.append(uid)
+                toks.append([req._pending_token])
+        return uids, toks
+
+    # -- tick phases (lock NOT held) ------------------------------------
+    def _put_with_recovery(self, uids, toks):
+        """One engine tick; on KV-pool exhaustion, preempt the cheapest
+        decode and retry. Tokens are admitted to the engine's descriptors
+        before its pool check, so retries feed empty chunks — and an
+        evicted victim must leave the feed entirely, or put() would mint
+        a fresh empty descriptor for it and leak its slot."""
+        uids, toks = list(uids), list(toks)
+        attempts = 0
+        while True:
+            try:
+                return uids, self._engine.put(uids, toks)
+            except PoolExhausted:
+                # the typed catch matters: a generic device RuntimeError
+                # (e.g. XLA 'Resource exhausted' OOM) must take the
+                # tick-fault path once, not preempt healthy decodes and
+                # re-run the failing program live-count times
+                if attempts >= len(self._live):
+                    raise
+                attempts += 1
+                with self._lock:
+                    victim = self._pool_pressure_victim(set(uids))
+                    if victim is None:
+                        raise
+                    self._preempt(victim)
+                    if victim.uid in uids:
+                        i = uids.index(victim.uid)
+                        uids.pop(i)
+                        toks.pop(i)
+                    if not uids:
+                        raise
+                toks = [[] for _ in uids]   # already admitted: continue only
+
+    def _pool_pressure_victim(self, feed_uids) -> Optional[Request]:
+        """Mid-tick eviction pick when the pool runs dry despite admission
+        control: the lowest-priority, latest-deadline decode — preferring
+        one outside this tick's feed (cheaper: nothing to rebuild)."""
+        pool = [r for r in self._live.values()
+                if r.state is RequestState.DECODE]
+        if not pool:
+            return None
+        dl = getattr(self.policy, "_deadline_key", lambda r: float("inf"))
+        pool.sort(key=lambda r: (r.priority, -dl(r)))
+        for r in pool:
+            if r.uid not in feed_uids:
+                return r
+        return pool[0]
+
+    def _on_tick_fault(self, uids, exc: Exception) -> None:
+        """A tick died (device error / injected chaos). Engine state for
+        every touched uid is suspect — ``seen`` may have advanced without
+        its KV being written — so it is DISCARDED (never published into
+        the prefix cache) and each request retries from its token stream,
+        or fails once its budget is spent. No block leaks either way."""
+        self._count("tick_faults")
+        logger.warning(f"ServingEngine: tick {self._tick_count} fault: "
+                       f"{type(exc).__name__}: {exc}")
+        with self._lock:
+            for uid in uids:
+                self._release_engine_state(uid, publish=False)
+                req = self._live.pop(uid, None)
+                if req is None:
+                    continue
+                req._pending_token = None
+                if req._cancel_requested:
+                    # no point retrying a request the caller already
+                    # abandoned (cancel landed while put() was in flight)
+                    self._retire(req, RequestState.CANCELLED)
+                    continue
+                req.retries += 1
+                if req.retries <= self.config.tick_retry_limit:
+                    req.transition(RequestState.QUEUED)
+                    self._queue.append(req)
+                else:
+                    req.error = (f"tick fault after {req.retries - 1} "
+                                 f"retries: {exc}")
+                    self._retire(req, RequestState.CANCELLED)
+
+    def _dispatch(self, uids, logits: np.ndarray) -> None:
+        """Turn the tick's logits into emitted tokens, completions and
+        telemetry."""
+        now = time.perf_counter()
+        finished: List[int] = []
+        for row, uid in zip(logits, uids):
+            req = self._live.get(uid)
+            if req is None or np.isnan(row[0]):
+                continue                      # evicted mid-tick / prefilling
+            tok = int(np.argmax(row))
+            if req.state is RequestState.PREFILL:
+                req.transition(RequestState.DECODE)
+                if req.t_first_token is None:
+                    req.t_first_token = now
+            req.tokens.append(tok)
+            req._pending_token = tok
+            if req.on_token is not None:
+                try:
+                    req.on_token(tok)
+                except Exception:
+                    logger.exception(
+                        f"ServingEngine: on_token callback failed "
+                        f"(request {req.uid}); cancelling its stream")
+                    req._cancel_requested = True
+            if (len(req.tokens) >= req.max_new_tokens
+                    or (req.eos_token_id is not None
+                        and tok == req.eos_token_id)):
+                finished.append(uid)
+        for uid in finished:
+            req = self._live.pop(uid)
+            self._engine.flush([uid])         # publishes into prefix cache
+            self._retire(req, RequestState.FINISHED)
+
+    # -- shared helpers --------------------------------------------------
+    def _release_engine_state(self, uid: int, publish: bool) -> None:
+        """Release whatever the engine holds for ``uid``. ``publish``
+        offers full KV blocks to the prefix cache (cancel / preempt);
+        tick faults must not (the KV may be torn)."""
+        if uid not in self._engine.seqs:
+            return
+        if publish:
+            self._engine.preempt(uid)
+        else:
+            self._engine.discard(uid)
+
+    def _reject(self, req: Request, reason: str) -> None:
+        req.error = reason
+        self._retire(req, RequestState.REJECTED)
+
+    def _retire(self, req: Request, state: RequestState) -> None:
+        req.transition(state)
+        self._requests.pop(req.uid, None)
+        # a preempted/faulted request that dies without re-admission must
+        # not leave a stale resume marker behind (uid-reuse telemetry)
+        self._engine.clear_resume(req.uid)
+        self._count({RequestState.FINISHED: "completed",
+                     RequestState.CANCELLED: "cancelled",
+                     RequestState.REJECTED: "rejected"}[state])
+        # span emission does disk I/O (JSONL write + flush): defer it out
+        # of the serving lock — every _retire caller holds it, and a slow
+        # sink must not stall submit()/cancel()/the next tick
+        self._span_backlog.append(req)
+
+    def _flush_spans(self) -> None:
+        """Emit deferred request spans OUTSIDE the serving lock (the
+        request objects are terminal and immutable by now)."""
+        if not self._span_backlog:   # unlocked peek: the idle driver loop
+            return                   # must not take the lock every poll
+        with self._lock:
+            backlog, self._span_backlog = self._span_backlog, []
+        for req in backlog:
+            self._emit_span(req)
+
+    def _emit_span(self, req: Request) -> None:
+        from ..telemetry.spans import RequestStats
+
+        t = self._telemetry
+        if not t.enabled:
+            return
+        n = len(req.tokens)
+        decode_s = (req.t_finish - req.t_first_token
+                    if req.t_finish is not None
+                    and req.t_first_token is not None else None)
+        # SLO verdict: judge completions against their deadlines; a
+        # rejected or failed request that CARRIED an SLO is a miss (the
+        # terminal timestamp is not a serve time — judging it would read
+        # near-100% attainment exactly when the system sheds load); a
+        # user cancel is the caller's choice, not judged
+        had_slo = (req.deadline_s is not None
+                   or req.ttft_deadline_s is not None)
+        if req.state is RequestState.FINISHED:
+            in_slo = req.in_slo()
+        elif req.state is RequestState.CANCELLED and req.error is None:
+            in_slo = None
+        else:
+            in_slo = False if had_slo else None
+        t.record_request_span(RequestStats(
+            uid=req.uid, state=req.state.value, priority=req.priority,
+            prompt_tokens=len(req.prompt), new_tokens=n,
+            queue_wait_s=req.queue_wait_s, ttft_s=req.ttft_s,
+            # latency only for served requests: near-zero reject/cancel
+            # "latencies" would drag the histogram DOWN exactly when the
+            # system sheds load (same shedding guard as in_slo below)
+            latency_s=(req.latency_s
+                       if req.state is RequestState.FINISHED else None),
+            # n tokens span n-1 decode intervals (the first token ends
+            # prefill): n/decode_s would inflate the rate, infinitely so
+            # for single-token requests
+            tokens_per_s=((n - 1) / decode_s if decode_s and n > 1 else None),
+            preemptions=req.preemptions, retries=req.retries,
+            in_slo=in_slo, error=req.error))
+
+    def _update_gauges(self) -> None:
+        t = self._telemetry
+        if not t.enabled:
+            return
+        with self._lock:
+            depth, live = len(self._queue), len(self._live)
+        snap = (depth, live, self._engine.kv_occupancy())
+        if snap == self._last_gauges:   # idle loop: don't re-publish
+            return                      # unchanged values every poll
+        self._last_gauges = snap
+        r = t.registry
+        r.gauge("serving/queue_depth").set(depth)
+        r.gauge("serving/live_requests").set(live)
+        r.gauge("serving/kv_occupancy").set(snap[2])

@@ -17,9 +17,12 @@ from .registry import (  # noqa: F401
     set_registry,
 )
 from .spans import (  # noqa: F401
+    REQUEST_RECORD_SCHEMA,
     SCHEMA_VERSION,
     STEP_RECORD_SCHEMA,
+    RequestStats,
     StepStats,
+    validate_request_record,
     validate_step_record,
 )
 from .sinks import (  # noqa: F401

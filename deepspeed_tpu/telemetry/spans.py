@@ -101,13 +101,77 @@ class StepStats:
         return d
 
 
-def validate_step_record(record: Dict[str, Any]) -> List[str]:
-    """Validate one JSONL step record against :data:`STEP_RECORD_SCHEMA`.
-    Returns a list of violation strings; empty means valid."""
+# ----------------------------------------------------------------------
+# serving-request spans (docs/serving.md): one record per request reaching
+# a terminal state (FINISHED / CANCELLED / REJECTED). Written by the
+# ServingEngine through Telemetry.record_request_span into
+# <output_dir>/requests.jsonl — a separate stream from steps.jsonl so each
+# file validates against exactly one schema.
+REQUEST_RECORD_SCHEMA: Dict[str, tuple] = {
+    "schema_version": ((int,), True),
+    "uid": ((int,), True),
+    "state": ((str,), True),
+    "priority": ((int,), True),
+    "prompt_tokens": ((int,), True),
+    "new_tokens": ((int,), True),
+    "timestamp": ((float, int), True),
+    "queue_wait_s": ((float, int), False),
+    "ttft_s": ((float, int), False),
+    "latency_s": ((float, int), False),
+    "tokens_per_s": ((float, int), False),
+    "preemptions": ((int,), True),
+    "retries": ((int,), True),
+    "in_slo": ((bool,), False),
+    "error": ((str,), False),
+}
+
+_REQUEST_STATES = ("finished", "cancelled", "rejected",
+                   "queued", "prefill", "decode")
+
+
+@dataclass
+class RequestStats:
+    """One serving request's span record: where its latency went
+    (queue wait vs TTFT vs decode) and how it ended."""
+
+    uid: int
+    state: str
+    priority: int = 0
+    prompt_tokens: int = 0
+    new_tokens: int = 0
+    queue_wait_s: Optional[float] = None
+    ttft_s: Optional[float] = None
+    latency_s: Optional[float] = None
+    tokens_per_s: Optional[float] = None
+    preemptions: int = 0
+    retries: int = 0
+    in_slo: Optional[bool] = None      # None = request carried no SLO
+    error: Optional[str] = None
+    timestamp: float = field(default_factory=time.time)
+
+    def to_record(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["schema_version"] = SCHEMA_VERSION
+        return d
+
+
+def validate_request_record(record: Dict[str, Any]) -> List[str]:
+    """Validate one requests.jsonl record against
+    :data:`REQUEST_RECORD_SCHEMA`. Returns violation strings; empty means
+    valid."""
+    errors = _validate_against(record, REQUEST_RECORD_SCHEMA)
+    state = record.get("state") if isinstance(record, dict) else None
+    if isinstance(state, str) and state not in _REQUEST_STATES:
+        errors.append(f"unknown request state '{state}'")
+    return errors
+
+
+def _validate_against(record: Dict[str, Any],
+                      schema: Dict[str, tuple]) -> List[str]:
     errors: List[str] = []
     if not isinstance(record, dict):
         return [f"record is {type(record).__name__}, expected dict"]
-    for name, (types, required) in STEP_RECORD_SCHEMA.items():
+    for name, (types, required) in schema.items():
         if name not in record or record[name] is None:
             if required:
                 errors.append(f"missing required field '{name}'")
@@ -119,6 +183,18 @@ def validate_step_record(record: Dict[str, Any]) -> List[str]:
         elif not isinstance(v, types):
             errors.append(
                 f"field '{name}' is {type(v).__name__}, expected {types}")
+    if record.get("schema_version") not in (None, SCHEMA_VERSION):
+        errors.append(
+            f"schema_version {record.get('schema_version')} != {SCHEMA_VERSION}")
+    return errors
+
+
+def validate_step_record(record: Dict[str, Any]) -> List[str]:
+    """Validate one JSONL step record against :data:`STEP_RECORD_SCHEMA`.
+    Returns a list of violation strings; empty means valid."""
+    errors = _validate_against(record, STEP_RECORD_SCHEMA)
+    if errors and not isinstance(record, dict):
+        return errors
     if isinstance(record.get("comm"), dict):
         for op, entry in record["comm"].items():
             if not isinstance(entry, dict):
@@ -132,7 +208,4 @@ def validate_step_record(record: Dict[str, Any]) -> List[str]:
         for k, v in record["memory"].items():
             if not isinstance(v, (int, float)) or isinstance(v, bool):
                 errors.append(f"memory['{k}'] non-numeric")
-    if record.get("schema_version") not in (None, SCHEMA_VERSION):
-        errors.append(
-            f"schema_version {record.get('schema_version')} != {SCHEMA_VERSION}")
     return errors

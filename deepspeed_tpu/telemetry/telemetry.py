@@ -17,13 +17,14 @@ no-ops, so instrumented call sites need no conditional imports.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Dict, List, Optional
 
 from ..utils.logging import logger
 from .heartbeat import Heartbeat, StallDetector
 from .registry import MetricsRegistry, get_registry
 from .sinks import JsonlSink, MonitorSink, PrometheusTextExporter
-from .spans import StepStats
+from .spans import RequestStats, StepStats
 
 
 class Telemetry:
@@ -39,6 +40,12 @@ class Telemetry:
         self.stall_detector: Optional[StallDetector] = None
         self.heartbeat: Optional[Heartbeat] = None
         self._closed = False
+        self._requests_path: Optional[str] = None
+        self._requests_sink: Optional[JsonlSink] = None
+        # spans arrive concurrently from the serving driver thread and
+        # client threads (submit/cancel emit outside the serving lock):
+        # serialize sink creation + writes or lines tear
+        self._requests_lock = threading.Lock()
 
         enabled = bool(getattr(config, "enabled", False))
         if enabled:
@@ -71,6 +78,14 @@ class Telemetry:
             hb_path = getattr(config, "heartbeat_path", None)
             if hb_path and writer_rank:
                 self.heartbeat = Heartbeat(hb_path)
+            # serving-request spans get their own JSONL stream (a step
+            # sink must see only step records — one file, one schema);
+            # created lazily on the first span so train-only runs never
+            # touch a requests.jsonl
+            req_path = getattr(config, "requests_jsonl_path", None)
+            if req_path is None:
+                req_path = os.path.join(out_dir, "requests.jsonl")
+            self._requests_path = req_path if writer_rank else None
         if monitor is not None:
             self.sinks.append(MonitorSink(monitor))
         self.enabled = enabled
@@ -153,6 +168,44 @@ class Telemetry:
             r.histogram("inference/decode_tokens_per_s").observe(
                 decode_tokens_per_s)
 
+    # -- serving --------------------------------------------------------
+    def record_request_span(self, stats: RequestStats) -> Dict[str, Any]:
+        """One serving request reached a terminal state: update the
+        ``serving/*`` registry series and append the span record to the
+        requests JSONL stream (validated by REQUEST_RECORD_SCHEMA).
+        Returns the emitted record dict."""
+        record = stats.to_record()
+        if not self.enabled:
+            return record
+        r = self.registry
+        if stats.queue_wait_s is not None:
+            r.histogram("serving/queue_wait_s").observe(stats.queue_wait_s)
+        if stats.ttft_s is not None:
+            r.histogram("serving/ttft_s").observe(stats.ttft_s)
+        if stats.latency_s is not None:
+            r.histogram("serving/request_latency_s").observe(stats.latency_s)
+        if stats.tokens_per_s is not None:
+            r.histogram("serving/tokens_per_s").observe(stats.tokens_per_s)
+        if stats.new_tokens:
+            r.counter("serving/generated_tokens").inc(stats.new_tokens)
+        if stats.in_slo is not None:
+            r.counter("serving/slo_judged").inc()
+            if stats.in_slo:
+                r.counter("serving/slo_met").inc()
+        if not self._closed and self._requests_path:
+            with self._requests_lock:
+                try:
+                    if self._requests_sink is None:
+                        self._requests_sink = JsonlSink(self._requests_path)
+                    self._requests_sink.write(record)
+                except Exception as e:   # a broken sink must not kill serving
+                    logger.warning(f"telemetry requests sink failed: {e}")
+                    if self._requests_sink is None:
+                        # the sink could not even be constructed (unwritable
+                        # path): disable it instead of re-raising every span
+                        self._requests_path = None
+        return record
+
     # -- lifecycle ------------------------------------------------------
     def close(self) -> None:
         if self._closed:
@@ -165,6 +218,14 @@ class Telemetry:
                 logger.warning(f"telemetry sink {type(sink).__name__} "
                                f"close failed: {e}")
         self.sinks = []
+        with self._requests_lock:
+            if self._requests_sink is not None:
+                try:
+                    self._requests_sink.close()
+                except Exception as e:
+                    logger.warning(
+                        f"telemetry requests sink close failed: {e}")
+                self._requests_sink = None
 
 
 # ----------------------------------------------------------------------

@@ -31,6 +31,18 @@ if [ "$#" -eq 0 ]; then
         smoke_rc=$chaos_rc
     fi
 
+    # serving-scheduler smoke (CPU evidence lane, docs/serving.md):
+    # under the same seeded overload the SLO-aware policy must sustain
+    # strictly higher in-SLA goodput than FCFS, and allocator block
+    # balance must be exactly zero after drain() on every leg —
+    # including injected tick faults and mid-stream cancellations
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/serving_smoke.py
+    serve_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$serve_rc
+    fi
+
     # host-overhead perf smoke (CPU evidence lane, docs/performance.md):
     # steady-state host overhead with prefetch + train_steps(8) must stay
     # >= 2x lower than the synchronous per-step path, with zero

@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Serving-scheduler smoke: seeded overload, FCFS vs SLO-aware goodput,
+and zero-leak KV accounting under faults + cancellations (docs/serving.md).
+
+CPU evidence lane for the serving subsystem (run by run_tests.sh):
+
+* one seeded workload — a burst of long low-priority "batch" requests
+  followed by Poisson arrivals of short high-priority "interactive"
+  requests with tight end-to-end deadlines — replayed against a fresh
+  engine under each scheduler policy;
+* gate 1: the SLO-aware policy must sustain STRICTLY higher in-SLA
+  goodput than FCFS at the same offered load. The win is structural:
+  FCFS head-of-line blocking parks every interactive request behind the
+  batch backlog for ~(N_batch/slots) x batch-service-time, far past the
+  interactive deadline, while the SLO policy admits them next tick via
+  priority-tier slot preemption (preempted batch requests re-prefill off
+  the prefix cache and still meet their loose deadlines);
+* gate 2: after drain(), allocator block balance is EXACTLY zero-leak on
+  every leg — including a chaos leg with injected tick faults
+  (serving_tick_fail_every) and mid-stream cancellations.
+
+Deadlines are expressed in calibrated tick units (the measured per-tick
+latency of this machine), so the verdict does not depend on host speed.
+Writes SERVE_SCHED_<round>.json (round via DST_ROUND, default r06).
+
+    JAX_PLATFORMS=cpu python scripts/serving_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DST_ROUND", "r06")
+
+import numpy as np  # noqa: E402
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+sys.path.insert(0, os.path.join(HERE, "scripts"))
+
+SEED = 0
+N_BATCH = 16          # long, low-priority, loose deadline, burst at t=0
+BATCH_OUT = 24
+N_INTERACTIVE = 16    # short, high-priority, tight deadline, Poisson
+INTER_OUT = 6
+PROMPT_LEN = 12
+INTER_WINDOW_TICKS = 20.0     # interactive arrivals land in [0, 20] ticks
+# ~8x the ideal interactive latency (7 ticks). FCFS cannot meet it
+# structurally: head-of-line FIFO parks every interactive request behind
+# the whole batch burst, >= (N_BATCH / max_seqs) * (BATCH_OUT + 1) = 100
+# ticks of service, while even the LAST interactive arrival's absolute
+# deadline is ~INTER_WINDOW + INTER_DEADLINE = 76 ticks — so every
+# interactive request misses under FCFS even if the host runs the legs
+# ~25% faster than its own calibration (observed jitter is ~10%), while
+# the SLO policy's slot preemption serves them with ~4x headroom.
+INTER_DEADLINE_TICKS = 56.0
+BATCH_DEADLINE_TICKS = 4000.0
+
+
+def _build_engine():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.ragged import (RaggedConfig,
+                                                RaggedInferenceEngine)
+    from deepspeed_tpu.models import Llama
+
+    model = Llama("tiny", d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+                  vocab_size=256, max_seq_len=128, use_flash=False,
+                  remat=False)
+    cfg = RaggedConfig(token_budget=64, max_seqs=4, kv_block_size=8,
+                       n_kv_blocks=96, max_context=64, dtype=jnp.float32,
+                       enable_prefix_cache=True)
+    return RaggedInferenceEngine(model, cfg, params=model.init(
+        jax.random.PRNGKey(0)))
+
+
+def _warmup_and_calibrate(eng) -> float:
+    """Compile every step shape the serving run will hit — the prefill
+    bucket and each live-pages bucket up to full context, at full slot
+    occupancy — then return the median steady-state tick latency. Without
+    this, mid-run XLA compiles land on the serving clock and every
+    tick-denominated deadline is judged against compile time, not serving
+    time. Leaves the engine empty (flushed, cache dropped)."""
+    rng = np.random.default_rng(99)
+    uids = [900_000 + i for i in range(eng.config.max_seqs)]
+    logits = eng.put(uids, [rng.integers(1, 256, (PROMPT_LEN,)).tolist()
+                            for _ in uids])
+    toks = [int(np.argmax(row)) for row in logits]
+    samples = []
+    for _ in range(eng.config.max_context - PROMPT_LEN - 1):
+        t0 = time.perf_counter()
+        logits = eng.put(uids, [[t] for t in toks])
+        samples.append(time.perf_counter() - t0)
+        toks = [int(np.argmax(row)) for row in logits]
+    eng.flush(uids)
+    eng.prefix_cache.drop_all(eng.allocator)
+    return float(np.median(samples[-12:]))
+
+
+def _workload(rng: np.random.Generator, tick_s: float):
+    """(arrival_s, kind, prompt, max_new, priority, deadline_s) rows,
+    sorted by arrival. Same seed -> same workload on every leg."""
+    rows = []
+    for i in range(N_BATCH):
+        rows.append((0.0, "batch",
+                     rng.integers(1, 256, (PROMPT_LEN,)).tolist(),
+                     BATCH_OUT, 0, BATCH_DEADLINE_TICKS * tick_s))
+    t = 0.0
+    for i in range(N_INTERACTIVE):
+        t += rng.exponential(INTER_WINDOW_TICKS / N_INTERACTIVE) * tick_s
+        rows.append((t, "interactive",
+                     rng.integers(1, 256, (PROMPT_LEN,)).tolist(),
+                     INTER_OUT, 2, INTER_DEADLINE_TICKS * tick_s))
+    rows.sort(key=lambda r: r[0])
+    return rows
+
+
+def _leak_check(eng) -> dict:
+    """Post-drain block accounting: zero problems, and with the prefix
+    cache dropped every page back on the free list."""
+    from deepspeed_tpu.inference.ragged import block_balance_report
+
+    rep = block_balance_report(eng)
+    eng.prefix_cache.drop_all(eng.allocator)
+    free_after = eng.allocator.free_blocks
+    return {"problems": rep["problems"],
+            "free_after_cache_drop": free_after,
+            "n_blocks": eng.allocator.n_blocks,
+            "zero_leak": (not rep["problems"]
+                          and free_after == eng.allocator.n_blocks)}
+
+
+def _run_leg(eng, policy: str, tick_s: float, chaos: bool = False) -> dict:
+    """One policy leg over the SHARED warmed engine (fresh engines would
+    re-trace their jitted step mid-leg and bill compile time to the
+    deadlines). Starts and ends with an empty engine + empty cache."""
+    from deepspeed_tpu.resilience import FaultInjector, install_fault_injector
+    from deepspeed_tpu.serving import ServingEngine
+
+    install_fault_injector(
+        FaultInjector(serving_tick_fail_every=13) if chaos else None)
+    srv = ServingEngine(eng, {"policy": policy, "max_queue": 256,
+                              "tick_retry_limit": 3,
+                              "drain_timeout_s": 300.0})
+    rows = _workload(np.random.default_rng(SEED), tick_s)
+    t0 = time.perf_counter()
+    reqs = []
+    cancelled = []
+    for i, (arrival_s, kind, prompt, max_new, priority, deadline_s) in \
+            enumerate(rows):
+        wait = arrival_s - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        reqs.append((kind, srv.submit(prompt, max_new_tokens=max_new,
+                                      priority=priority,
+                                      deadline_s=deadline_s)))
+        if chaos and i == N_BATCH + 8:
+            # mid-stream cancellations while the system is loaded: the
+            # interactive request just submitted (queued or prefilling)
+            # and a batch request still live in its decode — picked
+            # dynamically so a fast host that already finished the early
+            # batch rows cannot dodge the cancellation coverage
+            victims = [reqs[-1][1]]
+            victims += [r for k, r in reqs
+                        if k == "batch" and not r.is_terminal][:1]
+            for victim in victims:
+                if srv.cancel(victim):
+                    cancelled.append(victim.uid)
+    drained = srv.drain()
+    srv.close()
+    install_fault_injector(None)
+    wall = time.perf_counter() - t0
+
+    out = {"policy": policy, "chaos": chaos, "wall_s": round(wall, 2),
+           "drained": drained, "cancelled_uids": cancelled}
+    for kind in ("batch", "interactive"):
+        sel = [r for k, r in reqs if k == kind]
+        out[kind] = {
+            "offered": len(sel),
+            "finished": sum(r.state.value == "finished" for r in sel),
+            "rejected": sum(r.state.value == "rejected" for r in sel),
+            "cancelled": sum(r.state.value == "cancelled" for r in sel),
+            "in_sla": sum(r.state.value == "finished"
+                          and r.in_slo() is True for r in sel),
+            "preemptions": sum(r.preemptions for r in sel),
+            "retries": sum(r.retries for r in sel),
+        }
+    out["in_sla_total"] = out["batch"]["in_sla"] + out["interactive"]["in_sla"]
+    out["goodput_rps"] = round(out["in_sla_total"] / wall, 2)
+    out["leak_check"] = _leak_check(eng)
+    return out
+
+
+def main() -> int:
+    eng = _build_engine()
+    tick_s = _warmup_and_calibrate(eng)
+    print(f"[serving-smoke] calibrated tick: {tick_s * 1e3:.2f} ms")
+
+    legs = {
+        "fcfs": _run_leg(eng, "fcfs", tick_s),
+        "slo": _run_leg(eng, "slo", tick_s),
+        "slo_chaos": _run_leg(eng, "slo", tick_s, chaos=True),
+    }
+    for name, leg in legs.items():
+        print(f"[serving-smoke] {name}: in_sla={leg['in_sla_total']} "
+              f"(batch {leg['batch']['in_sla']}/{leg['batch']['offered']}, "
+              f"interactive {leg['interactive']['in_sla']}"
+              f"/{leg['interactive']['offered']}) "
+              f"preempted={leg['batch']['preemptions']} "
+              f"zero_leak={leg['leak_check']['zero_leak']}")
+
+    gates = {
+        "slo_beats_fcfs_goodput":
+            legs["slo"]["in_sla_total"] > legs["fcfs"]["in_sla_total"],
+        "all_legs_drained": all(l["drained"] for l in legs.values()),
+        "zero_leak_all_legs": all(l["leak_check"]["zero_leak"]
+                                  for l in legs.values()),
+        "chaos_faults_injected": legs["slo_chaos"]["batch"]["retries"]
+            + legs["slo_chaos"]["interactive"]["retries"] > 0,
+        "cancellations_exercised":
+            len(legs["slo_chaos"]["cancelled_uids"]) >= 2,
+    }
+    report = {
+        "metric": "in_sla_goodput_slo_vs_fcfs",
+        "seed": SEED,
+        "tick_ms": round(tick_s * 1e3, 3),
+        "workload": {"n_batch": N_BATCH, "batch_out": BATCH_OUT,
+                     "n_interactive": N_INTERACTIVE,
+                     "interactive_out": INTER_OUT,
+                     "prompt_len": PROMPT_LEN,
+                     "interactive_deadline_ticks": INTER_DEADLINE_TICKS,
+                     "interactive_window_ticks": INTER_WINDOW_TICKS},
+        "legs": legs,
+        "gates": gates,
+        "value": legs["slo"]["in_sla_total"] - legs["fcfs"]["in_sla_total"],
+    }
+    from _artifact import write_artifact
+
+    import jax
+
+    path = write_artifact("SERVE_SCHED", report,
+                          device=jax.devices()[0].device_kind)
+    print(f"[serving-smoke] artifact: {path}")
+    failed = [g for g, ok in gates.items() if not ok]
+    if failed:
+        print(f"serving smoke: FAILED gates {failed}")
+        return 1
+    print(f"serving smoke: OK — SLO in-SLA goodput "
+          f"{legs['slo']['in_sla_total']} > FCFS "
+          f"{legs['fcfs']['in_sla_total']} at the same offered load; "
+          f"zero leaked KV blocks on all legs")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,12 +9,17 @@ This driver reproduces that methodology on TPU:
 
 * Poisson arrivals at each swept rate; prompt lengths drawn from a mixed
   pool (short chat / medium / long context), fixed output length.
-* One ``put()`` call per engine tick serves every live sequence (decodes
-  + one prefill chunk — the engine's SplitFuse schedule); greedy token
-  appended per sequence; per-token latencies attributed per tick.
+* The measured path is the SHIPPED serving subsystem: requests go
+  through ``ServingEngine.submit()`` (deepspeed_tpu/serving/ — FCFS
+  policy, bounded queue sized to the offered load, background driver
+  tick), with per-token latency taken from the driver's ``on_token``
+  callback timestamps and TTFT/queue-wait from the request spans.
+* A ``direct`` control leg (DST_SERVE_DRIVER=direct) replays the same
+  workload through the pre-PR5 hand-rolled engine loop — the A/B that
+  bounds the serving front-end's own overhead (``serving_vs_direct``).
 * Reported per rate: achieved qps, generation tok/s, p50/p95 per-token
-  latency (decode ticks), p95 TTFT, and whether the p95 token latency
-  meets the SLA. The qps-vs-SLA curve is the committed artifact.
+  latency, p95 TTFT, and whether the p95 token latency meets the SLA.
+  The qps-vs-SLA curve is the committed artifact.
 * A/B: the Pallas paged-attention path vs DST_RAGGED_FORCE_GATHER=1 in a
   child process (one chip claim per run through the axon relay).
 
@@ -82,9 +87,8 @@ def _build_engine():
     return RaggedInferenceEngine(model, cfg, rng=jax.random.PRNGKey(0)), model
 
 
-def _run_rate(eng, rate: float, rng: np.random.Generator):
-    """Serve a Poisson arrival stream at ``rate`` req/s for DURATION_S."""
-    # pre-draw the arrival schedule
+def _draw_arrivals(rate: float, rng: np.random.Generator):
+    """Pre-draw the Poisson arrival schedule: (t, uid, prompt_len)."""
     arrivals = []
     t = 0.0
     uid = 0
@@ -93,6 +97,71 @@ def _run_rate(eng, rate: float, rng: np.random.Generator):
         plen = int(rng.choice(PROMPT_POOL, p=PROMPT_MIX))
         arrivals.append((t, uid, plen))
         uid += 1
+    return arrivals
+
+
+def _run_rate_serving(eng, rate: float, rng: np.random.Generator):
+    """Serve the Poisson stream through the SHIPPED path: one
+    ``ServingEngine`` (FCFS — the same FIFO admission the direct loop
+    hand-rolls) per swept rate, ``submit()`` at each arrival, per-token
+    latencies from the driver's ``on_token`` timestamps. The queue is
+    sized to the whole offered load so overload shows up as TTFT growth
+    (exactly like the direct loop's unbounded waiting list), not as
+    rejects."""
+    from deepspeed_tpu.serving import ServingEngine
+
+    arrivals = _draw_arrivals(rate, rng)
+    srv = ServingEngine(eng, {"policy": "fcfs",
+                              "max_queue": len(arrivals) + 8,
+                              "drain_timeout_s": 60.0,
+                              "poll_interval_s": 0.001})
+    reqs = []
+    t0 = time.perf_counter()
+    for t_arr, _uid, plen in arrivals:
+        wait = t_arr - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        stamps: list = []
+        reqs.append((stamps, srv.submit(
+            _make_prompt(rng, plen), max_new_tokens=OUT_TOKENS,
+            on_token=lambda _tok, _s=stamps:
+                _s.append(time.perf_counter()))))
+    srv.drain(timeout=DURATION_S + 60.0 - (time.perf_counter() - t0))
+    # count the overload residue BEFORE close() cancels it into terminal
+    # states — an overloaded leg must not read as drained — and stamp the
+    # wall clock here: close(timeout=0) below must not re-drain a backlog
+    # already judged undrained (it would inflate wall by a second drain
+    # window that the direct control leg never pays)
+    undrained = sum(not r.is_terminal for _, r in reqs)
+    wall = time.perf_counter() - t0
+    srv.close(timeout=0.0)   # cancels whatever would not finish -> empty
+
+    done = sum(r.state.value == "finished" for _, r in reqs)
+    gen_tokens = sum(len(r.tokens) for _, r in reqs)
+    ttft = [r.ttft_s * 1e3 for _, r in reqs if r.ttft_s is not None]
+    token_lat: list = []
+    for stamps, _ in reqs:
+        token_lat.extend((b - a) * 1e3 for a, b in zip(stamps, stamps[1:]))
+    lat = np.asarray(token_lat) if token_lat else np.asarray([float("inf")])
+    return {
+        "offered_qps": rate,
+        "completed": done,
+        "undrained": undrained,
+        "achieved_qps": round(done / wall, 2),
+        "gen_tokens_per_s": round(gen_tokens / wall, 1),
+        "p50_token_ms": round(float(np.percentile(lat, 50)), 2),
+        "p95_token_ms": round(float(np.percentile(lat, 95)), 2),
+        "p95_ttft_ms": round(float(np.percentile(np.asarray(ttft), 95)), 1)
+        if ttft else None,
+        "meets_sla": bool(np.percentile(lat, 95) <= SLA_MS
+                          and undrained == 0),
+    }
+
+
+def _run_rate(eng, rate: float, rng: np.random.Generator):
+    """Direct-engine control leg: the pre-PR5 hand-rolled serving loop
+    (A/B bound on the ServingEngine front-end's own overhead)."""
+    arrivals = _draw_arrivals(rate, rng)
     live: dict = {}          # uid -> {"generated": int, "t_arrive", "t_first"}
     waiting: list = []       # admission queue (FIFO): overload -> TTFT grows
     token_lat, ttft, done = [], [], 0
@@ -117,7 +186,7 @@ def _run_rate(eng, rate: float, rng: np.random.Generator):
             new_uids.append(u)
             new_toks.append(_make_prompt(rng, plen))
             live[u] = {"generated": 0, "t_arrive": t_arr,
-                       "t_first": None, "last": None}
+                       "t_first": None, "t_tok": None, "last": None}
         # schedule decode continuations (one sampled token) and drive
         # still-prefilling sequences with put(uid, []) — they must appear
         # in EVERY tick so the completing tick's logits are observed
@@ -137,12 +206,9 @@ def _run_rate(eng, rate: float, rng: np.random.Generator):
                 break
             time.sleep(0.001)
             continue
-        tick0 = time.perf_counter()
         logits = eng.put(new_uids, new_toks)
-        tick_ms = (time.perf_counter() - tick0) * 1e3
         now = time.perf_counter() - t0
         finished = []
-        n_decoded = 0
         for row, u in zip(logits, new_uids):
             if np.isnan(row[0]):
                 continue                      # still mid-prefill
@@ -152,13 +218,17 @@ def _run_rate(eng, rate: float, rng: np.random.Generator):
                 st["t_first"] = now
                 ttft.append((now - st["t_arrive"]) * 1e3)
             else:
-                n_decoded += 1
+                # wall inter-token delta per request — the same clock the
+                # serving leg's on_token stamps use, so serving_vs_direct
+                # compares like with like (put()-only duration would hide
+                # this loop's own host work from the control leg)
+                token_lat.append((now - st["t_tok"]) * 1e3)
+            st["t_tok"] = now
             st["generated"] += 1
             if st["generated"] >= OUT_TOKENS:
                 finished.append(u)
             else:
                 st["last"] = tok
-        token_lat.extend([tick_ms] * max(n_decoded, 0))
         if finished:
             eng.flush(finished)
             for u in finished:
@@ -203,20 +273,26 @@ def _run_child():
             for i, p in enumerate(PROMPT_POOL)}
     eng.generate(warm, max_new_tokens=4)
 
+    run_rate = (_run_rate if os.environ.get("DST_SERVE_DRIVER") == "direct"
+                else _run_rate_serving)
     rows = []
     for rate in RATES:
-        rows.append(_run_rate(eng, rate, np.random.default_rng(int(rate * 10))))
+        rows.append(run_rate(eng, rate, np.random.default_rng(int(rate * 10))))
         print(f"[serve] {rows[-1]}", flush=True)
         if not rows[-1]["meets_sla"] and rows[-1]["p95_token_ms"] > 4 * SLA_MS:
             break                     # far past saturation; stop the sweep
     best = max((r["achieved_qps"] for r in rows if r["meets_sla"]), default=0.0)
     import jax
 
-    mode = ("pallas_prefix_cache" if _SYS_LEN
+    driver = ("direct" if os.environ.get("DST_SERVE_DRIVER") == "direct"
+              else "serving")
+    mode = ("direct" if driver == "direct"
+            else "pallas_prefix_cache" if _SYS_LEN
             else "gather" if os.environ.get("DST_RAGGED_FORCE_GATHER") == "1"
             else "pallas")
     row = {
         "mode": mode,
+        "driver": driver,
         "device": jax.devices()[0].device_kind,
         "sla_ms": SLA_MS, "out_tokens": OUT_TOKENS,
         "prompt_pool": PROMPT_POOL, "params": model.config.param_count(),
@@ -235,18 +311,27 @@ def main():
         return 0
     report = {"metric": "serve_qps_at_p95_token_sla", "unit": "req/s",
               "sla_ms": SLA_MS}
+    # measured legs drive the SHIPPED ServingEngine path; the "direct"
+    # leg replays the pallas workload through the pre-PR5 hand-rolled
+    # loop as the A/B control on the front-end's own overhead.
     # third leg: a shared system prompt (the chat-serving common case)
     # with automatic prefix caching on — its qps-vs-pallas delta is the
     # committed prefix-cache win (the reference has no counterpart)
-    # every leg pins BOTH knobs so an externally-set env can't silently
-    # turn a control leg into a prefix-cached (or gather) run
+    # every leg pins ALL knobs so an externally-set env can't silently
+    # turn a control leg into a prefix-cached (or gather / direct) run
     for mode, env_extra in (
             ("pallas", {"DST_RAGGED_FORCE_GATHER": "0",
-                        "DST_SERVE_SYS_PROMPT": "0"}),
+                        "DST_SERVE_SYS_PROMPT": "0",
+                        "DST_SERVE_DRIVER": "serving"}),
+            ("direct", {"DST_RAGGED_FORCE_GATHER": "0",
+                        "DST_SERVE_SYS_PROMPT": "0",
+                        "DST_SERVE_DRIVER": "direct"}),
             ("gather", {"DST_RAGGED_FORCE_GATHER": "1",
-                        "DST_SERVE_SYS_PROMPT": "0"}),
+                        "DST_SERVE_SYS_PROMPT": "0",
+                        "DST_SERVE_DRIVER": "serving"}),
             ("pallas_prefix_cache", {"DST_RAGGED_FORCE_GATHER": "0",
-                                     "DST_SERVE_SYS_PROMPT": "256"})):
+                                     "DST_SERVE_SYS_PROMPT": "256",
+                                     "DST_SERVE_DRIVER": "serving"})):
         env = dict(os.environ, **env_extra)
         env[_CHILD] = "1"
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
@@ -269,6 +354,11 @@ def main():
         pc = (report.get("pallas_prefix_cache") or {}).get("qps_at_sla") or 0
         if pc and report["value"]:
             report["prefix_cache_vs_pallas"] = round(pc / report["value"], 2)
+        d = (report.get("direct") or {}).get("qps_at_sla") or 0
+        if d and report["value"]:
+            # shipped ServingEngine path vs the hand-rolled control loop:
+            # ~1.0 means the front-end adds no measurable overhead
+            report["serving_vs_direct"] = round(report["value"] / d, 2)
     sys.path.insert(0, os.path.join(HERE, "scripts"))
     from _artifact import write_artifact
 

@@ -307,6 +307,108 @@ def test_record_request_series(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# serving-request spans (PR 5): schema, registry series, JSONL stream
+def test_validate_request_record_catches_violations():
+    from deepspeed_tpu.telemetry import RequestStats, validate_request_record
+
+    good = RequestStats(uid=1, state="finished", prompt_tokens=4,
+                        new_tokens=2).to_record()
+    assert validate_request_record(good) == []
+    bad = dict(good)
+    del bad["uid"]
+    bad["state"] = "vanished"
+    errs = validate_request_record(bad)
+    assert any("uid" in e for e in errs)
+    assert any("unknown request state" in e for e in errs)
+    stale = dict(good, schema_version=99)
+    assert any("schema_version" in e for e in validate_request_record(stale))
+    assert validate_request_record(["junk"])        # non-dict -> errors
+
+
+def test_record_request_span_series_and_jsonl(tmp_path):
+    from deepspeed_tpu.telemetry import RequestStats, validate_request_record
+
+    class Cfg:
+        enabled = True
+        output_dir = str(tmp_path / "srv")
+
+    t = Telemetry(config=Cfg())
+    t.record_request_span(RequestStats(
+        uid=1, state="finished", priority=2, prompt_tokens=8, new_tokens=4,
+        queue_wait_s=0.01, ttft_s=0.05, latency_s=0.2, tokens_per_s=20.0,
+        in_slo=True))
+    t.record_request_span(RequestStats(uid=2, state="rejected",
+                                       error="queue full", in_slo=False))
+    r = t.registry
+    assert r.counter("serving/generated_tokens").value == 4
+    assert r.counter("serving/slo_judged").value == 2
+    assert r.counter("serving/slo_met").value == 1
+    assert r.histogram("serving/ttft_s").count == 1
+    assert r.histogram("serving/queue_wait_s").count == 1
+    t.close()
+    # requests get their OWN jsonl stream (one file, one schema) and every
+    # line validates
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(str(tmp_path / "srv"),
+                              "requests.jsonl")).read().splitlines()]
+    assert [rec["state"] for rec in recs] == ["finished", "rejected"]
+    for rec in recs:
+        assert validate_request_record(rec) == [], rec
+    assert recs[1]["error"] == "queue full"
+    # step-record validation must NOT accept a request record (separate
+    # schemas guard the one-file-one-schema contract)
+    assert validate_step_record(recs[0])
+
+
+def test_serving_engine_exports_gauges_and_spans(tmp_path):
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.ragged import (RaggedConfig,
+                                                RaggedInferenceEngine)
+    from deepspeed_tpu.models import Llama
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.telemetry import validate_request_record
+
+    class Cfg:
+        enabled = True
+        output_dir = str(tmp_path / "serve")
+
+    t = Telemetry(config=Cfg())
+    set_telemetry(t)
+    model = Llama("tiny", n_layers=1, d_model=32, n_heads=2, n_kv_heads=2,
+                  vocab_size=64, max_seq_len=64, use_flash=False, remat=False)
+    eng = RaggedInferenceEngine(
+        model, RaggedConfig(token_budget=16, max_seqs=2, kv_block_size=8,
+                            n_kv_blocks=16, max_context=32,
+                            dtype=jnp.float32))
+    srv = ServingEngine(eng, {"max_queue": 1}, start=False)
+    ok = srv.submit([1, 2, 3, 4], max_new_tokens=3, ttft_deadline_s=60.0)
+    rejected = srv.submit([5, 6, 7], max_new_tokens=3)    # queue full
+    while not ok.is_terminal:
+        srv._tick()
+    r = t.registry
+    assert r.counter("serving/admitted").value == 1
+    assert r.counter("serving/completed").value == 1
+    assert r.counter("serving/rejected").value == 1
+    assert r.counter("serving/ticks").value >= 3
+    assert r.gauge("serving/queue_depth").value == 0
+    assert r.gauge("serving/live_requests").value == 0
+    assert 0.0 <= r.gauge("serving/kv_occupancy").value <= 1.0
+    t.close()
+    set_telemetry(None)
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(str(tmp_path / "serve"),
+                              "requests.jsonl")).read().splitlines()]
+    assert {rec["state"] for rec in recs} == {"finished", "rejected"}
+    for rec in recs:
+        assert validate_request_record(rec) == [], rec
+    fin = next(rec for rec in recs if rec["state"] == "finished")
+    assert fin["new_tokens"] == 3 and fin["ttft_s"] > 0
+    assert fin["in_slo"] is True
+    assert rejected.state.value == "rejected"
+
+
+# ----------------------------------------------------------------------
 # resilience
 def test_retry_call_counts_and_succeeds():
     from deepspeed_tpu.resilience import RetryPolicy, retry_call
