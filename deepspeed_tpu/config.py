@@ -706,6 +706,10 @@ class ChaosConfig:
     collective_delay_every: int = 0
     serving_tick_fail_at: int = -1
     serving_tick_fail_every: int = 0
+    # kill serving replica #replica_die_index once its engine has run
+    # replica_die_at_tick ticks (-1 disables; one-shot)
+    replica_die_at_tick: int = -1
+    replica_die_index: int = 0
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ChaosConfig":
@@ -728,6 +732,8 @@ class ChaosConfig:
             collective_delay_every=int(_take(d, "collective_delay_every", 0)),
             serving_tick_fail_at=int(_take(d, "serving_tick_fail_at", -1)),
             serving_tick_fail_every=int(_take(d, "serving_tick_fail_every", 0)),
+            replica_die_at_tick=int(_take(d, "replica_die_at_tick", -1)),
+            replica_die_index=int(_take(d, "replica_die_index", 0)),
         )
         _warn_unknown(d, "resilience.chaos")
         return out
@@ -751,6 +757,103 @@ class ResilienceConfig:
             chaos=ChaosConfig.from_dict(_take(d, "chaos", None)),
         )
         _warn_unknown(d, "resilience")
+        return out
+
+
+@dataclass
+class FleetConfig:
+    """The ``serving.fleet`` block: multi-replica router front-end
+    (docs/serving.md).
+
+    ``router`` picks the routing policy (``"least_loaded"`` or
+    ``"prefix_affinity"`` — consistent hashing on the prompt's full-block
+    prefix so repeat traffic lands on the replica holding its cached KV).
+    ``disaggregated`` splits the fleet into ``prefill_replicas`` replicas
+    that only compute prompt KV and hand pages off to the decode
+    replicas. ``autoscale`` turns on the telemetry-driven controller; the
+    sizing policy itself lives in
+    :class:`deepspeed_tpu.elasticity.ServingElasticityConfig` (the
+    ``min_replicas``..``sla_low`` knobs here are forwarded to it, so
+    training and serving elasticity share one policy surface).
+    ``failover`` re-queues a dead replica's in-flight requests onto the
+    survivors via the bit-exact resume path; ``respawn`` additionally
+    replaces dead replicas while the healthy count sits below
+    ``min_replicas``."""
+
+    replicas: int = 1
+    router: str = "least_loaded"
+    affinity_vnodes: int = 64
+    affinity_spill_load: int = 0
+    disaggregated: bool = False
+    prefill_replicas: int = 1
+    health_interval_s: float = 0.05
+    failover: bool = True
+    respawn: bool = True
+    autoscale: bool = False
+    autoscale_interval_s: float = 1.0
+    min_replicas: int = 1
+    max_replicas: int = 8
+    scale_up_queue_per_replica: float = 8.0
+    scale_down_queue_per_replica: float = 1.0
+    kv_high: float = 0.85
+    sla_low: float = 0.90
+    sla_window: int = 64
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "FleetConfig":
+        if not d:
+            return cls()
+        d = dict(d)
+        out = cls(
+            replicas=int(_take(d, "replicas", 1)),
+            router=str(_take(d, "router", "least_loaded")),
+            affinity_vnodes=int(_take(d, "affinity_vnodes", 64)),
+            affinity_spill_load=int(_take(d, "affinity_spill_load", 0)),
+            disaggregated=bool(_take(d, "disaggregated", False)),
+            prefill_replicas=int(_take(d, "prefill_replicas", 1)),
+            health_interval_s=float(_take(d, "health_interval_s", 0.05)),
+            failover=bool(_take(d, "failover", True)),
+            respawn=bool(_take(d, "respawn", True)),
+            autoscale=bool(_take(d, "autoscale", False)),
+            autoscale_interval_s=float(_take(d, "autoscale_interval_s", 1.0)),
+            min_replicas=int(_take(d, "min_replicas", 1)),
+            max_replicas=int(_take(d, "max_replicas", 8)),
+            scale_up_queue_per_replica=float(
+                _take(d, "scale_up_queue_per_replica", 8.0)),
+            scale_down_queue_per_replica=float(
+                _take(d, "scale_down_queue_per_replica", 1.0)),
+            kv_high=float(_take(d, "kv_high", 0.85)),
+            sla_low=float(_take(d, "sla_low", 0.90)),
+            sla_window=int(_take(d, "sla_window", 64)),
+        )
+        if out.router not in ("least_loaded", "prefix_affinity"):
+            raise ConfigError(
+                f"serving.fleet.router must be 'least_loaded' or "
+                f"'prefix_affinity', got '{out.router}'")
+        if out.replicas < 1:
+            raise ConfigError(
+                f"serving.fleet.replicas must be >= 1, got {out.replicas}")
+        if out.disaggregated and out.prefill_replicas < 1:
+            raise ConfigError(
+                f"serving.fleet.prefill_replicas must be >= 1 in "
+                f"disaggregated mode, got {out.prefill_replicas}")
+        if not 1 <= out.min_replicas <= out.max_replicas:
+            raise ConfigError(
+                f"serving.fleet needs 1 <= min_replicas <= max_replicas, "
+                f"got [{out.min_replicas}, {out.max_replicas}]")
+        if out.scale_down_queue_per_replica > out.scale_up_queue_per_replica:
+            # fail at parse, not as an ElasticityError inside every
+            # monitor poll (the hysteresis band must be non-negative)
+            raise ConfigError(
+                "serving.fleet.scale_down_queue_per_replica must not "
+                "exceed scale_up_queue_per_replica "
+                f"({out.scale_down_queue_per_replica} > "
+                f"{out.scale_up_queue_per_replica})")
+        if out.sla_window < 1:
+            raise ConfigError(
+                f"serving.fleet.sla_window must be >= 1, got "
+                f"{out.sla_window}")
+        _warn_unknown(d, "serving.fleet")
         return out
 
 
@@ -782,6 +885,7 @@ class ServingConfig:
     drain_timeout_s: float = 120.0
     stuck_tick_timeout_s: float = 30.0
     tick_retry_limit: int = 1
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
@@ -789,6 +893,7 @@ class ServingConfig:
             return cls()
         d = dict(d)
         out = cls(
+            fleet=FleetConfig.from_dict(_take(d, "fleet", None)),
             max_queue=int(_take(d, "max_queue", 256)),
             policy=str(_take(d, "policy", "slo")),
             kv_pressure=float(_take(d, "kv_pressure", 0.90)),

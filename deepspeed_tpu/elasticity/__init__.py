@@ -16,10 +16,15 @@ restart loop onto run-loop resume (runtime/engine.load_checkpoint).
 from .elasticity import (
     ElasticityConfig,
     ElasticityError,
+    ServingElasticityConfig,
     compute_elastic_config,
+    compute_serving_replicas,
     ensure_immutable_elastic_config,
     get_compatible_gpus,
+    serving_replica_candidates,
 )
 
 __all__ = ["compute_elastic_config", "ensure_immutable_elastic_config",
-           "get_compatible_gpus", "ElasticityConfig", "ElasticityError"]
+           "get_compatible_gpus", "ElasticityConfig", "ElasticityError",
+           "ServingElasticityConfig", "compute_serving_replicas",
+           "serving_replica_candidates"]

@@ -1,10 +1,20 @@
-"""Elastic batch-size planning (reference elasticity/elasticity.py).
+"""Elastic capacity planning: training batch geometry AND serving
+replica counts (reference elasticity/elasticity.py, extended).
 
-Given a target global-batch range, candidate micro-batch sizes, and a min/max
-accelerator count, find the global batch size (and per-count micro-batch +
-GAS) that stays valid across every admissible accelerator count — so a job
-can resume from checkpoint at a different slice size without changing the
-effective batch.
+Two consumers share the same candidate-enumeration discipline:
+
+* **training** — given a target global-batch range, candidate micro-batch
+  sizes, and a min/max accelerator count, find the global batch size (and
+  per-count micro-batch + GAS) that stays valid across every admissible
+  accelerator count, so a job can resume from checkpoint at a different
+  slice size without changing the effective batch
+  (:func:`compute_elastic_config`);
+* **serving** — given the live pressure signals (queue depth, in-SLA
+  ratio, KV occupancy), size the replica fleet by walking the admissible
+  replica-count candidates for the smallest count that absorbs the load
+  (:func:`compute_serving_replicas`). The fleet autoscaler calls this —
+  policy lives HERE, not hard-coded in the fleet loop, so training and
+  serving elasticity stay one subsystem with one config surface.
 """
 
 from __future__ import annotations
@@ -110,6 +120,110 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
     if return_microbatch:
         return best_batch, best_gpus, None
     return best_batch, best_gpus
+
+
+# ----------------------------------------------------------------------
+# serving-fleet sizing (consumed by serving/fleet.py's autoscaler)
+
+@dataclass
+class ServingElasticityConfig:
+    """Replica-count policy for the serving fleet autoscaler.
+
+    ``scale_up_queue_per_replica`` is the sustained queue depth one
+    replica is allowed to carry before the policy asks for more;
+    ``scale_down_queue_per_replica`` is the (strictly lower) depth below
+    which a replica is considered idle — the gap between the two is the
+    hysteresis band that keeps the fleet from flapping. ``kv_high`` and
+    ``sla_low`` are pressure overrides: a fleet whose KV pools run hot or
+    whose in-SLA ratio sags grows even when the queue looks shallow
+    (queue depth lags both). ``max_step`` bounds replicas added/removed
+    per decision so one noisy sample can never double or halve a fleet.
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    scale_up_queue_per_replica: float = 8.0
+    scale_down_queue_per_replica: float = 1.0
+    kv_high: float = 0.85
+    sla_low: float = 0.90
+    max_step: int = 1
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ElasticityError(
+                f"min_replicas must be >= 1, got {self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ElasticityError(
+                f"max_replicas {self.max_replicas} < min_replicas "
+                f"{self.min_replicas}")
+        if self.scale_down_queue_per_replica > self.scale_up_queue_per_replica:
+            raise ElasticityError(
+                "scale_down_queue_per_replica must not exceed "
+                "scale_up_queue_per_replica (the gap is the hysteresis band)")
+        if self.max_step < 1:
+            raise ElasticityError(
+                f"max_step must be >= 1, got {self.max_step}")
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict]) -> "ServingElasticityConfig":
+        if not d:
+            return cls()
+        return cls(**{k: v for k, v in d.items()
+                      if k in cls.__dataclass_fields__})
+
+
+def serving_replica_candidates(config: ServingElasticityConfig) -> List[int]:
+    """Admissible replica counts, smallest first — the serving analog of
+    ``_candidate_batches``: the policy walks these for the first count
+    that absorbs the offered load."""
+    return list(range(config.min_replicas, config.max_replicas + 1))
+
+
+def compute_serving_replicas(current: int, *,
+                             queue_depth: float,
+                             kv_occupancy: float = 0.0,
+                             in_sla_ratio: Optional[float] = None,
+                             config: Optional[ServingElasticityConfig] = None
+                             ) -> int:
+    """Target replica count from live pressure signals.
+
+    Sizing: the smallest candidate count keeping per-replica queue depth
+    at or under ``scale_up_queue_per_replica``; KV or SLA pressure at the
+    current size bumps the target one above ``current`` even when the
+    queue looks absorbed (both signals lead the queue under bursty
+    arrivals). Shrinking additionally requires the queue to sit under the
+    *down* threshold at the SMALLER size — the hysteresis that keeps a
+    fleet at the load boundary from oscillating. Movement per call is
+    clamped to ``max_step`` and the result always lands in
+    ``[min_replicas, max_replicas]``. Pure and deterministic: the fleet
+    autoscaler (and its tests) call it with measured signals.
+    """
+    cfg = config or ServingElasticityConfig()
+    current = max(cfg.min_replicas, min(cfg.max_replicas, int(current)))
+    candidates = serving_replica_candidates(cfg)
+    target = next((n for n in candidates
+                   if queue_depth <= n * cfg.scale_up_queue_per_replica),
+                  cfg.max_replicas)
+    pressured = (kv_occupancy >= cfg.kv_high
+                 or (in_sla_ratio is not None
+                     and in_sla_ratio < cfg.sla_low))
+    if pressured:
+        # the bump also pins target >= current, so pressure inherently
+        # vetoes shrinking — the hysteresis check below only ever sees
+        # unpressured fleets
+        target = max(target, min(current + 1, cfg.max_replicas))
+    if target > current:
+        target = min(target, current + cfg.max_step)
+    elif target < current:
+        # hysteresis judged at the size actually stepped to: judged at
+        # the unclamped target, a single queued request (> down * 1)
+        # would freeze an arbitrarily oversized fleet forever instead of
+        # letting it shrink stepwise
+        stepped = max(target, current - cfg.max_step)
+        target = (current
+                  if queue_depth > stepped * cfg.scale_down_queue_per_replica
+                  else stepped)
+    return max(cfg.min_replicas, min(cfg.max_replicas, target))
 
 
 def elasticity_fingerprint(ds_config: Dict) -> str:

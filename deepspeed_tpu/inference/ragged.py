@@ -296,6 +296,35 @@ def _prompt_lookup(ctx: Sequence[int], ngram: int, k: int) -> List[int]:
 
 
 @dataclass
+class KVExport:
+    """Host-side snapshot of one sequence's KV state, the unit of the
+    disaggregated prefill→decode hand-off (``export_kv``/``import_kv``).
+    Today the pages travel as numpy arrays (CPU copy); the dataclass is
+    the explicit seam where an ICI transfer replaces the host hop later —
+    importers validate geometry, never provenance."""
+
+    uid: int
+    tokens: List[int]          # fed context (prompt + any decoded tokens)
+    seen: int                  # tokens whose KV the pages actually hold
+    prompt_len: int
+    kv_block_size: int
+    n_layers: int
+    n_kv_heads: int
+    head_dim: int
+    dtype: str
+    k_pages: np.ndarray        # [n_layers, n_pages, hkv, block, hd]
+    v_pages: np.ndarray
+
+    @property
+    def n_pages(self) -> int:
+        return int(self.k_pages.shape[1])
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.k_pages.nbytes + self.v_pages.nbytes)
+
+
+@dataclass
 class SequenceDescriptor:
     """Reference DSSequenceDescriptor: uid, slot, tokens seen/scheduled,
     owned KV blocks."""
@@ -448,6 +477,7 @@ class RaggedInferenceEngine:
         self._core_fn = None
         self._decode_fn = None
         self._copy_page_fn = None
+        self._import_fn = None
         self._verify_fn = None
         # speculative-decoding acceptance stats (generate_speculative)
         self.spec_stats = {"proposed": 0, "accepted": 0, "rounds": 0}
@@ -579,6 +609,135 @@ class RaggedInferenceEngine:
         would silently skip its TTFT/latency telemetry, and the marker
         set would grow without bound under preempt-then-cancel churn."""
         self._resume_uids.discard(uid)
+
+    # -- KV export/import (disaggregated prefill/decode hand-off) --------
+    def export_kv(self, uid: int) -> "KVExport":
+        """Snapshot ``uid``'s KV pages + token stream for hand-off to
+        ANOTHER engine (disaggregated serving: a prefill replica computes
+        the KV, a decode replica continues the stream). Host copy today —
+        this is the explicit seam where an ICI/DMA page transfer plugs in
+        later; the importer's accounting is identical either way.
+
+        The sequence must be fully prefilled (``pending == 0``): exporting
+        mid-prefill would hand off context whose tail has no KV. The
+        export does NOT release anything — the caller decides whether to
+        ``preempt`` (publish into this engine's prefix cache) or
+        ``discard`` the local copy afterwards."""
+        seq = self.seqs.get(uid)
+        if seq is None:
+            raise KeyError(f"uid {uid} has no live sequence to export")
+        if seq.pending:
+            raise ValueError(
+                f"uid {uid}: {seq.pending} tokens still pending prefill — "
+                "a mid-prefill export would hand off torn context")
+        if seq.seen == 0 or not seq.blocks:
+            raise ValueError(f"uid {uid}: nothing prefilled yet")
+        c = self.model.config
+        idx = jnp.asarray(np.asarray(seq.blocks, np.int32))
+        # one device gather per layer leaf, then host transfer; rows past
+        # ``seen`` in the last page are never-read scratch and ride along
+        k = np.stack([np.asarray(leaf[idx]) for leaf in self.kv_pool[0]])
+        v = np.stack([np.asarray(leaf[idx]) for leaf in self.kv_pool[1]])
+        t = self._telemetry
+        if t.enabled:
+            t.registry.counter("inference/kv_exports").inc()
+            t.registry.counter("inference/kv_export_pages").inc(
+                len(seq.blocks))
+        return KVExport(uid=uid, tokens=list(seq.tokens), seen=seq.seen,
+                        prompt_len=seq.prompt_len,
+                        kv_block_size=self.config.kv_block_size,
+                        n_layers=c.n_layers, n_kv_heads=c.n_kv_heads,
+                        head_dim=c.head_dim,
+                        dtype=str(jnp.dtype(self.config.dtype)),
+                        k_pages=k, v_pages=v)
+
+    def import_kv(self, uid: int, export: "KVExport") -> None:
+        """Adopt an exported sequence: allocate pages from THIS engine's
+        pool (evicting cached prefixes under pressure, same discipline as
+        admission), scatter the pages in, and create a live descriptor at
+        ``seen`` — so the next ``put(uid, [next_token])`` continues the
+        stream bit-exactly without re-prefilling. Pages are charged and
+        refcounted exactly like locally-computed ones: ``seq.blocks``
+        holds one allocator ref each and ``assert_block_balance`` holds.
+
+        Raises :class:`PoolExhausted` (recoverable — the caller can fall
+        back to the re-prefill resume path) or ``ValueError`` on geometry
+        mismatch. On any failure nothing is mutated."""
+        cfg = self.config
+        c = self.model.config
+        if uid in self.seqs:
+            raise ValueError(f"uid {uid} already live in this engine")
+        want = (cfg.kv_block_size, c.n_layers, c.n_kv_heads, c.head_dim,
+                str(jnp.dtype(cfg.dtype)))
+        have = (export.kv_block_size, export.n_layers, export.n_kv_heads,
+                export.head_dim, export.dtype)
+        if want != have:
+            raise ValueError(
+                f"KV geometry mismatch: engine (block,layers,hkv,hd,dtype)="
+                f"{want} vs export {have}")
+        if export.seen != len(export.tokens):
+            raise ValueError(
+                f"export seen {export.seen} != tokens {len(export.tokens)}")
+        if export.seen > cfg.max_context:
+            raise ValueError(
+                f"export context {export.seen} exceeds max_context "
+                f"{cfg.max_context}")
+        need = export.n_pages
+        if need != -(-export.seen // cfg.kv_block_size):
+            raise ValueError(
+                f"export carries {need} pages for {export.seen} tokens")
+        if not self._free_slots:
+            raise RuntimeError("no free sequence slots; flush() first")
+        if need > self.allocator.free_blocks and self.prefix_cache is not None:
+            self.prefix_cache.evict_for(self.allocator, need)
+        blocks = self.allocator.allocate(need)        # may raise PoolExhausted
+        try:
+            # pow2-bucket the page count (one compiled writer per bucket,
+            # not one per hand-off length); padding lanes scatter zeros
+            # into the sink page, which is never read
+            B = 1
+            while B < need:
+                B *= 2
+            B = min(B, self.max_pages)
+            dst = np.full((B,), cfg.n_kv_blocks, np.int32)
+            dst[:need] = blocks
+            k, v = export.k_pages, export.v_pages
+            if B > need:
+                pad = np.zeros((k.shape[0], B - need) + k.shape[2:], k.dtype)
+                k = np.concatenate([k, pad], axis=1)
+                v = np.concatenate([v, pad], axis=1)
+            self.kv_pool = self._write_pages(
+                self.kv_pool, jnp.asarray(dst), jnp.asarray(k),
+                jnp.asarray(v))
+        except BaseException:
+            self.allocator.release(blocks)
+            raise
+        # telemetry suppressed like a resume: the serving layer's request
+        # span owns the end-to-end TTFT/latency story for handed-off work
+        self.seqs[uid] = SequenceDescriptor(
+            uid=uid, slot=self._free_slots.pop(),
+            tokens=[int(t) for t in export.tokens], seen=int(export.seen),
+            blocks=blocks, t_admitted=None, t_created=None,
+            prompt_len=int(export.prompt_len))
+        self._resume_uids.discard(uid)
+        t = self._telemetry
+        if t.enabled:
+            t.registry.counter("inference/kv_imports").inc()
+
+    def _write_pages(self, pools, dst, k, v):
+        """Scatter imported pages into every layer's K/V leaf (one jitted
+        donated program; the import-side half of the hand-off seam)."""
+        if self._import_fn is None:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def imp(pools, dst, k, v):
+                kp = tuple(leaf.at[dst].set(k[i].astype(leaf.dtype))
+                           for i, leaf in enumerate(pools[0]))
+                vp = tuple(leaf.at[dst].set(v[i].astype(leaf.dtype))
+                           for i, leaf in enumerate(pools[1]))
+                return (kp, vp)
+
+            self._import_fn = imp
+        return self._import_fn(pools, dst, k, v)
 
     def trim(self, uid: int, length: int) -> None:
         """Rewind ``uid`` to its first ``length`` tokens, freeing now-unused
@@ -731,6 +890,14 @@ class RaggedInferenceEngine:
         """Fraction of the paged KV pool currently held by live sequences
         or the prefix cache (1.0 = exhausted)."""
         return 1.0 - self.allocator.free_blocks / self.allocator.n_blocks
+
+    def kv_demand(self) -> float:
+        """Fraction of the pool that live DEMAND holds: pages the cache
+        could reclaim on allocation pressure don't count. This is the
+        capacity-planning signal (a warm LRU cache legitimately absorbs
+        the whole pool at idle — raw ``kv_occupancy`` would read that as
+        permanent pressure and an autoscaler could never scale down)."""
+        return 1.0 - self._available_blocks() / self.allocator.n_blocks
 
     def _record_step_telemetry(self, sched) -> None:
         """Per-ragged-step series: scheduled tokens + pool occupancy. Host
@@ -1205,12 +1372,19 @@ class RaggedInferenceEngine:
                                        live_pages=live_pages, window=window,
                                        interpret=interp)
 
-            return jax.shard_map(
-                local, mesh=self.topo.mesh, axis_names={"model"},
-                in_specs=(hspec, pspec, pspec, P_(None, None), P_(None),
-                          P_(None)),
-                out_specs=hspec, check_vma=False)(
-                q, kp, vp, tables, positions, slots)
+            in_specs = (hspec, pspec, pspec, P_(None, None), P_(None),
+                        P_(None))
+            if hasattr(jax, "shard_map"):               # jax >= 0.5
+                mapped = jax.shard_map(
+                    local, mesh=self.topo.mesh, axis_names={"model"},
+                    in_specs=in_specs, out_specs=hspec, check_vma=False)
+            else:                                       # 0.4.x spelling
+                from jax.experimental.shard_map import shard_map
+
+                mapped = shard_map(
+                    local, mesh=self.topo.mesh,
+                    in_specs=in_specs, out_specs=hspec, check_rep=False)
+            return mapped(q, kp, vp, tables, positions, slots)
 
         def norm(x, w, b=None):
             return rms_norm(x, w, c.norm_eps) if c.norm == "rms" \

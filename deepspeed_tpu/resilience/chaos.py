@@ -21,7 +21,12 @@ event so tests (tests/test_fault_tolerance.py) and the chaos smoke loop
 * ``serving_tick_fail_at`` / ``serving_tick_fail_every`` — fail serving
   engine ticks (:class:`TickFault`, a *recoverable* RuntimeError: the
   ServingEngine's request-level retry-or-fail path is the code under
-  test, so unlike the faults above it must be catchable).
+  test, so unlike the faults above it must be catchable);
+* ``replica_die_at_tick`` / ``replica_die_index`` — kill one serving
+  replica of a :class:`~deepspeed_tpu.serving.ServingFleet` once it has
+  run N engine ticks (polled by the fleet health monitor via
+  :meth:`should_kill_replica`; the fleet's failover re-queues the dead
+  replica's in-flight requests on the survivors).
 
 Faults raise :class:`InjectedFault` (a ``BaseException``) so retry helpers
 and broad ``except Exception`` recovery code never swallow an injected
@@ -87,7 +92,9 @@ class FaultInjector:
                  collective_delay_s: float = 0.0,
                  collective_delay_every: int = 0,
                  serving_tick_fail_at: int = -1,
-                 serving_tick_fail_every: int = 0):
+                 serving_tick_fail_every: int = 0,
+                 replica_die_at_tick: int = -1,
+                 replica_die_index: int = 0):
         fields = {
             "seed": seed,
             "crash_before_commit_at_save": crash_before_commit_at_save,
@@ -103,6 +110,8 @@ class FaultInjector:
             "collective_delay_every": collective_delay_every,
             "serving_tick_fail_at": serving_tick_fail_at,
             "serving_tick_fail_every": serving_tick_fail_every,
+            "replica_die_at_tick": replica_die_at_tick,
+            "replica_die_index": replica_die_index,
         }
         for name, default in fields.items():
             setattr(self, name,
@@ -143,7 +152,8 @@ class FaultInjector:
                  "exit_code", "collective_fail_op",
                  "collective_fail_at_call", "collective_delay_s",
                  "collective_delay_every", "serving_tick_fail_at",
-                 "serving_tick_fail_every"}
+                 "serving_tick_fail_every", "replica_die_at_tick",
+                 "replica_die_index"}
         unknown = set(spec) - known
         if unknown:
             logger.warning(f"{CHAOS_ENV}: ignoring unknown keys {sorted(unknown)}")
@@ -204,6 +214,26 @@ class FaultInjector:
             self._count("serving_tick_fail")
             logger.warning(f"chaos: failing serving tick {tick}")
             raise TickFault(f"injected serving tick fault at tick {tick}")
+
+    def should_kill_replica(self, replica_index: int, ticks: int) -> bool:
+        """Injected serving-replica death: True once, for the replica
+        whose index matches ``replica_die_index``, as soon as it has run
+        ``replica_die_at_tick`` engine ticks (>= 0 enables). The fleet's
+        health monitor polls this and performs the actual kill+failover —
+        death is a FLEET-level event (the whole replica process/host is
+        gone), not a per-tick fault the ServingEngine could retry."""
+        if self.replica_die_at_tick < 0:
+            return False
+        if replica_index != self.replica_die_index:
+            return False
+        if ticks < self.replica_die_at_tick:
+            return False
+        if self.injected.get("replica_death"):
+            return False
+        self._count("replica_death")
+        logger.warning(
+            f"chaos: killing serving replica {replica_index} at tick {ticks}")
+        return True
 
     def on_collective(self, op: str) -> None:
         n = self._collective_calls.get(op, 0) + 1

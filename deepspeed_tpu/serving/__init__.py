@@ -1,21 +1,42 @@
 """Serving layer: request lifecycle, SLO-aware continuous-batching
-scheduling, and a streaming front-end over the ragged engine.
+scheduling, a streaming front-end over the ragged engine, and a
+multi-replica fleet router on top.
 
 This is the FastGen/MII serving surface the reference exposes
 (``mii/batching/ragged_batching.py``, the DeepSpeed-FastGen blog's
 throughput-under-SLA methodology) promoted into a first-class subsystem:
 :class:`Request` descriptors with a validated state machine, pluggable
 admission/preemption policies (FCFS baseline + SLO-aware
-earliest-deadline-first), and a :class:`ServingEngine` that owns the
+earliest-deadline-first), a :class:`ServingEngine` that owns the
 background tick loop, backpressure, cancellation, graceful drain and
-fault recovery. See docs/serving.md.
+fault recovery — and a :class:`ServingFleet` that load-balances N engine
+replicas behind the same call surface (least-loaded or
+prefix-cache-affinity routing, failover via bit-exact resume,
+disaggregated prefill/decode KV hand-off, telemetry-driven
+autoscaling). The KV leak audit (:func:`block_balance_report` /
+:func:`assert_block_balance`, re-exported from the ragged engine) is
+part of the public serving contract: zero leaked pages after drain on
+every replica. See docs/serving.md.
 """
 
+from ..inference.ragged import (  # noqa: F401
+    assert_block_balance,
+    block_balance_report,
+)
+from .fleet import Replica, ReplicaState, ServingFleet  # noqa: F401
 from .request import (  # noqa: F401
     InvalidTransition,
     Request,
     RequestState,
     TERMINAL_STATES,
+)
+from .router import (  # noqa: F401
+    LeastLoadedRouter,
+    NoHealthyReplica,
+    PrefixAffinityRouter,
+    RouterPolicy,
+    make_router,
+    prefix_key,
 )
 from .scheduler import (  # noqa: F401
     CapacityView,
@@ -24,4 +45,4 @@ from .scheduler import (  # noqa: F401
     SchedulerPolicy,
     make_policy,
 )
-from .server import ServingEngine  # noqa: F401
+from .server import ServingEngine, stream_tokens  # noqa: F401

@@ -1,0 +1,771 @@
+"""Multi-replica serving: one front-end, N engine replicas.
+
+``ServingFleet`` exposes the same ``submit / stream / cancel / drain /
+close`` surface as a single :class:`~.server.ServingEngine`, but
+load-balances across N replicas — the MII deployment surface (one
+front-end, many model replicas) reproduced TPU-natively. Three pillars:
+
+* **routing** (:mod:`.router`) — least-loaded baseline, or
+  prefix-cache-affinity consistent hashing so repeat traffic lands on
+  the replica already holding its KV pages. Replicas are health-checked;
+  a dead replica's in-flight requests are harvested and re-queued on the
+  survivors through the SAME bit-exact resume path preemption uses (the
+  dead replica's KV is suspect and is never published; the request
+  re-prefills ``prompt + emitted`` elsewhere and the greedy stream
+  continues identically).
+* **disaggregated prefill/decode** — dedicated prefill replicas compute
+  prompt KV, then hand the pages to decode replicas through the
+  engine-level :meth:`~deepspeed_tpu.inference.ragged.RaggedInferenceEngine.export_kv`
+  / ``import_kv`` seam (a CPU page copy today; the refcount discipline
+  is identical to locally-computed pages, so ``assert_block_balance``
+  holds on both sides). Prefill replicas keep publishing prompt pages
+  into their own prefix caches, so affinity routing and disaggregation
+  compose.
+* **autoscaling** — a telemetry-driven controller (queue depth, in-SLA
+  ratio, KV pressure) sized by
+  :func:`deepspeed_tpu.elasticity.compute_serving_replicas` — the policy
+  lives in ``elasticity/``, not here — growing the replica set through
+  the replica factory and shrinking it with graceful drain (stop
+  admission, serve out, close). Dead replicas are respawned with the
+  same jittered exponential backoff contract as
+  :class:`~deepspeed_tpu.launcher.agent.ElasticAgent`; multi-process
+  deployments put each replica process under that agent and point the
+  factory at its rendezvous.
+
+Threading: the fleet owns one monitor thread (health + chaos + respawn +
+autoscale). Each replica's ServingEngine keeps its own driver. Lock
+order is strictly fleet -> replica: fleet callbacks invoked by replica
+drivers (``on_handoff`` / ``on_retire``) run OUTSIDE the replica's
+serving lock, so taking the fleet lock there cannot invert.
+
+Telemetry: per-replica gauges ride the replica's namespaced metrics
+(``serving/<replica>/...``); the fleet adds router counters
+(``serving/fleet/affinity_{hits,misses}``, ``handoffs``, ``failovers``,
+``respawns``, ``scale_{ups,downs}``) and fleet-wide gauges
+(``serving/fleet/replicas``, ``queue_depth``). See docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import log_dist, logger
+from .request import Request, RequestState
+from .router import (NoHealthyReplica, PrefixAffinityRouter, RouterPolicy,
+                     least_loaded_pick, make_router)
+from .server import ServingEngine, stream_tokens
+
+
+class ReplicaState:
+    HEALTHY = "healthy"
+    DRAINING = "draining"
+    DEAD = "dead"
+
+
+class Replica:
+    """One engine + its serving front-end, plus fleet-side bookkeeping."""
+
+    def __init__(self, name: str, engine, serving: ServingEngine,
+                 role: str = "unified"):
+        self.name = name
+        self.engine = engine
+        self.serving = serving
+        self.role = role                  # "unified" | "prefill" | "decode"
+        self.state = ReplicaState.HEALTHY
+        self.index = int(name.rsplit("-", 1)[-1]) if "-" in name else 0
+
+    @property
+    def accepting(self) -> bool:
+        return self.state == ReplicaState.HEALTHY and self.serving._accepting
+
+    @property
+    def load(self) -> int:
+        # pending_work, not queue+live: the adoption/handoff pens hold
+        # admitted requests too, and both routing and scale-down reaping
+        # must see them
+        return self.serving.pending_work
+
+    @property
+    def driver_alive(self) -> bool:
+        d = self.serving._driver
+        return d is not None and d.is_alive()
+
+
+class ServingFleet:
+    """Replicated serving front-end; same call surface as ServingEngine.
+
+    ``engine_factory()`` must return a FRESH
+    :class:`~deepspeed_tpu.inference.ragged.RaggedInferenceEngine` (own
+    KV pool, same model weights) per call — replicas share nothing but
+    parameters. ``serving_config`` is the per-replica ServingConfig (dict
+    or object); ``config`` the :class:`~deepspeed_tpu.config.FleetConfig`
+    (dict or object). With ``start=False`` nothing ticks on its own:
+    tests drive determinstically via :meth:`step` (one poll + one tick
+    per replica).
+    """
+
+    def __init__(self, engine_factory, config: Any = None,
+                 serving_config: Any = None,
+                 router: Optional[RouterPolicy] = None,
+                 preemption_guard: Any = None,
+                 start: bool = True):
+        from ..config import FleetConfig, ServingConfig
+
+        if config is None:
+            config = FleetConfig()
+        elif isinstance(config, dict):
+            config = FleetConfig.from_dict(config)
+        self.config = config
+        if serving_config is None:
+            serving_config = ServingConfig()
+        elif isinstance(serving_config, dict):
+            serving_config = ServingConfig.from_dict(serving_config)
+        self._serving_config = serving_config
+        self._factory = engine_factory
+        self._guard = preemption_guard
+        self._start_drivers = start
+        self._lock = threading.RLock()
+        self._replicas: Dict[str, Replica] = {}
+        self._requests: Dict[int, Tuple[Request, str]] = {}  # uid -> (req, replica)
+        self._name_counter = itertools.count()
+        self._accepting = True
+        self._stop_evt = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._last_autoscale = 0.0
+        self._chaos_fired = False
+        # sliding in-SLA window feeding the autoscaler (True/False per
+        # SLO-carrying terminal request; cancels and SLO-less skipped)
+        self._sla_window = collections.deque(maxlen=config.sla_window)
+        self._shed_backlog: List[Request] = []   # fleet-rejected, span due
+        # respawn backoff (ElasticAgent contract: exponential + healthy
+        # reset; here per-fleet since replicas are interchangeable)
+        self._respawn_after = 0.0
+        self._respawn_delay = 0.5
+        if router is not None:
+            self.router = router
+        else:
+            self.router = make_router(
+                config.router, block_size=self._probe_block_size(),
+                vnodes=config.affinity_vnodes,
+                spill_load=config.affinity_spill_load)
+        if config.disaggregated:
+            for _ in range(config.prefill_replicas):
+                self._spawn(role="prefill")
+            for _ in range(config.replicas):
+                self._spawn(role="decode")
+        else:
+            for _ in range(config.replicas):
+                self._spawn(role="unified")
+        log_dist(f"ServingFleet: {len(self._replicas)} replicas "
+                 f"router={self.router.name} "
+                 f"disaggregated={config.disaggregated} "
+                 f"autoscale={config.autoscale}")
+        if start:
+            self._monitor = threading.Thread(target=self._monitor_loop,
+                                             daemon=True,
+                                             name="fleet-monitor")
+            self._monitor.start()
+
+    def _probe_block_size(self) -> int:
+        # the affinity key must match the engines' prefix-cache unit; all
+        # replicas share one config, so any instance answers. No replica
+        # exists yet at router-construction time, so build one eagerly
+        # only when the router actually needs the block size.
+        if self.config.router != "prefix_affinity":
+            return 16
+        eng = self._factory()
+        self._pending_engine = eng
+        return eng.config.kv_block_size
+
+    # -- telemetry -------------------------------------------------------
+    @property
+    def _telemetry(self):
+        from ..telemetry import get_telemetry
+
+        return get_telemetry()
+
+    def _count(self, name: str, n: float = 1.0) -> None:
+        self._telemetry.registry.counter(f"serving/fleet/{name}").inc(n)
+
+    def _update_gauges(self) -> None:
+        t = self._telemetry
+        if not t.enabled:
+            return
+        with self._lock:
+            healthy = [r for r in self._replicas.values()
+                       if r.state == ReplicaState.HEALTHY]
+            depth = sum(r.serving.queue_depth for r in healthy)
+        t.registry.gauge("serving/fleet/replicas").set(len(healthy))
+        t.registry.gauge("serving/fleet/queue_depth").set(depth)
+
+    # -- replica lifecycle ----------------------------------------------
+    def _spawn(self, role: str = "unified") -> Replica:
+        """Build one replica (engine via the factory + a namespaced
+        ServingEngine) and register it with the router."""
+        engine = getattr(self, "_pending_engine", None)
+        if engine is not None:
+            self._pending_engine = None
+        else:
+            engine = self._factory()
+        name = f"replica-{next(self._name_counter)}"
+        serving = ServingEngine(
+            engine, self._serving_config,
+            preemption_guard=self._guard,
+            start=self._start_drivers,
+            replica_id=name,
+            on_handoff=(self._on_handoff if role == "prefill" else None),
+            on_retire=self._on_retire)
+        rep = Replica(name, engine, serving, role=role)
+        with self._lock:
+            self._replicas[name] = rep
+            # the routing ring hashes over the replicas that PREFILL —
+            # that's where prompt KV is computed and where the prefix
+            # cache pays off. Disaggregated: the prefill pool; unified:
+            # everyone. Decode replicas never own a ring segment (their
+            # placement is least-loaded at hand-off time: the pages are
+            # new to all of them).
+            prefills = (role == "prefill" if self.config.disaggregated
+                        else role == "unified")
+            if prefills:
+                self.router.on_join(name)
+        self._update_gauges()
+        return rep
+
+    def _view(self, role: Optional[str] = None, live: bool = False,
+              refused=()) -> Dict[str, int]:
+        """name -> load routing view. ``live=False``: replicas accepting
+        NEW work (health-checked admission view). ``live=True``: anything
+        not DEAD — the continuation view (draining replicas finish
+        admitted work, they just take no new admissions). ``role``
+        filters; None = any serving (non-prefill) role. ``refused`` names
+        are excluded (stop-race retry loops)."""
+        out = {}
+        for r in self._replicas.values():
+            if r.name in refused:
+                continue
+            if (r.state == ReplicaState.DEAD) if live else not r.accepting:
+                continue
+            if role is not None and r.role != role:
+                continue
+            if role is None and r.role == "prefill":
+                continue
+            out[r.name] = r.load
+        return out
+
+    # -- submission ------------------------------------------------------
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               priority: int = 0,
+               deadline_s: Optional[float] = None,
+               ttft_deadline_s: Optional[float] = None,
+               client_request_id: Optional[str] = None,
+               on_token=None) -> Request:
+        """Route a request to a replica. Same contract as
+        ``ServingEngine.submit``: returns immediately, possibly already
+        REJECTED (no healthy replica, or the target's backpressure)."""
+        req = Request(
+            prompt=list(prompt),
+            max_new_tokens=(max_new_tokens if max_new_tokens is not None
+                            else self._serving_config.default_max_new_tokens),
+            eos_token_id=eos_token_id, priority=priority,
+            deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+            client_request_id=client_request_id, on_token=on_token)
+        req.t_submit = time.perf_counter()
+        self._route(req)
+        self._flush_shed()
+        return req
+
+    def _route(self, req: Request, requeue: bool = False) -> None:
+        """Pick a replica and enqueue. ``requeue`` marks the continuation
+        of an already-admitted request (fail-over, hand-off fallback): it
+        bypasses the fleet and replica admission gates — a draining fleet
+        must serve out admitted work — and may land on DRAINING (never
+        DEAD) replicas. A pick whose driver stopped between the view
+        snapshot and the enqueue refuses non-terminally; the loop places
+        the request elsewhere."""
+        refused: set = set()
+        while True:
+            with self._lock:
+                if not self._accepting and not requeue:
+                    self._reject(req, "fleet closed to new requests")
+                    return
+                if self.config.disaggregated:
+                    # prefill pool first — routed by the CONFIGURED
+                    # router below (affinity composes with
+                    # disaggregation: the ring hashes the prefill
+                    # replicas, where repeat prefixes find their cached
+                    # KV); the handoff hook ships the result onward
+                    view = self._view("prefill", live=requeue,
+                                      refused=refused)
+                    if not view:
+                        # degrade: unified path on whatever can serve
+                        view = self._view(live=requeue, refused=refused)
+                        req._handoff_requested = False
+                    else:
+                        req._handoff_requested = True
+                else:
+                    view = self._view(live=requeue, refused=refused)
+                if not view:
+                    self._reject(req, "no healthy replica")
+                    return
+                try:
+                    name = self.router.route(view, req.prompt)
+                except NoHealthyReplica:
+                    self._reject(req, "no healthy replica")
+                    return
+                if isinstance(self.router, PrefixAffinityRouter):
+                    self._count("affinity_hits"
+                                if self.router.last_was_primary
+                                else "affinity_misses")
+                self._requests[req.uid] = (req, name)
+                replica = self._replicas[name]
+            if replica.serving.submit_request(req, requeue=requeue) \
+                    is not None:
+                self._count("routed")
+                return
+            refused.add(name)      # stopped mid-race: try the next one
+
+
+    def stream(self, prompt: Sequence[int], **kwargs):
+        """Generator yielding tokens as they are emitted (see
+        ``ServingEngine.stream``)."""
+        return stream_tokens(self, prompt, **kwargs)
+
+    def cancel(self, req) -> bool:
+        """Cancel by Request or uid, wherever the request currently
+        lives. A request in flight between replicas (handoff/failover)
+        carries the flag with it and dies at its next boundary."""
+        with self._lock:
+            if not isinstance(req, Request):
+                ent = self._requests.get(int(req))
+                if ent is None:
+                    return False
+                req = ent[0]
+            if req.is_terminal:
+                return False
+            req._cancel_requested = True
+            ent = self._requests.get(req.uid)
+            replica = self._replicas.get(ent[1]) if ent is not None else None
+        if replica is not None:
+            replica.serving.cancel(req)
+        return True
+
+    # -- shutdown --------------------------------------------------------
+    def drain(self, timeout: Optional[float] = None,
+              reject_queued: bool = False) -> bool:
+        """Stop admission fleet-wide and serve out every backlog. Prefill
+        replicas drain first so their handoffs land before the decode
+        replicas are judged empty."""
+        with self._lock:
+            self._accepting = False
+            replicas = list(self._replicas.values())
+        for r in replicas:
+            if r.state == ReplicaState.HEALTHY:
+                r.serving.stop_admission()
+        budget = (timeout if timeout is not None
+                  else self._serving_config.drain_timeout_s)
+        deadline = time.perf_counter() + budget
+        ordered = ([r for r in replicas if r.role == "prefill"]
+                   + [r for r in replicas if r.role != "prefill"])
+        ok = True
+        for r in ordered:
+            if r.state == ReplicaState.DEAD:
+                continue
+            left = max(0.0, deadline - time.perf_counter())
+            ok = r.serving.drain(timeout=left, reject_queued=reject_queued) \
+                and ok
+        return ok
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Graceful shutdown: drain, then close every replica and stop
+        the monitor."""
+        self.drain(timeout=timeout)
+        self._stop_evt.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        with self._lock:
+            replicas = list(self._replicas.values())
+        for r in replicas:
+            if r.state != ReplicaState.DEAD:
+                r.serving.close(timeout=timeout)
+        self._flush_shed()
+        self._update_gauges()
+
+    def __enter__(self) -> "ServingFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    @property
+    def healthy_replicas(self) -> List[Replica]:
+        with self._lock:
+            return [r for r in self._replicas.values()
+                    if r.state == ReplicaState.HEALTHY]
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(r.serving.queue_depth for r in self._replicas.values()
+                       if r.state != ReplicaState.DEAD)
+
+    @property
+    def live_requests(self) -> int:
+        with self._lock:
+            return sum(r.serving.live_requests
+                       for r in self._replicas.values()
+                       if r.state != ReplicaState.DEAD)
+
+    def block_leaks(self) -> List[str]:
+        """Fleet-wide KV leak audit: the union of every replica's
+        block-balance problems, each prefixed with its replica name
+        (empty list = zero leaks everywhere, dead replicas included —
+        evacuation discards their sequences, so their allocators must
+        balance too). Valid when idle; mid-tick reads race drivers."""
+        from ..inference.ragged import block_balance_report
+
+        problems: List[str] = []
+        for r in self.replicas:
+            for p in block_balance_report(r.engine)["problems"]:
+                problems.append(f"{r.name}: {p}")
+        return problems
+
+    def in_sla_ratio(self) -> Optional[float]:
+        """Fraction of recent SLO-carrying requests that met their SLO
+        (None until one lands) — the autoscaler's quality signal."""
+        with self._lock:
+            if not self._sla_window:
+                return None
+            return sum(self._sla_window) / len(self._sla_window)
+
+    # -- replica-driver callbacks (OUTSIDE the replica's serving lock) ---
+    def _on_retire(self, req: Request) -> None:
+        # same verdict discipline as the request span: completions judged
+        # against their deadlines, sheds with an SLO count as misses,
+        # user cancels not judged
+        had_slo = (req.deadline_s is not None
+                   or req.ttft_deadline_s is not None)
+        with self._lock:
+            self._requests.pop(req.uid, None)
+            if req.state is RequestState.FINISHED:
+                verdict = req.in_slo()
+                if verdict is not None:
+                    self._sla_window.append(bool(verdict))
+            elif had_slo and not (req.state is RequestState.CANCELLED
+                                  and req.error is None):
+                self._sla_window.append(False)
+
+    def _on_handoff(self, req: Request, export) -> None:
+        """A prefill replica finished a flagged request's prompt: ship
+        the KV to a decode replica (least-loaded — the pages are new to
+        every decode replica, affinity buys nothing here). A hand-off is
+        the CONTINUATION of an admitted request, so draining replicas
+        (admission closed, serving out) still take it — only dead ones
+        are excluded. No live decode replica means the request re-queues
+        wherever possible and re-prefills (degraded, never lost)."""
+        refused: set = set()
+        while True:
+            with self._lock:
+                view = self._view("decode", live=True, refused=refused)
+                if not view:
+                    # last resort: decode ON a prefill replica (same
+                    # engine, same weights) rather than shed admitted
+                    # work — clear the flag or its next first-token
+                    # would hand off again in an endless loop
+                    view = self._view("prefill", live=True,
+                                      refused=refused)
+                    req._handoff_requested = False
+                if not view:
+                    self._reject(req, "no live replica for decode handoff")
+                    break
+                name = least_loaded_pick(view)
+                self._requests[req.uid] = (req, name)
+                replica = self._replicas[name]
+            if replica.serving.adopt(req, export):
+                self._count("handoffs")
+                return
+            # the pick stopped between the view snapshot and adopt()
+            # (scale-down reap / kill race): place it elsewhere
+            refused.add(name)
+        self._flush_shed()
+
+    def _reject(self, req: Request, reason: str) -> None:
+        """Fleet-level shed (no replica ever owned the request). Same
+        observable contract as a replica-level reject: span emitted into
+        requests.jsonl and — when the request carried an SLO — a miss in
+        the autoscaler's in-SLA window (shedding is exactly the signal
+        that must drive scale-up). The span write is DEFERRED to
+        :meth:`_flush_shed` — most callers hold the fleet lock, and sink
+        I/O under it would stall every submit/cancel/poll exactly when
+        the system sheds load (same discipline as the replica span
+        backlog)."""
+        req.error = reason
+        req.transition(RequestState.REJECTED)
+        self._count("rejected")
+        with self._lock:    # reentrant: most (not all) callers hold it
+            self._shed_backlog.append(req)
+
+    def _flush_shed(self) -> None:
+        """Emit deferred fleet-shed spans OUTSIDE the fleet lock (the
+        requests are terminal and immutable by now)."""
+        from .server import emit_request_span
+
+        if not self._shed_backlog:
+            return
+        with self._lock:
+            backlog, self._shed_backlog = self._shed_backlog, []
+        for req in backlog:
+            emit_request_span(self._telemetry, req)
+            self._on_retire(req)
+
+    # -- health / chaos / failover --------------------------------------
+    def kill_replica(self, name: str, reason: str = "killed") -> bool:
+        """Abrupt replica death (tests, chaos, ops). In-flight work fails
+        over to the survivors when ``config.failover`` is on."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None or rep.state == ReplicaState.DEAD:
+                return False
+            rep.state = ReplicaState.DEAD
+            self.router.on_leave(name)
+        logger.warning(f"ServingFleet: replica {name} died ({reason})")
+        rep.serving.kill()
+        orphans = rep.serving.evacuate()
+        self._failover_orphans(orphans, source=name)
+        self._update_gauges()
+        return True
+
+    def _failover_orphans(self, orphans: List[Request],
+                          source: str) -> None:
+        """Re-place (or shed, per config) requests harvested from a dead
+        or force-closed replica. Runs WITHOUT the fleet lock."""
+        if self.config.failover:
+            if orphans:
+                self._count("failovers", len(orphans))
+            for req in orphans:
+                if req._cancel_requested:
+                    # honor the pending cancel here (its replica is gone)
+                    # with the full terminal contract: span + counter,
+                    # same as a replica-level retire
+                    from .server import emit_request_span
+
+                    req.transition(RequestState.CANCELLED)
+                    self._count("cancelled")
+                    emit_request_span(self._telemetry, req)
+                    self._on_retire(req)
+                    continue
+                self._route(req, requeue=True)
+        else:
+            for req in orphans:
+                self._reject(req, f"replica {source} died")
+        self._flush_shed()
+
+    def poll(self) -> None:
+        """One monitor pass: driver health, injected chaos, respawn,
+        autoscale-interval check. The monitor thread loops this; tests
+        call it directly for determinism."""
+        self._check_chaos()
+        self._check_health()
+        self._check_respawn()
+        if self.config.autoscale:
+            now = time.perf_counter()
+            if now - self._last_autoscale >= self.config.autoscale_interval_s:
+                self._last_autoscale = now
+                self.autoscale_once()
+        self._flush_shed()
+        self._update_gauges()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop_evt.wait(self.config.health_interval_s):
+            try:
+                self.poll()
+            except Exception:
+                logger.exception("ServingFleet: monitor pass crashed")
+
+    def _check_chaos(self) -> None:
+        if self._chaos_fired:
+            return
+        from ..resilience.chaos import get_fault_injector
+
+        inj = get_fault_injector()
+        if inj is None:
+            return
+        with self._lock:
+            candidates = [(r.name, r.index, r.serving._tick_count)
+                          for r in self._replicas.values()
+                          if r.state == ReplicaState.HEALTHY]
+        for name, index, ticks in candidates:
+            if inj.should_kill_replica(index, ticks):
+                self._chaos_fired = True
+                self.kill_replica(name, reason="chaos: injected death")
+                return
+
+    def _check_health(self) -> None:
+        """A replica whose driver thread died (unhandled crash, real
+        process trouble) is treated exactly like injected death —
+        DRAINING replicas included: their backlog still needs a driver,
+        and an unnoticed death would strand it forever."""
+        if not self._start_drivers:
+            return              # manual-step mode: no threads to check
+        with self._lock:
+            sick = [r.name for r in self._replicas.values()
+                    if r.state != ReplicaState.DEAD and not r.driver_alive]
+        for name in sick:
+            self.kill_replica(name, reason="driver thread dead")
+
+    def _check_respawn(self) -> None:
+        """Replace dead capacity while the healthy count sits below
+        ``min_replicas`` — the fleet-local analog of ElasticAgent's
+        restart loop, with the same jittered exponential backoff shape
+        (deterministic here: replicas are stateless to replace)."""
+        if not self.config.respawn:
+            return
+        with self._lock:
+            # each pool is audited against its own floor: the serving
+            # (non-prefill) pool against min_replicas — same denominator
+            # as scale_to/autoscale, else healthy prefill replicas mask
+            # dead decode capacity — and, in disaggregated mode, the
+            # prefill pool against prefill_replicas (losing it silently
+            # degrades every request to unified re-prefill serving)
+            healthy = sum(1 for r in self._replicas.values()
+                          if r.state == ReplicaState.HEALTHY
+                          and r.role != "prefill")
+            prefill = sum(1 for r in self._replicas.values()
+                          if r.state == ReplicaState.HEALTHY
+                          and r.role == "prefill")
+            want_prefill = (self.config.prefill_replicas
+                            if self.config.disaggregated else 0)
+            if self.config.disaggregated and prefill < want_prefill:
+                role, have, floor = "prefill", prefill, want_prefill
+            elif healthy < self.config.min_replicas:
+                role = "decode" if self.config.disaggregated else "unified"
+                have, floor = healthy, self.config.min_replicas
+            else:
+                self._respawn_delay = 0.5
+                return
+            if not self._accepting:
+                return
+            if time.perf_counter() < self._respawn_after:
+                return
+            self._respawn_after = time.perf_counter() + self._respawn_delay
+            self._respawn_delay = min(self._respawn_delay * 2.0, 30.0)
+        rep = self._spawn(role=role)
+        self._count("respawns")
+        from ..resilience import record_restart
+
+        record_restart()
+        logger.warning(f"ServingFleet: respawned {role} capacity as "
+                       f"{rep.name} ({have}/{floor} healthy)")
+
+    # -- autoscaling -----------------------------------------------------
+    def _elastic_config(self):
+        from ..elasticity import ServingElasticityConfig
+
+        c = self.config
+        return ServingElasticityConfig(
+            min_replicas=c.min_replicas, max_replicas=c.max_replicas,
+            scale_up_queue_per_replica=c.scale_up_queue_per_replica,
+            scale_down_queue_per_replica=c.scale_down_queue_per_replica,
+            kv_high=c.kv_high, sla_low=c.sla_low)
+
+    def autoscale_once(self) -> int:
+        """One controller decision: measure, size via the shared
+        elasticity policy, apply. Returns the target count."""
+        from ..elasticity import compute_serving_replicas
+
+        with self._lock:
+            scalable = [r for r in self._replicas.values()
+                        if r.state != ReplicaState.DEAD
+                        and r.role != "prefill"]
+            healthy = [r for r in scalable
+                       if r.state == ReplicaState.HEALTHY]
+            queue_depth = sum(r.serving.queue_depth for r in scalable)
+            # demand, not raw occupancy: cache-reclaimable pages are
+            # capacity, and counting them would ratchet the fleet to
+            # max_replicas after any warm-cache burst
+            kv = (max(r.engine.kv_demand() for r in healthy)
+                  if healthy else 0.0)
+        target = compute_serving_replicas(
+            max(1, len(healthy)), queue_depth=queue_depth, kv_occupancy=kv,
+            in_sla_ratio=self.in_sla_ratio(), config=self._elastic_config())
+        self.scale_to(target)
+        return target
+
+    def scale_to(self, n: int) -> None:
+        """Grow to / shrink toward ``n`` serving (non-prefill) replicas.
+        Scale-down is graceful: the least-loaded replica stops admission,
+        serves out, and only then closes (finished by later polls)."""
+        with self._lock:
+            if not self._accepting:
+                # draining/closing fleet: spawning replicas that can
+                # never receive work just burns engines moments before
+                # close() tears them down (the backlog reads as load
+                # until it serves out)
+                return
+            # selection and state flip under ONE lock acquisition: a
+            # stale snapshot could resurrect a replica kill_replica()
+            # just flipped to DEAD
+            healthy = [r for r in self._replicas.values()
+                       if r.state == ReplicaState.HEALTHY
+                       and r.role != "prefill"]
+            delta = n - len(healthy)
+            victims: List[Replica] = []
+            if delta < 0:
+                victims = sorted(healthy, key=lambda r: (r.load, r.name))
+                victims = victims[:min(-delta, max(0, len(healthy) - 1))]
+                for r in victims:
+                    r.state = ReplicaState.DRAINING
+                    self.router.on_leave(r.name)
+        if delta > 0:
+            role = "decode" if self.config.disaggregated else "unified"
+            for _ in range(delta):
+                self._spawn(role=role)
+                self._count("scale_ups")
+        for r in victims:
+            r.serving.stop_admission()
+            self._count("scale_downs")
+        # reap drained replicas (from this call or earlier ones). DEAD is
+        # flipped BEFORE close(): once close sets the replica's stop
+        # event it refuses continuations, so it must already be out of
+        # every requeue/handoff view (adopt()'s refusal return covers
+        # the one in-flight call that raced the flip)
+        with self._lock:
+            drained = [r for r in self._replicas.values()
+                       if r.state == ReplicaState.DRAINING and r.load == 0]
+            for r in drained:
+                r.state = ReplicaState.DEAD
+        for r in drained:
+            r.serving.close(timeout=5.0)
+            # a continuation enqueued in the window between the DEAD flip
+            # and close() stopping the driver would otherwise be stranded
+            # in a joined-dead replica — harvest and re-place it
+            stragglers = r.serving.evacuate()
+            if stragglers:
+                self._failover_orphans(stragglers, source=r.name)
+            logger.info(f"ServingFleet: scale-down of {r.name} complete")
+        self._update_gauges()
+
+    # -- deterministic driving (tests / smoke) ---------------------------
+    def step(self) -> bool:
+        """Manual-mode driver: one monitor poll plus one tick per live
+        replica. Returns True when any replica did work. Only meaningful
+        with ``start=False`` (no competing threads)."""
+        self.poll()
+        did = False
+        for r in self.replicas:
+            if r.state == ReplicaState.DEAD:
+                continue
+            did = r.serving._tick() or did
+        return did

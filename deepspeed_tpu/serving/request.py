@@ -86,6 +86,11 @@ class Request:
     ttft_deadline_s: Optional[float] = None  # first-token SLO, from submit
     on_token: Optional[Callable[[int], None]] = None
     uid: int = field(default_factory=lambda: next(_uid_counter))
+    # stable LOGICAL id: survives re-routing, fail-over and prefill→decode
+    # hand-off across replicas, so one request is one id in requests.jsonl
+    # no matter how many engines touched it. Defaults to a uid-derived
+    # string; callers pass their own to correlate with client-side logs.
+    client_request_id: Optional[str] = None
 
     # -- lifecycle bookkeeping (driver-owned; read-only for callers) ----
     state: RequestState = RequestState.QUEUED
@@ -106,11 +111,18 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.client_request_id is None:
+            self.client_request_id = f"req-{self.uid:08d}"
+        elif not isinstance(self.client_request_id, str):
+            raise ValueError("client_request_id must be a string")
         self._done = threading.Event()
         # driver-internal: the next token to feed the engine (produced by
         # the previous tick's logits, not yet admitted as context)
         self._pending_token: Optional[int] = None
         self._cancel_requested = False
+        # fleet-internal: hand this request from its prefill replica to a
+        # decode replica once its first token resolves (disaggregated mode)
+        self._handoff_requested = False
 
     # -- state machine --------------------------------------------------
     def transition(self, new: RequestState) -> None:

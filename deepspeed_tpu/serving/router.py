@@ -1,0 +1,213 @@
+"""Request routing across serving replicas.
+
+The fleet front-end (:mod:`.fleet`) holds N engine replicas; a router
+decides which replica each incoming prompt lands on. Two policies ship:
+
+* :class:`LeastLoadedRouter` — send to the healthy replica with the
+  smallest load (queued + live requests). The throughput baseline: even
+  spread, zero locality.
+* :class:`PrefixAffinityRouter` — consistent hashing over the prompt's
+  FULL-BLOCK prefix (the exact unit the engine's automatic prefix cache
+  keys on: ``PrefixCache.match`` shares full ``kv_block_size`` pages,
+  capped so at least one token remains to prefill). Repeat traffic with
+  a shared prefix — chat system prompts, RAG templates, few-shot headers
+  — lands on the replica that already holds those KV pages, so its
+  prefill is mostly cache adoption instead of recompute. The ring is the
+  classic consistent-hash construction (``vnodes`` virtual points per
+  replica, sorted by hash; a key routes to the first point clockwise),
+  which bounds key movement on membership change: adding one replica to
+  N moves ~1/(N+1) of keys, and removing one moves ONLY the keys that
+  mapped to it — the property the fleet's failover depends on (a dead
+  replica must not reshuffle the healthy replicas' working sets).
+
+Routers are deliberately engine-agnostic: they operate on *names* plus a
+caller-supplied health/load view, so the hash-ring properties are
+testable without building a single engine.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class NoHealthyReplica(RuntimeError):
+    """Every replica is dead or draining — nothing can take the request."""
+
+
+def _hash64(data: str) -> int:
+    """Stable 64-bit hash (sha256-derived: identical across processes and
+    runs — python's ``hash()`` is salted per process and would reshuffle
+    the ring on every restart, defeating affinity)."""
+    return int.from_bytes(
+        hashlib.sha256(data.encode("utf-8")).digest()[:8], "big")
+
+
+def prefix_key(prompt: Sequence[int], block_size: int) -> Tuple[int, ...]:
+    """The routing key for a prompt: its longest cacheable full-block
+    prefix (mirrors ``PrefixCache.match`` — full blocks only, capped at
+    ``len(prompt) - 1`` so the key matches what a replica could actually
+    hold). Prompts shorter than one full block key on the whole prompt:
+    identical short prompts should still co-locate."""
+    k = (len(prompt) - 1) // block_size
+    if k <= 0:
+        return tuple(int(t) for t in prompt)
+    return tuple(int(t) for t in prompt[: k * block_size])
+
+
+def least_loaded_pick(replicas: Dict[str, float]) -> str:
+    """THE least-loaded selection (ties break by name for determinism) —
+    one definition shared by the baseline router, the affinity router's
+    degrade/spill paths, and the fleet's prefill/handoff placement."""
+    if not replicas:
+        raise NoHealthyReplica("no healthy replica to route to")
+    return min(replicas.items(), key=lambda kv: (kv[1], kv[0]))[0]
+
+
+class RouterPolicy:
+    """Base router: pick a replica name for a prompt.
+
+    ``replicas`` is the caller's current view: an ordered mapping of
+    name -> load (smaller = less loaded) restricted to replicas that can
+    accept work — health filtering happens before the router sees them.
+    """
+
+    name = "base"
+
+    def route(self, replicas: Dict[str, float],
+              prompt: Sequence[int]) -> str:
+        raise NotImplementedError
+
+    # membership hooks (stateful routers maintain a ring)
+    def on_join(self, replica: str) -> None:
+        pass
+
+    def on_leave(self, replica: str) -> None:
+        pass
+
+
+class LeastLoadedRouter(RouterPolicy):
+    """Route to the least-loaded healthy replica (ties break by name for
+    determinism)."""
+
+    name = "least_loaded"
+
+    def route(self, replicas: Dict[str, float],
+              prompt: Sequence[int]) -> str:
+        return least_loaded_pick(replicas)
+
+
+class PrefixAffinityRouter(RouterPolicy):
+    """Consistent-hash routing on the prompt's full-block prefix.
+
+    ``spill_load`` (0 = off) is the load-shedding valve: when the ring's
+    choice already carries at least that much load AND some other healthy
+    replica is strictly less loaded, the request spills to least-loaded
+    instead — affinity is a throughput optimisation, not a hostage
+    situation. Spills are reported as affinity misses.
+    """
+
+    name = "prefix_affinity"
+
+    def __init__(self, block_size: int, vnodes: int = 64,
+                 spill_load: int = 0):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.block_size = int(block_size)
+        self.vnodes = int(vnodes)
+        self.spill_load = int(spill_load)
+        self._ring: List[Tuple[int, str]] = []   # (point, replica) sorted
+        self._points: List[int] = []             # parallel sorted points
+        self._members: set = set()
+        # set by route(): True when the last pick was the ring's primary
+        # owner (an affinity hit), False on ring-walk fallback or spill
+        self.last_was_primary: Optional[bool] = None
+
+    # -- membership ------------------------------------------------------
+    def on_join(self, replica: str) -> None:
+        if replica in self._members:
+            return
+        self._members.add(replica)
+        for i in range(self.vnodes):
+            point = _hash64(f"{replica}#{i}")
+            j = bisect.bisect_left(self._points, point)
+            self._points.insert(j, point)
+            self._ring.insert(j, (point, replica))
+
+    def on_leave(self, replica: str) -> None:
+        if replica not in self._members:
+            return
+        self._members.discard(replica)
+        keep = [(p, r) for p, r in self._ring if r != replica]
+        self._ring = keep
+        self._points = [p for p, _ in keep]
+
+    # -- routing ---------------------------------------------------------
+    def _hash_for(self, prompt: Sequence[int]) -> int:
+        return _hash64(",".join(map(str,
+                                    prefix_key(prompt, self.block_size))))
+
+    def owner(self, prompt: Sequence[int],
+              eligible: Optional[Callable[[str], bool]] = None
+              ) -> Optional[str]:
+        """The ring's pick for this prompt: the first replica clockwise
+        from the key's hash, skipping ones ``eligible`` rejects. None
+        when the ring is empty or nothing is eligible."""
+        return self.owner_from_hash(self._hash_for(prompt), eligible)
+
+    def owner_from_hash(self, h: int,
+                        eligible: Optional[Callable[[str], bool]] = None
+                        ) -> Optional[str]:
+        """Ring walk from a precomputed key hash (``route`` needs both
+        the unconditional primary and the health-filtered pick — hashing
+        the prompt once serves both walks)."""
+        if not self._ring:
+            return None
+        start = bisect.bisect_right(self._points, h) % len(self._ring)
+        seen: set = set()
+        for off in range(len(self._ring)):
+            _, rep = self._ring[(start + off) % len(self._ring)]
+            if rep in seen:
+                continue
+            seen.add(rep)
+            if eligible is None or eligible(rep):
+                return rep
+            if len(seen) == len(self._members):
+                break
+        return None
+
+    def route(self, replicas: Dict[str, float],
+              prompt: Sequence[int]) -> str:
+        if not replicas:
+            raise NoHealthyReplica("no healthy replica to route to")
+        # the ring may know replicas the health view excludes (draining /
+        # dead): walk past them. Primary = first ring owner regardless of
+        # health — routing to anyone else counts as an affinity miss.
+        h = self._hash_for(prompt)
+        primary = self.owner_from_hash(h)
+        chosen = self.owner_from_hash(h, eligible=lambda r: r in replicas)
+        if chosen is None:
+            # membership drifted (replica joined the fleet but not the
+            # ring yet, or vice versa): degrade to least-loaded
+            chosen = least_loaded_pick(replicas)
+        if self.spill_load > 0 and replicas[chosen] >= self.spill_load:
+            alt = least_loaded_pick(replicas)
+            if replicas[alt] < replicas[chosen]:
+                chosen = alt
+        self.last_was_primary = (chosen == primary)
+        return chosen
+
+
+def make_router(name: str, *, block_size: int = 16, vnodes: int = 64,
+                spill_load: int = 0) -> RouterPolicy:
+    """Router factory for config-driven selection."""
+    if name == "least_loaded":
+        return LeastLoadedRouter()
+    if name == "prefix_affinity":
+        return PrefixAffinityRouter(block_size=block_size, vnodes=vnodes,
+                                    spill_load=spill_load)
+    raise ValueError(f"unknown router '{name}' "
+                     "(expected 'least_loaded' or 'prefix_affinity')")

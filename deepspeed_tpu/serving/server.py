@@ -53,6 +53,86 @@ from .request import Request, RequestState
 from .scheduler import CapacityView, SchedulerPolicy, make_policy
 
 
+def emit_request_span(telemetry, req: Request) -> None:
+    """Emit one terminal request's span record — shared by the
+    ServingEngine retire path and fleet-level rejections (a request shed
+    before it ever reached a replica must still appear in
+    requests.jsonl: one logical request, one record, no matter where it
+    died)."""
+    from ..telemetry.spans import RequestStats
+
+    if not telemetry.enabled:
+        return
+    n = len(req.tokens)
+    decode_s = (req.t_finish - req.t_first_token
+                if req.t_finish is not None
+                and req.t_first_token is not None else None)
+    # SLO verdict: judge completions against their deadlines; a
+    # rejected or failed request that CARRIED an SLO is a miss (the
+    # terminal timestamp is not a serve time — judging it would read
+    # near-100% attainment exactly when the system sheds load); a
+    # user cancel is the caller's choice, not judged
+    had_slo = (req.deadline_s is not None
+               or req.ttft_deadline_s is not None)
+    if req.state is RequestState.FINISHED:
+        in_slo = req.in_slo()
+    elif req.state is RequestState.CANCELLED and req.error is None:
+        in_slo = None
+    else:
+        in_slo = False if had_slo else None
+    telemetry.record_request_span(RequestStats(
+        uid=req.uid, state=req.state.value,
+        client_request_id=req.client_request_id, priority=req.priority,
+        prompt_tokens=len(req.prompt), new_tokens=n,
+        queue_wait_s=req.queue_wait_s, ttft_s=req.ttft_s,
+        # latency only for served requests: near-zero reject/cancel
+        # "latencies" would drag the histogram DOWN exactly when the
+        # system sheds load (same shedding guard as in_slo below)
+        latency_s=(req.latency_s
+                   if req.state is RequestState.FINISHED else None),
+        # n tokens span n-1 decode intervals (the first token ends
+        # prefill): n/decode_s would inflate the rate, infinitely so
+        # for single-token requests
+        tokens_per_s=((n - 1) / decode_s if decode_s and n > 1 else None),
+        preemptions=req.preemptions, retries=req.retries,
+        in_slo=in_slo, error=req.error))
+
+
+def stream_tokens(server, prompt: Sequence[int], **kwargs):
+    """Streaming generator over any submit/cancel surface — shared by
+    :meth:`ServingEngine.stream` and ``ServingFleet.stream``. Yields
+    tokens as the driver emits them; breaking out (or ``close()``-ing
+    the generator) cancels the request."""
+    if "on_token" in kwargs:
+        raise ValueError("stream() owns the on_token callback")
+    q: "queue_mod.Queue[int]" = queue_mod.Queue()
+    req = server.submit(prompt, on_token=q.put, **kwargs)
+    if req.state is RequestState.REJECTED:
+        raise RuntimeError(f"request rejected: {req.error}")
+    try:
+        emitted = 0
+        while True:
+            try:
+                yield q.get(timeout=0.05)
+                emitted += 1
+            except queue_mod.Empty:
+                if req.is_terminal:
+                    break
+        while emitted < len(req.tokens):   # tokens raced the sentinel
+            yield q.get_nowait()
+            emitted += 1
+        if req.state is RequestState.REJECTED:
+            # shed after admission to the queue (deadline expiry,
+            # drain, preemption latch) — must not read as a
+            # successful empty/partial generation
+            raise RuntimeError(f"request rejected: {req.error}")
+        if req.state is RequestState.CANCELLED and req.error:
+            raise RuntimeError(f"request failed: {req.error}")
+    finally:
+        if not req.is_terminal:
+            server.cancel(req)
+
+
 class ServingEngine:
     """SLO-aware continuous-batching front-end over a
     :class:`~deepspeed_tpu.inference.ragged.RaggedInferenceEngine`."""
@@ -60,7 +140,10 @@ class ServingEngine:
     def __init__(self, engine, config: Any = None,
                  policy: Optional[SchedulerPolicy] = None,
                  preemption_guard: Any = None,
-                 start: bool = True):
+                 start: bool = True,
+                 replica_id: Optional[str] = None,
+                 on_handoff=None,
+                 on_retire=None):
         from ..config import ServingConfig
 
         if config is None:
@@ -75,12 +158,25 @@ class ServingEngine:
                                    preemption=config.preemption)
                               if config.policy == "slo" else {}))
         self._guard = preemption_guard
+        # fleet wiring: a replica_id namespaces this engine's metrics
+        # (serving/<replica_id>/...) so N replicas don't stomp one gauge;
+        # on_handoff receives (request, KVExport) when a handoff-flagged
+        # request finishes prefill; on_retire fires once per terminal
+        # request (both called OUTSIDE the serving lock, driver thread)
+        self.replica_id = replica_id
+        self._metric_prefix = (f"serving/{replica_id}" if replica_id
+                               else "serving")
+        self._on_handoff = on_handoff
+        self._on_retire = on_retire
         self._lock = threading.RLock()
         self._queue: List[Request] = []
         self._live: Dict[int, Request] = {}
         self._requests: Dict[int, Request] = {}   # uid -> non-terminal req
         self._accepting = True
         self._span_backlog: List[Request] = []   # retired, span not yet emitted
+        self._adoptions: List[tuple] = []        # (req, KVExport) to import
+        self._handoff_backlog: List[tuple] = []  # (req, KVExport) to ship
+        self._handoffs_in_flight = 0             # popped, export not done
         self._last_gauges: Optional[tuple] = None
         self._stop_evt = threading.Event()
         self._tick_count = 0
@@ -89,7 +185,8 @@ class ServingEngine:
         self._stuck_reported = False
         self._driver: Optional[threading.Thread] = None
         self._watchdog: Optional[threading.Thread] = None
-        log_dist(f"ServingEngine: policy={self.policy.name} "
+        log_dist(f"ServingEngine{f'[{replica_id}]' if replica_id else ''}: "
+                 f"policy={self.policy.name} "
                  f"max_queue={config.max_queue} "
                  f"preemption={getattr(self.policy, 'preemption', False)}")
         if start:
@@ -103,7 +200,8 @@ class ServingEngine:
         return get_telemetry()
 
     def _count(self, name: str, n: float = 1.0) -> None:
-        self._telemetry.registry.counter(f"serving/{name}").inc(n)
+        self._telemetry.registry.counter(
+            f"{self._metric_prefix}/{name}").inc(n)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -123,6 +221,7 @@ class ServingEngine:
                priority: int = 0,
                deadline_s: Optional[float] = None,
                ttft_deadline_s: Optional[float] = None,
+               client_request_id: Optional[str] = None,
                on_token=None) -> Request:
         """Enqueue a request. Returns immediately; the request may come
         back already REJECTED (backpressure — full queue, serving closed,
@@ -134,10 +233,35 @@ class ServingEngine:
                                       else self.config.default_max_new_tokens),
                       eos_token_id=eos_token_id, priority=priority,
                       deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+                      client_request_id=client_request_id,
                       on_token=on_token)
-        req.t_submit = time.perf_counter()
+        return self.submit_request(req)
+
+    def submit_request(self, req: Request,
+                       requeue: bool = False) -> Optional[Request]:
+        """Enqueue an existing QUEUED :class:`Request` — the fleet-facing
+        half of :meth:`submit`: the router builds (or re-routes) the
+        request object and each replica only validates and queues it.
+        ``t_submit`` is preserved when already set (a failed-over request
+        keeps its ORIGINAL clock: its deadlines are promises to the
+        caller, not to whichever replica ends up serving it).
+
+        ``requeue`` marks the CONTINUATION of an already-admitted request
+        (fail-over, hand-off fallback): like :meth:`adopt` it bypasses
+        the admission gate and the ``max_queue`` bound — a draining
+        replica must serve out admitted work, not shed it. Only a
+        stopped driver refuses a requeue, and it does so NON-terminally
+        (returns None with the request untouched) so the caller can
+        place it on another replica."""
+        if req.state is not RequestState.QUEUED:
+            raise ValueError(
+                f"submit_request needs a QUEUED request, got {req.state.name}")
+        if req.t_submit is None:
+            req.t_submit = time.perf_counter()
         with self._lock:
-            if not self._accepting:
+            if requeue and self._stop_evt.is_set():
+                return None
+            if not requeue and not self._accepting:
                 self._reject(req, "serving closed to new requests")
             elif (len(req.prompt) + req.max_new_tokens
                     > self._engine.config.max_context):
@@ -153,7 +277,10 @@ class ServingEngine:
                 # under any policy) forever
                 self._reject(req, "prompt + max_new_tokens exceeds "
                                   "engine KV pool capacity")
-            elif len(self._queue) >= self.config.max_queue:
+            elif not requeue and len(self._queue) >= self.config.max_queue:
+                # backpressure is for NEW work; a failed-over continuation
+                # was already admitted once and queues past the bound
+                # rather than being shed
                 self._reject(req, "admission queue full")
             else:
                 self._requests[req.uid] = req
@@ -161,37 +288,83 @@ class ServingEngine:
         self._flush_spans()
         return req
 
+    def adopt(self, req: Request, kv_export) -> bool:
+        """Hand-off arrival (disaggregated decode replica): take over a
+        request whose KV a prefill replica already computed. The import
+        happens on the DRIVER thread at the next tick boundary — engine
+        state is only ever touched from there — so this just queues the
+        (request, export) pair. If the import cannot land (pool pressure,
+        geometry), the request falls back to the normal resume path:
+        re-queued here and re-prefilled from ``prompt + tokens``.
+
+        Unlike :meth:`submit_request` this does NOT check ``_accepting``:
+        a hand-off is the continuation of an already-admitted request,
+        and a draining fleet must serve out exactly these (admission
+        closed, backlog finishes). A stopped driver (killed / closed
+        replica) REFUSES — returns False with the request untouched, so
+        the fleet can place it elsewhere (nothing here would ever
+        process the pen)."""
+        with self._lock:
+            if self._stop_evt.is_set():
+                return False
+            self._requests[req.uid] = req
+            self._adoptions.append((req, kv_export))
+        return True
+
+    def stop_admission(self) -> None:
+        """Close the front door (submissions reject) without touching the
+        backlog — the graceful scale-down shape: the fleet stops routing
+        here, live work serves out, then ``close()`` is safe."""
+        with self._lock:
+            self._accepting = False
+
+    def kill(self) -> None:
+        """Abrupt stop — the injected-replica-death shape. Joins the
+        driver/watchdog threads (the in-flight tick completes; a real
+        crash would tear mid-tick, which is exactly the suspect-KV case
+        ``evacuate`` assumes) but does NOT drain, retire or release
+        anything: the fleet harvests survivors via :meth:`evacuate`."""
+        self._stop_evt.set()
+        for t in (self._driver, self._watchdog):
+            if t is not None:
+                t.join(timeout=5.0)
+        self._driver = self._watchdog = None
+
+    def evacuate(self) -> List[Request]:
+        """Post-``kill`` harvest: every non-terminal request, re-queued
+        for another replica. Engine state of live requests is DISCARDED
+        (suspect KV is never published into the prefix cache — the
+        replica died, nothing it computed since its last publish can be
+        trusted), so the allocator balances and the requests resume
+        bit-exactly elsewhere from their token streams."""
+        with self._lock:
+            orphans: List[Request] = []
+            for req in list(self._queue):
+                orphans.append(req)
+            for uid, req in list(self._live.items()):
+                self._release_engine_state(uid, publish=False)
+                req.transition(RequestState.QUEUED)
+                req._pending_token = None
+                orphans.append(req)
+            for req, _ in self._adoptions:       # never imported: no state
+                orphans.append(req)
+            for req, _ in self._handoff_backlog:  # exported + released
+                orphans.append(req)
+            self._queue.clear()
+            self._live.clear()
+            self._adoptions.clear()
+            self._handoff_backlog.clear()
+            self._requests.clear()
+            for req in orphans:
+                # these uids never come back to THIS engine
+                self._engine.clear_resume(req.uid)
+            self._accepting = False
+        return orphans
+
     def stream(self, prompt: Sequence[int], **kwargs):
         """Generator yielding tokens as the driver emits them. Breaking
         out (or ``close()``-ing the generator) cancels the request."""
-        if "on_token" in kwargs:
-            raise ValueError("stream() owns the on_token callback")
-        q: "queue_mod.Queue[int]" = queue_mod.Queue()
-        req = self.submit(prompt, on_token=q.put, **kwargs)
-        if req.state is RequestState.REJECTED:
-            raise RuntimeError(f"request rejected: {req.error}")
-        try:
-            emitted = 0
-            while True:
-                try:
-                    yield q.get(timeout=0.05)
-                    emitted += 1
-                except queue_mod.Empty:
-                    if req.is_terminal:
-                        break
-            while emitted < len(req.tokens):   # tokens raced the sentinel
-                yield q.get_nowait()
-                emitted += 1
-            if req.state is RequestState.REJECTED:
-                # shed after admission to the queue (deadline expiry,
-                # drain, preemption latch) — must not read as a
-                # successful empty/partial generation
-                raise RuntimeError(f"request rejected: {req.error}")
-            if req.state is RequestState.CANCELLED and req.error:
-                raise RuntimeError(f"request failed: {req.error}")
-        finally:
-            if not req.is_terminal:
-                self.cancel(req)
+        return stream_tokens(self, prompt, **kwargs)
 
     def cancel(self, req) -> bool:
         """Cancel by Request or uid. QUEUED requests die immediately;
@@ -203,7 +376,11 @@ class ServingEngine:
             if req is None or req.is_terminal:
                 return False
             req._cancel_requested = True
-            if req.state is RequestState.QUEUED:
+            # only requests actually sitting in OUR queue die here; ones
+            # parked in the adoption/handoff pens (state QUEUED too) are
+            # retired by the driver at their next boundary, where their
+            # pen entry is dropped with them
+            if req.state is RequestState.QUEUED and req in self._queue:
                 self._queue.remove(req)
                 self._retire(req, RequestState.CANCELLED)
         self._flush_spans()
@@ -226,11 +403,19 @@ class ServingEngine:
             timeout if timeout is not None else self.config.drain_timeout_s)
         while time.perf_counter() < deadline:
             with self._lock:
-                if not self._queue and not self._live:
+                if self._idle_locked():
                     return True
             time.sleep(0.002)
         with self._lock:
-            return not self._queue and not self._live
+            return self._idle_locked()
+
+    def _idle_locked(self) -> bool:
+        """No request in any pre-terminal holding pen (lock held):
+        queue, live set, deferred adoptions, un-shipped handoffs —
+        including ones mid-export on the driver thread."""
+        return (not self._queue and not self._live
+                and not self._adoptions and not self._handoff_backlog
+                and not self._handoffs_in_flight)
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Graceful shutdown: drain, cancel whatever would not finish,
@@ -238,13 +423,14 @@ class ServingEngine:
         drained = self.drain(timeout=timeout)
         if not drained:
             with self._lock:
-                stuck = list(self._queue) + list(self._live.values())
+                stuck = (list(self._queue) + list(self._live.values())
+                         + [req for req, _ in self._adoptions])
             for req in stuck:
                 self.cancel(req)
             t0 = time.perf_counter()
             while time.perf_counter() - t0 < 5.0:
                 with self._lock:
-                    if not self._queue and not self._live:
+                    if self._idle_locked():
                         break
                 time.sleep(0.002)
         self._stop_evt.set()
@@ -252,6 +438,7 @@ class ServingEngine:
             if t is not None:
                 t.join(timeout=5.0)
         self._driver = self._watchdog = None
+        self._flush_handoffs()
         self._flush_spans()
         self._update_gauges()
 
@@ -271,6 +458,18 @@ class ServingEngine:
     def live_requests(self) -> int:
         with self._lock:
             return len(self._live)
+
+    @property
+    def pending_work(self) -> int:
+        """Every request this replica still owes an outcome: queued,
+        live, AND the adoption/handoff pens — the count the fleet's load
+        view and scale-down reaping must use (the pens are invisible to
+        ``queue_depth``/``live_requests``, and closing a replica with a
+        parked adoption would cancel admitted work)."""
+        with self._lock:
+            return (len(self._queue) + len(self._live)
+                    + len(self._adoptions) + len(self._handoff_backlog)
+                    + self._handoffs_in_flight)
 
     def block_leaks(self) -> List[str]:
         """Allocator block-balance problems (empty = zero leak). Valid
@@ -324,8 +523,10 @@ class ServingEngine:
                     f"> {timeout:.0f}s (device call wedged?)")
 
     def _tick(self) -> bool:
-        """One driver iteration: cancellations, admission (+ preemption),
-        one engine ``put()``, token dispatch. Returns False when idle."""
+        """One driver iteration: adoptions, cancellations, admission
+        (+ preemption), one engine ``put()``, token dispatch. Returns
+        False when idle."""
+        self._import_adoptions()
         with self._lock:
             self._process_cancellations()
             self._admit()
@@ -348,12 +549,91 @@ class ServingEngine:
             self._flush_spans()
             return True
         with self._lock:
-            self._dispatch(uids, logits)
+            handoffs = self._dispatch(uids, logits)
+        self._export_handoffs(handoffs)
+        self._flush_handoffs()
         self._flush_spans()
         self._update_gauges()
         return True
 
-    # -- tick phases (lock held) ----------------------------------------
+    # -- tick phases (driver thread; engine work OUTSIDE the lock) -------
+    def _import_adoptions(self) -> None:
+        """Import handed-off KV for adopted requests (driver thread only:
+        the engine's pool is single-writer). The import itself — a full
+        KV page copy — runs OUTSIDE the serving lock, which guards only
+        the request structures; holding it across a multi-MB copy would
+        stall every submit()/cancel() on this replica. An import that
+        cannot land falls back to the normal resume path — the request
+        re-queues HERE and re-prefills ``prompt + tokens`` — so a tight
+        decode pool degrades to recompute, never to a lost request."""
+        with self._lock:
+            if not self._adoptions:
+                return
+            adoptions, self._adoptions = self._adoptions, []
+        deferred = []
+        now = time.perf_counter()
+        for req, export in adoptions:
+            if req._cancel_requested:
+                with self._lock:
+                    self._retire(req, RequestState.CANCELLED)
+                continue
+            if not req.tokens:
+                # no emitted token to continue from — nothing a KV import
+                # can resume; take the ordinary prefill path instead
+                with self._lock:
+                    self._queue.append(req)
+                continue
+            if not self._engine._free_slots:
+                # slot exhaustion is TRANSIENT (a live decode finishing
+                # frees one, and adoptions run before admission each
+                # tick): defer rather than burn the export on a
+                # re-prefill that would queue behind the same slots
+                deferred.append((req, export))
+                continue
+            try:
+                self._engine.import_kv(req.uid, export)
+            except Exception as e:
+                logger.warning(
+                    f"ServingEngine: KV import for request {req.uid} "
+                    f"failed ({type(e).__name__}: {e}); falling back to "
+                    f"re-prefill")
+                self._count("adopt_fallbacks")
+                with self._lock:
+                    self._queue.append(req)
+                continue
+            with self._lock:
+                req.transition(RequestState.PREFILL)
+                req.transition(RequestState.DECODE)
+                req.t_admit = now
+                if req.t_first_admit is None:
+                    req.t_first_admit = now
+                # the prefill replica emitted at least one token; feeding
+                # the last one continues the greedy stream bit-exactly
+                req._pending_token = req.tokens[-1]
+                self._live[req.uid] = req
+            self._count("adopted")
+        if deferred:
+            with self._lock:
+                self._adoptions.extend(deferred)
+
+    def _export_handoffs(self, reqs: List[Request]) -> None:
+        """Export + release engine state for requests leaving through the
+        hand-off seam (driver thread, OUTSIDE the serving lock — same
+        stall argument as the import side). The prompt pages are
+        published into OUR prefix cache on the way out (repeat prefixes
+        still hit this prefill replica). ``_handoffs_in_flight`` keeps
+        drain honest across the window where the request is in no pen."""
+        for req in reqs:
+            export = self._engine.export_kv(req.uid)
+            self._engine.preempt(req.uid)
+            self._engine.clear_resume(req.uid)   # leaves this engine for good
+            req.transition(RequestState.QUEUED)
+            req._pending_token = None
+            with self._lock:
+                self._handoff_backlog.append((req, export))
+                self._handoffs_in_flight -= 1
+            self._count("handoffs_out")
+
     def _process_cancellations(self) -> None:
         for uid, req in list(self._live.items()):
             if req._cancel_requested:
@@ -507,11 +787,13 @@ class ServingEngine:
                                  f"retries: {exc}")
                     self._retire(req, RequestState.CANCELLED)
 
-    def _dispatch(self, uids, logits: np.ndarray) -> None:
+    def _dispatch(self, uids, logits: np.ndarray) -> List[Request]:
         """Turn the tick's logits into emitted tokens, completions and
-        telemetry."""
+        telemetry. Returns the requests leaving via the hand-off seam
+        (their KV export happens after the lock drops)."""
         now = time.perf_counter()
         finished: List[int] = []
+        handoffs: List[Request] = []
         for row, uid in zip(logits, uids):
             req = self._live.get(uid)
             if req is None or np.isnan(row[0]):
@@ -535,10 +817,23 @@ class ServingEngine:
                     or (req.eos_token_id is not None
                         and tok == req.eos_token_id)):
                 finished.append(uid)
+            elif (req._handoff_requested and self._on_handoff is not None
+                    and self._engine.seqs.get(uid) is not None
+                    and self._engine.seqs[uid].pending == 0):
+                # disaggregated hand-off: prefill is done and the first
+                # token(s) resolved — hand the request to
+                # ``_export_handoffs`` (KV export + release outside the
+                # lock), which ships it to a decode replica via the
+                # fleet callback
+                self._live.pop(uid)
+                self._requests.pop(uid, None)
+                self._handoffs_in_flight += 1
+                handoffs.append(req)
         for uid in finished:
             req = self._live.pop(uid)
             self._engine.flush([uid])         # publishes into prefix cache
             self._retire(req, RequestState.FINISHED)
+        return handoffs
 
     # -- shared helpers --------------------------------------------------
     def _release_engine_state(self, uid: int, publish: bool) -> None:
@@ -570,6 +865,28 @@ class ServingEngine:
         # sink must not stall submit()/cancel()/the next tick
         self._span_backlog.append(req)
 
+    def _flush_handoffs(self) -> None:
+        """Deliver exported requests to the fleet OUTSIDE the serving
+        lock: the callback routes to (and locks) another replica, and
+        holding our lock across that is a lock-order inversion waiting
+        to happen."""
+        if not self._handoff_backlog:
+            return
+        with self._lock:
+            backlog, self._handoff_backlog = self._handoff_backlog, []
+        for req, export in backlog:
+            try:
+                self._on_handoff(req, export)
+            except Exception:
+                # the request's engine state is already released; the one
+                # recovery that loses nothing is re-queueing it here
+                logger.exception(
+                    f"ServingEngine: handoff callback failed for request "
+                    f"{req.uid}; re-queueing locally")
+                with self._lock:
+                    self._requests[req.uid] = req
+                    self._queue.append(req)
+
     def _flush_spans(self) -> None:
         """Emit deferred request spans OUTSIDE the serving lock (the
         request objects are terminal and immutable by now)."""
@@ -579,45 +896,16 @@ class ServingEngine:
             backlog, self._span_backlog = self._span_backlog, []
         for req in backlog:
             self._emit_span(req)
+            if self._on_retire is not None:
+                try:
+                    self._on_retire(req)
+                except Exception:
+                    logger.exception(
+                        f"ServingEngine: on_retire callback failed "
+                        f"(request {req.uid})")
 
     def _emit_span(self, req: Request) -> None:
-        from ..telemetry.spans import RequestStats
-
-        t = self._telemetry
-        if not t.enabled:
-            return
-        n = len(req.tokens)
-        decode_s = (req.t_finish - req.t_first_token
-                    if req.t_finish is not None
-                    and req.t_first_token is not None else None)
-        # SLO verdict: judge completions against their deadlines; a
-        # rejected or failed request that CARRIED an SLO is a miss (the
-        # terminal timestamp is not a serve time — judging it would read
-        # near-100% attainment exactly when the system sheds load); a
-        # user cancel is the caller's choice, not judged
-        had_slo = (req.deadline_s is not None
-                   or req.ttft_deadline_s is not None)
-        if req.state is RequestState.FINISHED:
-            in_slo = req.in_slo()
-        elif req.state is RequestState.CANCELLED and req.error is None:
-            in_slo = None
-        else:
-            in_slo = False if had_slo else None
-        t.record_request_span(RequestStats(
-            uid=req.uid, state=req.state.value, priority=req.priority,
-            prompt_tokens=len(req.prompt), new_tokens=n,
-            queue_wait_s=req.queue_wait_s, ttft_s=req.ttft_s,
-            # latency only for served requests: near-zero reject/cancel
-            # "latencies" would drag the histogram DOWN exactly when the
-            # system sheds load (same shedding guard as in_slo below)
-            latency_s=(req.latency_s
-                       if req.state is RequestState.FINISHED else None),
-            # n tokens span n-1 decode intervals (the first token ends
-            # prefill): n/decode_s would inflate the rate, infinitely so
-            # for single-token requests
-            tokens_per_s=((n - 1) / decode_s if decode_s and n > 1 else None),
-            preemptions=req.preemptions, retries=req.retries,
-            in_slo=in_slo, error=req.error))
+        emit_request_span(self._telemetry, req)
 
     def _update_gauges(self) -> None:
         t = self._telemetry
@@ -630,6 +918,6 @@ class ServingEngine:
             return                      # unchanged values every poll
         self._last_gauges = snap
         r = t.registry
-        r.gauge("serving/queue_depth").set(depth)
-        r.gauge("serving/live_requests").set(live)
-        r.gauge("serving/kv_occupancy").set(snap[2])
+        r.gauge(f"{self._metric_prefix}/queue_depth").set(depth)
+        r.gauge(f"{self._metric_prefix}/live_requests").set(live)
+        r.gauge(f"{self._metric_prefix}/kv_occupancy").set(snap[2])

@@ -110,6 +110,13 @@ class StepStats:
 REQUEST_RECORD_SCHEMA: Dict[str, tuple] = {
     "schema_version": ((int,), True),
     "uid": ((int,), True),
+    # the LOGICAL request id: stable across re-routing / fail-over /
+    # prefill→decode hand-off between replicas, so one request stays one
+    # id in requests.jsonl however many engines served it. Optional in
+    # the schema (not a version bump): every record emitted since the
+    # field landed carries it, but archived version-1 streams predate it
+    # and must keep validating.
+    "client_request_id": ((str,), False),
     "state": ((str,), True),
     "priority": ((int,), True),
     "prompt_tokens": ((int,), True),
@@ -136,6 +143,7 @@ class RequestStats:
 
     uid: int
     state: str
+    client_request_id: str = ""
     priority: int = 0
     prompt_tokens: int = 0
     new_tokens: int = 0

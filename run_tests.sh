@@ -43,6 +43,20 @@ if [ "$#" -eq 0 ]; then
         smoke_rc=$serve_rc
     fi
 
+    # serving-fleet smoke (CPU evidence lane, docs/serving.md): in-SLA
+    # goodput must scale >= 1.8x from 1 -> 2 replicas under the seeded
+    # overload; prefix-affinity routing must beat least-loaded on
+    # prefix-cache hit rate; injected replica death (failover) and the
+    # disaggregated prefill->decode handoff must be bit-identical to an
+    # uninterrupted single-engine run; zero leaked KV pages on every
+    # replica on every leg
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/fleet_smoke.py
+    fleet_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$fleet_rc
+    fi
+
     # host-overhead perf smoke (CPU evidence lane, docs/performance.md):
     # steady-state host overhead with prefetch + train_steps(8) must stay
     # >= 2x lower than the synchronous per-step path, with zero

@@ -32,6 +32,14 @@ import pytest  # noqa: E402
 from deepspeed_tpu.parallel import mesh as mesh_mod  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 lane")
+    config.addinivalue_line(
+        "markers", "fleet: multi-replica serving-fleet tests (selectable "
+        "with -m fleet; kept tier-1-fast)")
+
+
 @pytest.fixture(autouse=True)
 def _reset_topology():
     mesh_mod.reset_topology()
