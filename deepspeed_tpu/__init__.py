@@ -94,7 +94,7 @@ def initialize(*,
                 tp_specs = model.partition_specs(param_shapes, topology)
             rules = ZeroShardingRules(topology, cfg.zero)
             init_shardings = rules.param_shardings(param_shapes, tp_specs)
-            params = jax.jit(model.init,
+            params = jax.jit(model.init,  # dslint: disable=recompile-hazard -- one-shot sharded init at engine construction; initialize() runs once per process
                              out_shardings=init_shardings)(init_rng, *model_args)
         else:
             params = model.init(init_rng, *model_args)

@@ -1,0 +1,17 @@
+"""dslint — AST-based invariant checker for deepspeed_tpu.
+
+Machine-checks the invariants the perf and serving layers are built on
+(docs/static_analysis.md): no host syncs or host state inside traced
+code, no per-call jit construction, lock order fleet -> replica with no
+blocking work or user callbacks under a held lock, and no broad
+``except`` swallowing the typed fault semantics. Pure stdlib ``ast`` —
+nothing in this package imports jax or executes analyzed code.
+
+CLI: ``python -m deepspeed_tpu.analysis --check --baseline
+dslint_baseline.json`` (the run_tests.sh gate).
+"""
+
+from .cli import analyze, main  # noqa: F401
+from .findings import Baseline, Finding  # noqa: F401
+from .model import PackageModel, build_package_model  # noqa: F401
+from .registry import Rule, all_rules, known_rule_ids, register  # noqa: F401

@@ -1,0 +1,162 @@
+"""dslint CLI: ``python -m deepspeed_tpu.analysis [paths...]``.
+
+Modes:
+
+* default — analyze and print human-readable findings;
+* ``--check`` — exit 1 on any finding that is neither suppressed
+  in-source nor grandfathered in the baseline (the CI gate);
+* ``--update-baseline`` — rewrite the baseline to exactly today's
+  unsuppressed findings (run after fixing or deliberately accepting);
+* ``--format json`` — machine-readable output;
+* ``--list-rules`` — the rule catalog.
+
+With no paths, the ``deepspeed_tpu`` package containing this module is
+analyzed — so the committed gate line works from the repo root with no
+arguments beyond the baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from .findings import (Baseline, Finding, apply_suppressions,
+                       parse_suppressions)
+from .model import build_package_model
+from .registry import all_rules, known_rule_ids
+
+
+def analyze(paths: Sequence[str], base: Optional[str] = None,
+            select: Optional[Sequence[str]] = None,
+            ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run every (selected) rule over ``paths``; returns findings with
+    suppression flags applied (suppressed ones are kept, marked)."""
+    pkg = build_package_model(paths, base=base)
+    known = set(known_rule_ids())
+    rules = all_rules()
+    active = [rid for rid in sorted(rules)
+              if (not select or rid in select)
+              and (not ignore or rid not in ignore)]
+    findings: List[Finding] = []
+    for rid in active:
+        findings.extend(rules[rid]().run(pkg))
+    sups = []
+    meta_on = (not select or "suppression" in select) and \
+        (not ignore or "suppression" not in ignore)
+    for mod in pkg.modules.values():
+        s, problems = parse_suppressions(mod.key, mod.comments, known)
+        sups.extend(s)
+        if meta_on:
+            findings.extend(problems)
+    unused = apply_suppressions(findings, sups)
+    if meta_on:
+        findings.extend(unused)
+    for f in findings:
+        mod = pkg.modules.get(f.path)
+        if mod is not None:
+            f.source_line = mod.line(f.line)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule, f.code))
+    return findings
+
+
+def _default_paths() -> List[str]:
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [pkg_dir]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.analysis",
+        description="dslint: AST invariant checker for host-sync, "
+                    "trace-hygiene, recompile-hazard, lock-discipline "
+                    "and exception-discipline (docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the "
+                         "deepspeed_tpu package)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on unsuppressed, un-baselined findings")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON of grandfathered findings")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite --baseline from current findings")
+    ap.add_argument("--format", choices=("human", "json"),
+                    default="human")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run")
+    ap.add_argument("--ignore", default=None,
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed/baselined findings")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        rules = all_rules()
+        for rid in sorted(rules):
+            print(f"{rid:24s} {rules[rid].summary}")
+        print(f"{'suppression':24s} malformed / reasonless / unused "
+              f"dslint suppression comments (meta-rule)")
+        return 0
+
+    paths = args.paths or _default_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"dslint: no such path: {p}", file=sys.stderr)
+            return 2
+    cwd = os.getcwd()
+    base = cwd if all(os.path.abspath(p).startswith(cwd + os.sep)
+                      or os.path.abspath(p) == cwd for p in paths) \
+        else None
+    select = args.select.split(",") if args.select else None
+    ignore = args.ignore.split(",") if args.ignore else None
+    findings = analyze(paths, base=base, select=select, ignore=ignore)
+
+    stale = 0
+    if args.baseline and not args.update_baseline:
+        stale = Baseline.load(args.baseline).absorb(findings)
+    if args.update_baseline:
+        if not args.baseline:
+            print("dslint: --update-baseline needs --baseline",
+                  file=sys.stderr)
+            return 2
+        Baseline.from_findings(findings).save(args.baseline)
+
+    live = [f for f in findings if not f.suppressed and not f.baselined]
+    suppressed = sum(1 for f in findings if f.suppressed)
+    baselined = sum(1 for f in findings if f.baselined)
+    n_files = len({f.path for f in live})
+
+    if args.format == "json":
+        shown = findings if args.show_suppressed else live
+        print(json.dumps({
+            "findings": [f.to_dict() for f in shown],
+            "summary": {"total": len(findings), "live": len(live),
+                        "suppressed": suppressed,
+                        "baselined": baselined,
+                        "stale_baseline_entries": stale}},
+            indent=1, sort_keys=True))
+    else:
+        shown = findings if args.show_suppressed else live
+        for f in shown:
+            tag = ""
+            if f.suppressed:
+                tag = " [suppressed]"
+            elif f.baselined:
+                tag = " [baselined]"
+            print(f"{f.location()}: {f.rule}[{f.code}] {f.message} "
+                  f"(in {f.symbol}){tag}")
+        verdict = "PASS" if not live else "FAIL"
+        gate = f"; gate: {verdict}" if args.check else ""
+        print(f"dslint: {len(live)} finding(s) in {n_files} file(s) "
+              f"({suppressed} suppressed, {baselined} baselined"
+              + (f", {stale} stale baseline entrie(s)" if stale else "")
+              + f"){gate}")
+
+    if args.update_baseline:
+        return 0
+    if args.check and live:
+        return 1
+    return 0

@@ -1,0 +1,222 @@
+"""Findings, per-line suppressions, and the committed baseline.
+
+Suppression syntax (the reason is REQUIRED — a suppression without one
+is itself a finding):
+
+    something_risky()   # dslint: disable=host-sync -- trace-time constant
+    # dslint: disable-next-line=lock-discipline -- dedicated sink mutex
+    sink.write(record)
+
+Multiple rules: ``disable=host-sync,trace-hygiene -- reason``. The
+comment must sit on the flagged line (or the line above, with
+``disable-next-line``). Suppressions that match no finding are reported
+too (``suppression`` rule): a stale suppression hides nothing today but
+will silently hide a regression tomorrow.
+
+The baseline file grandfathers pre-existing findings so the CI gate can
+demand *zero new* findings without requiring a big-bang cleanup.
+Entries are fingerprinted by (rule, path, symbol, normalized source
+line) — never by line number, which drifts on every unrelated edit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dslint:\s*(disable(?:-next-line)?)\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\- ]+?)"
+    r"\s*(?:--\s*(?P<reason>.*))?$")
+
+
+@dataclass
+class Finding:
+    rule: str          # rule family id, e.g. "host-sync"
+    code: str          # sub-check, e.g. "item-call"
+    path: str          # display-relative path
+    line: int
+    col: int
+    message: str
+    symbol: str = "<module>"
+    #: set post-collection
+    source_line: str = ""
+    suppressed: bool = False
+    baselined: bool = False
+
+    def fingerprint(self) -> str:
+        norm = " ".join(self.source_line.split())
+        h = hashlib.sha1(
+            f"{self.rule}|{self.path}|{self.symbol}|{norm}".encode())
+        return h.hexdigest()[:16]
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_dict(self) -> Dict:
+        return {"rule": self.rule, "code": self.code, "path": self.path,
+                "line": self.line, "col": self.col,
+                "message": self.message, "symbol": self.symbol,
+                "suppressed": self.suppressed,
+                "baselined": self.baselined,
+                "fingerprint": self.fingerprint()}
+
+
+@dataclass
+class Suppression:
+    path: str
+    comment_line: int       # line the comment sits on
+    applies_to: int         # line findings must be on to match
+    rules: Tuple[str, ...]
+    reason: str
+    used_rules: Set[str] = field(default_factory=set)
+
+
+def parse_suppressions(path: str, comments: Dict[int, str],
+                       known_rules: Set[str]
+                       ) -> Tuple[List[Suppression], List[Finding]]:
+    """Extract suppressions from one module's comments; malformed ones
+    come back as findings of the ``suppression`` meta-rule."""
+    sups: List[Suppression] = []
+    problems: List[Finding] = []
+    for lineno, text in sorted(comments.items()):
+        # pragma syntax is the tool name followed by a colon — prose
+        # comments may mention the tool by bare name without being
+        # parsed as suppressions
+        if "dslint" + ":" not in text:
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            problems.append(Finding(
+                rule="suppression", code="malformed", path=path,
+                line=lineno, col=0,
+                message="malformed dslint comment (expected "
+                        "'# dslint: disable=<rule> -- <reason>')"))
+            continue
+        rules = tuple(r.strip() for r in m.group("rules").split(",")
+                      if r.strip())
+        reason = (m.group("reason") or "").strip()
+        unknown = [r for r in rules if r not in known_rules]
+        if unknown:
+            problems.append(Finding(
+                rule="suppression", code="unknown-rule", path=path,
+                line=lineno, col=0,
+                message=f"suppression names unknown rule(s): "
+                        f"{', '.join(unknown)}"))
+            rules = tuple(r for r in rules if r in known_rules)
+            if not rules:   # nothing valid left: no (unused) suppression
+                continue
+        if not reason:
+            problems.append(Finding(
+                rule="suppression", code="missing-reason", path=path,
+                line=lineno, col=0,
+                message="suppression without a reason — add "
+                        "'-- <why this is safe here>'"))
+            continue   # a reasonless suppression does not suppress
+        applies = lineno + 1 if m.group(1) == "disable-next-line" \
+            else lineno
+        sups.append(Suppression(path=path, comment_line=lineno,
+                                applies_to=applies, rules=rules,
+                                reason=reason))
+    return sups, problems
+
+
+def apply_suppressions(findings: List[Finding],
+                       sups: List[Suppression]) -> List[Finding]:
+    """Mark suppressed findings; return findings for UNUSED suppressions
+    (a suppression that matches nothing is dead weight that will hide a
+    future regression — keep them honest)."""
+    index: Dict[Tuple[str, int], List[Suppression]] = {}
+    for s in sups:
+        index.setdefault((s.path, s.applies_to), []).append(s)
+    for f in findings:
+        for s in index.get((f.path, f.line), []):
+            if f.rule in s.rules:
+                f.suppressed = True
+                s.used_rules.add(f.rule)
+    unused: List[Finding] = []
+    for s in sups:
+        # per-RULE accounting: `disable=a,b` where only `a` ever fires
+        # leaves a dead `b` that would silently swallow a future
+        # b-finding on this line — report the unmatched subset
+        dead = [r for r in s.rules if r not in s.used_rules]
+        if dead:
+            unused.append(Finding(
+                rule="suppression", code="unused", path=s.path,
+                line=s.comment_line, col=0,
+                message=f"unused suppression for "
+                        f"{', '.join(dead)} — nothing on this line "
+                        f"triggers it; remove "
+                        f"{'the comment' if len(dead) == len(s.rules) else 'that rule from the comment'}"))
+    return unused
+
+
+# ----------------------------------------------------------------------
+# baseline
+# ----------------------------------------------------------------------
+
+class Baseline:
+    """Fingerprint multiset of grandfathered findings."""
+
+    def __init__(self, counts: Optional[Dict[str, int]] = None,
+                 meta: Optional[Dict[str, Dict]] = None) -> None:
+        self.counts: Dict[str, int] = dict(counts or {})
+        self.meta: Dict[str, Dict] = dict(meta or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+        except FileNotFoundError:
+            return cls()
+        counts: Dict[str, int] = {}
+        meta: Dict[str, Dict] = {}
+        for e in data.get("entries", []):
+            fp = e["fingerprint"]
+            counts[fp] = counts.get(fp, 0) + int(e.get("count", 1))
+            meta[fp] = e
+        return cls(counts, meta)
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        b = cls()
+        for f in findings:
+            if f.suppressed:
+                continue
+            fp = f.fingerprint()
+            b.counts[fp] = b.counts.get(fp, 0) + 1
+            b.meta.setdefault(fp, {
+                "fingerprint": fp, "rule": f.rule, "path": f.path,
+                "symbol": f.symbol, "message": f.message})
+        return b
+
+    def save(self, path: str) -> None:
+        entries = []
+        for fp in sorted(self.counts):
+            e = dict(self.meta.get(fp, {"fingerprint": fp}))
+            e["fingerprint"] = fp
+            e["count"] = self.counts[fp]
+            entries.append(e)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"version": 1, "entries": entries}, fh, indent=1,
+                      sort_keys=True)
+            fh.write("\n")
+
+    def absorb(self, findings: List[Finding]) -> int:
+        """Mark up to ``count`` findings per fingerprint as baselined.
+        Returns the number of STALE baseline entries (fingerprints with
+        no surviving finding — the code was fixed; the entry should be
+        dropped via --update-baseline)."""
+        remaining = dict(self.counts)
+        for f in findings:
+            if f.suppressed:
+                continue
+            fp = f.fingerprint()
+            if remaining.get(fp, 0) > 0:
+                remaining[fp] -= 1
+                f.baselined = True
+        return sum(1 for fp, n in remaining.items() if n > 0)

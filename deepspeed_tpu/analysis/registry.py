@@ -1,0 +1,59 @@
+"""Rule registry: a rule is a named family of related checks.
+
+A rule subclasses :class:`Rule`, registers via :func:`register`, and
+yields :class:`~.findings.Finding` objects from :meth:`run`. Suppression
+and baseline granularity is the rule *family* id (``host-sync``), while
+each finding also carries a ``code`` naming the specific check
+(``item-call``) for humans and golden tests.
+
+Adding a rule (see docs/static_analysis.md for the worked example):
+
+1. create ``rules/my_rule.py`` with a ``Rule`` subclass and
+   ``@register`` it;
+2. import the module from ``rules/__init__.py``;
+3. add a planted true-positive and a near-miss true-negative fixture
+   under ``tests/fixtures/dslint/`` and a golden entry in
+   ``tests/test_static_analysis.py``;
+4. document it in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Type
+
+from .findings import Finding
+from .model import PackageModel
+
+
+class Rule:
+    #: family id used in suppressions / --select / baseline entries
+    id: str = ""
+    #: one-line description for --list-rules
+    summary: str = ""
+
+    def run(self, pkg: PackageModel) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+_RULES: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in _RULES:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    _RULES[cls.id] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    # import for side effect: rule modules self-register
+    from . import rules  # noqa: F401
+
+    return dict(_RULES)
+
+
+def known_rule_ids() -> List[str]:
+    ids = sorted(all_rules())
+    return ids + ["suppression"]   # the meta-rule has no Rule class

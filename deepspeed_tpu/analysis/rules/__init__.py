@@ -1,0 +1,7 @@
+"""Rule modules self-register on import (see ..registry)."""
+
+from . import host_sync        # noqa: F401
+from . import trace_hygiene    # noqa: F401
+from . import recompile        # noqa: F401
+from . import locks            # noqa: F401
+from . import exceptions       # noqa: F401

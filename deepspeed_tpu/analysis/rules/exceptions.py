@@ -1,0 +1,154 @@
+"""exception-discipline: broad handlers that swallow typed fault
+semantics.
+
+The resilience/serving layers communicate through exception TYPES:
+``TickFault`` (recoverable tick error -> retry budget), ``PoolExhausted``
+(typed KV exhaustion -> preempt-and-retry, explicitly distinct from a
+generic device RuntimeError), ``InjectedFault`` (chaos, a BaseException
+precisely so ``except Exception`` can never absorb an injected crash),
+``RetryError`` (budget spent). A broad ``except Exception`` dropped into
+a tick/retry path silently converts those contracts into "log and carry
+on" — the soak passes, the recovery path rots.
+
+Checks:
+* ``bare-except`` — ``except:`` catches BaseException, including
+  InjectedFault and KeyboardInterrupt; always flagged (package-wide)
+  unless the handler re-raises;
+* ``broad-baseexception`` — ``except BaseException`` without re-raise,
+  same blast radius, package-wide;
+* ``broad-except`` — ``except Exception`` in a tick/retry/serving/
+  resilience path that neither re-raises, nor follows a narrower
+  domain-fault handler, nor visibly hands the exception to a recovery
+  function (passing ``e`` to a non-logging call);
+* ``caught-injected-fault`` — explicitly catching InjectedFault outside
+  the chaos harness defeats the whole point of injecting it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional
+
+from ..findings import Finding
+from ..model import (PackageModel, FunctionInfo, ModuleInfo,
+                     final_attr_name, iter_shallow)
+from ..registry import Rule, register
+
+DOMAIN_FAULTS = {"TickFault", "PoolExhausted", "InjectedFault",
+                 "CollectiveFault", "RetryError"}
+_DOMAIN_PATH = re.compile(r"(^|/)(serving|resilience)(/|\.py$)")
+_DOMAIN_FUNC = re.compile(r"tick|retry|drive|recover|resume")
+_LOGGING_HEADS = {"logger", "logging", "warnings", "log", "print",
+                  "log_dist"}
+
+
+def _handler_names(h: ast.ExceptHandler) -> List[str]:
+    if h.type is None:
+        return []
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    out = []
+    for t in types:
+        n = final_attr_name(t)
+        if n:
+            out.append(n)
+    return out
+
+
+def _reraises(h: ast.ExceptHandler) -> bool:
+    for node in iter_shallow(h):
+        if isinstance(node, ast.Raise):
+            return True
+    return False
+
+
+def _hands_off(h: ast.ExceptHandler) -> bool:
+    """The bound exception is passed as an argument to a non-logging
+    call — the handler is routing the fault to recovery machinery
+    (``self._on_tick_fault(uids, e)``), not swallowing it."""
+    if h.name is None:
+        return False
+    for node in iter_shallow(h):
+        if not isinstance(node, ast.Call):
+            continue
+        head = node.func
+        while isinstance(head, ast.Attribute):
+            head = head.value
+        head_name = head.id if isinstance(head, ast.Name) else ""
+        fname = final_attr_name(node.func) or ""
+        if head_name in _LOGGING_HEADS or fname in _LOGGING_HEADS:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Name) and arg.id == h.name:
+                return True
+    return False
+
+
+@register
+class ExceptionDisciplineRule(Rule):
+    id = "exception-discipline"
+    summary = ("bare/BaseException handlers anywhere; except Exception "
+               "in tick/retry paths that swallows typed fault semantics")
+
+    def run(self, pkg: PackageModel) -> Iterator[Finding]:
+        for mod in pkg.modules.values():
+            in_chaos = mod.key.endswith("resilience/chaos.py")
+            for f in pkg.functions_in(mod.key):
+                yield from self._check_function(f, mod, in_chaos)
+
+    def _check_function(self, f: FunctionInfo, mod: ModuleInfo,
+                        in_chaos: bool) -> Iterator[Finding]:
+        domain = bool(_DOMAIN_PATH.search(mod.key)
+                      or _DOMAIN_FUNC.search(f.name))
+        for node in iter_shallow(f.node):
+            if not isinstance(node, ast.Try):
+                continue
+            narrower_domain = False
+            for h in node.handlers:
+                names = _handler_names(h)
+                if set(names) & DOMAIN_FAULTS:
+                    if "InjectedFault" in names and not in_chaos:
+                        yield Finding(
+                            rule=self.id, code="caught-injected-fault",
+                            path=mod.key, line=h.lineno,
+                            col=h.col_offset, symbol=f.qualname,
+                            message="catching InjectedFault defeats "
+                                    "chaos testing — it is a "
+                                    "BaseException precisely so fault "
+                                    "injection can't be absorbed")
+                    narrower_domain = True
+                    continue
+                if h.type is None:
+                    if not _reraises(h):
+                        yield Finding(
+                            rule=self.id, code="bare-except",
+                            path=mod.key, line=h.lineno,
+                            col=h.col_offset, symbol=f.qualname,
+                            message="bare `except:` swallows "
+                                    "BaseException — including "
+                                    "InjectedFault and "
+                                    "KeyboardInterrupt; catch the "
+                                    "specific types or re-raise")
+                    continue
+                if "BaseException" in names and not _reraises(h):
+                    yield Finding(
+                        rule=self.id, code="broad-baseexception",
+                        path=mod.key, line=h.lineno, col=h.col_offset,
+                        symbol=f.qualname,
+                        message="`except BaseException` without "
+                                "re-raise swallows InjectedFault / "
+                                "KeyboardInterrupt")
+                    continue
+                if "Exception" in names and domain:
+                    if _reraises(h) or narrower_domain or _hands_off(h):
+                        continue
+                    yield Finding(
+                        rule=self.id, code="broad-except", path=mod.key,
+                        line=h.lineno, col=h.col_offset,
+                        symbol=f.qualname,
+                        message="broad `except Exception` in a "
+                                "tick/retry path can absorb "
+                                "TickFault/PoolExhausted recovery "
+                                "semantics — catch the typed faults "
+                                "first, re-raise, or hand the "
+                                "exception to the recovery path")

@@ -1,0 +1,149 @@
+"""host-sync: device->host synchronization inside traced code.
+
+The contract this enforces is PR 2's "zero extra host syncs when
+telemetry is off" and ROADMAP item 1's compute-collective overlap: one
+``.item()`` / ``float()`` / ``np.asarray`` on an array value inside a
+``@jax.jit`` / ``lax.scan`` / ``shard_map`` body (or anything those
+bodies call) either fails at trace time or — worse, via host callbacks
+and debugging shims — silently serializes the device stream against the
+host. ``print()`` in traced code doesn't sync but prints *tracers* once
+at trace time, which is always a leftover debug statement; use
+``jax.debug.print`` when output is really wanted.
+
+Scope: ONLY functions in the traced set (see model.py). Host-side
+orchestration code converts arrays freely — that is its job.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..findings import Finding
+from ..model import (PackageModel, FunctionInfo, ModuleInfo,
+                     final_attr_name, dotted_name, iter_shallow)
+from ..registry import Rule, register
+
+_SYNC_METHODS = {
+    "item": "forces a device->host transfer of the value",
+    "tolist": "copies the whole array to host",
+    "block_until_ready": "blocks the host on the device stream",
+}
+_CASTS = {"float", "int", "bool", "complex"}
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """Expressions that are trace-time constants (shape arithmetic):
+    casting those is fine inside traced code."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in {"shape", "ndim", "size", "dtype", "itemsize"}
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.Call):
+        name = final_attr_name(node.func)
+        if name in {"len", "prod", "range", "getenv"}:
+            return True
+        # os.environ.get(...) is a host constant read at trace time
+        return (name == "get" and isinstance(node.func, ast.Attribute)
+                and final_attr_name(node.func.value) == "environ")
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    return False
+
+
+def _numpy_attr(mod: ModuleInfo, func: ast.AST) -> Optional[str]:
+    """'asarray' when ``func`` is numpy's asarray/array via any alias."""
+    if isinstance(func, ast.Attribute):
+        dn = dotted_name(func)
+        if dn is None:
+            return None
+        head = dn.split(".")[0]
+        real = mod.alias_to_module.get(head)
+        if real == "numpy" or (real or "").startswith("numpy."):
+            return func.attr
+    elif isinstance(func, ast.Name):
+        imp = mod.name_imports.get(func.id)
+        if imp and imp[0].lstrip(".") == "numpy":
+            return imp[1]
+    return None
+
+
+def _jax_attr(mod: ModuleInfo, func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        dn = dotted_name(func)
+        if dn is None:
+            return None
+        real = mod.alias_to_module.get(dn.split(".")[0])
+        if real == "jax" or (real or "").startswith("jax."):
+            return func.attr
+    return None
+
+
+@register
+class HostSyncRule(Rule):
+    id = "host-sync"
+    summary = ("device->host syncs (.item()/float()/np.asarray/"
+               "block_until_ready/print) inside traced code")
+
+    def run(self, pkg: PackageModel) -> Iterator[Finding]:
+        for f in pkg.functions.values():
+            if f.traced_reason is None:
+                continue
+            mod = pkg.modules[f.module]
+            yield from self._check(f, mod)
+
+    def _check(self, f: FunctionInfo,
+               mod: ModuleInfo) -> Iterator[Finding]:
+        why = f" [traced: {f.traced_reason}]"
+        for node in iter_shallow(f.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = final_attr_name(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and name in _SYNC_METHODS:
+                yield Finding(
+                    rule=self.id, code=f"{name}-call", path=mod.key,
+                    line=node.lineno, col=node.col_offset,
+                    symbol=f.qualname,
+                    message=f".{name}() in traced code "
+                            f"{_SYNC_METHODS[name]}{why}")
+            elif isinstance(node.func, ast.Name) and name in _CASTS:
+                if node.args and not _is_static_expr(node.args[0]):
+                    yield Finding(
+                        rule=self.id, code="scalar-cast", path=mod.key,
+                        line=node.lineno, col=node.col_offset,
+                        symbol=f.qualname,
+                        message=f"{name}() on a (possibly traced) array "
+                                f"value syncs the host; use jnp ops or "
+                                f"hoist to the caller{why}")
+            elif name == "print" and isinstance(node.func, ast.Name):
+                yield Finding(
+                    rule=self.id, code="print", path=mod.key,
+                    line=node.lineno, col=node.col_offset,
+                    symbol=f.qualname,
+                    message="print() in traced code prints tracers at "
+                            "trace time — use jax.debug.print or "
+                            f"delete{why}")
+            else:
+                np_attr = _numpy_attr(mod, node.func)
+                if np_attr in {"asarray", "array", "copy"}:
+                    yield Finding(
+                        rule=self.id, code="np-convert", path=mod.key,
+                        line=node.lineno, col=node.col_offset,
+                        symbol=f.qualname,
+                        message=f"np.{np_attr}() in traced code pulls "
+                                f"the value to host (use jnp.{np_attr} "
+                                f"for trace-safe math){why}")
+                    continue
+                jax_attr = _jax_attr(mod, node.func)
+                if jax_attr in {"device_get", "device_put"}:
+                    yield Finding(
+                        rule=self.id, code="device-transfer",
+                        path=mod.key, line=node.lineno,
+                        col=node.col_offset, symbol=f.qualname,
+                        message=f"jax.{jax_attr}() inside traced code "
+                                f"is a host round-trip{why}")

@@ -1,0 +1,396 @@
+"""lock-discipline: what happens while a lock is held.
+
+The serving layer's documented discipline (docs/serving.md):
+
+* lock ORDER is fleet -> replica (``ServingFleet._lock`` before
+  ``ServingEngine._lock``); any path acquiring them in reverse can
+  deadlock against the monitor/driver threads;
+* spans, KV export/import and handoff callbacks run OUTSIDE the serving
+  lock — sink I/O or a multi-MB page copy under it stalls every
+  ``submit()``/``cancel()``/tick on the replica;
+* user callbacks (``on_token``/``on_handoff``/``on_retire``) never run
+  under a lock the caller's code can re-enter.
+
+The rule builds the package lock-acquisition graph: every
+``with <lock>:`` region, the blocking operations lexically inside it,
+and — transitively through the resolved call graph — the locks acquired
+and blocking calls made by functions invoked while the lock is held.
+Findings report the full call path so a human can audit the chain.
+
+Checks:
+* ``order-violation`` — an edge that contradicts the documented order;
+* ``lock-cycle`` — a cycle in the acquisition graph (undocumented
+  orders included: cycles deadlock regardless of documentation);
+* ``self-deadlock`` — re-acquiring a non-reentrant ``Lock`` you hold;
+* ``blocking-under-lock`` — sleep/join/wait, file or sink I/O,
+  ``device_put``/transfers, unbounded ``queue`` ops under a held lock;
+* ``callback-under-lock`` — invoking a user-supplied callback
+  (``on_*`` / ``*_callback`` attributes that resolve to no package
+  method) while holding a lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from ..findings import Finding
+from ..model import (PackageModel, FunctionInfo, ModuleInfo, LockRegion,
+                     final_attr_name, dotted_name, iter_shallow)
+from ..registry import Rule, register
+
+# Documented lock order, outermost first, matched by "Class.attr"
+# suffix so the rule also drives the fixture corpus. Source of truth:
+# docs/serving.md ("fleet -> replica").
+DOCUMENTED_LOCK_ORDER: Sequence[str] = (
+    "ServingFleet._lock",
+    "ServingEngine._lock",
+)
+
+_CALLBACK_NAME = re.compile(r"^_?on_[a-z0-9_]+$|_callback$|^callback$")
+
+_BLOCKING_SIMPLE = {
+    "sleep": "time.sleep",
+    "fsync": "os.fsync",
+    "system": "os.system",
+}
+_DEVICE_CALLS = {"device_put", "device_get", "block_until_ready"}
+_IO_RECEIVER_HINT = re.compile(
+    r"(^|_)(sink|file|fh|fp|stream|writer|sock|socket)s?$")
+_MAX_DEPTH = 4
+
+
+def _lock_display(key: str) -> str:
+    return key.split("::")[-1]
+
+
+class _Summary:
+    """Per-function facts the transitive walk composes."""
+
+    def __init__(self) -> None:
+        # (node, code, description) lexically in the function body but
+        # OUTSIDE any nested with-lock (those are charged to the inner
+        # region's holder)
+        self.blocking: List[Tuple[ast.AST, str, str]] = []
+        self.callbacks: List[Tuple[ast.AST, str]] = []
+        self.locks: List[LockRegion] = []
+
+
+@register
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    summary = ("lock-order cycles vs the documented fleet->replica "
+               "order; blocking calls and user callbacks under a held "
+               "lock")
+
+    def run(self, pkg: PackageModel) -> Iterator[Finding]:
+        self.pkg = pkg
+        summaries: Dict[str, _Summary] = {}
+        for f in pkg.functions.values():
+            summaries[f.key] = self._summarize(f)
+        # per-region findings + edge collection
+        edges: Dict[Tuple[str, str], Tuple[FunctionInfo, ast.AST, str]] = {}
+        for f in pkg.functions.values():
+            for region in f.lock_regions:
+                yield from self._check_region(f, region, summaries,
+                                              edges)
+        yield from self._check_graph(edges)
+
+    # -- summaries ------------------------------------------------------
+    def _summarize(self, f: FunctionInfo) -> _Summary:
+        s = _Summary()
+        s.locks = list(f.lock_regions)
+        mod = self.pkg.modules[f.module]
+        lock_nodes = {id(r.with_node) for r in f.lock_regions}
+
+        def walk(node: ast.AST, under_lock: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda,
+                                      ast.ClassDef)):
+                    continue
+                inner = under_lock or id(child) in lock_nodes
+                if isinstance(child, ast.Call) and not inner:
+                    hit = self._classify_blocking(child, f, mod)
+                    if hit:
+                        s.blocking.append((child,) + hit)
+                    cb = self._classify_callback(child, f)
+                    if cb:
+                        s.callbacks.append((child, cb))
+                walk(child, inner)
+
+        walk(f.node, False)
+        return s
+
+    def _classify_blocking(self, call: ast.Call, f: FunctionInfo,
+                           mod: ModuleInfo
+                           ) -> Optional[Tuple[str, str]]:
+        func = call.func
+        name = final_attr_name(func)
+        if name is None:
+            return None
+        if isinstance(func, ast.Name):
+            if name == "open":
+                return ("file-io", "open()")
+            imp = mod.name_imports.get(name)
+            if imp and imp[0].lstrip(".") == "time" and imp[1] == "sleep":
+                return ("sleep", "time.sleep()")
+            return None
+        # attribute calls ------------------------------------------------
+        recv = func.value
+        recv_name = final_attr_name(recv) or ""
+        dn = dotted_name(func) or ""
+        head = dn.split(".")[0] if dn else ""
+        real = mod.alias_to_module.get(head, "")
+        if name in _BLOCKING_SIMPLE and real in {"time", "os"}:
+            return ("sleep" if name == "sleep" else "file-io",
+                    f"{_BLOCKING_SIMPLE[name]}()")
+        if real == "subprocess" or real.startswith("subprocess."):
+            return ("subprocess", f"subprocess.{name}()")
+        if name in _DEVICE_CALLS:
+            return ("device-transfer", f".{name}() (device round-trip)")
+        if name == "join" and not isinstance(recv, ast.Constant) \
+                and not (isinstance(recv, ast.Name)
+                         and recv.id in {"sep", "delim"}):
+            # "x".join(...) has a Constant receiver; thread/process
+            # joins have names. String vars named like containers still
+            # slip through — suppress those with a reason.
+            if isinstance(recv, (ast.Name, ast.Attribute)) \
+                    and not recv_name.startswith(("str", "text")):
+                return ("join", f"{recv_name or '<expr>'}.join()")
+            return None
+        if name == "wait":
+            return ("wait", f"{recv_name or '<expr>'}.wait()")
+        if name in {"write", "flush", "dump"} \
+                and _IO_RECEIVER_HINT.search(recv_name):
+            return ("file-io", f"{recv_name}.{name}()")
+        if name in {"dump", "save"} and real in {"json", "pickle",
+                                                 "numpy"}:
+            return ("file-io", f"{head}.{name}()")
+        if name in {"put", "get"} and self._is_queue_recv(recv, f):
+            if not any(kw.arg in {"timeout", "block"}
+                       for kw in call.keywords):
+                return ("queue-op",
+                        f"unbounded {recv_name or 'queue'}.{name}()")
+        return None
+
+    def _is_queue_recv(self, recv: ast.AST, f: FunctionInfo) -> bool:
+        """Receiver known to be a queue.Queue: an attr annotated/assigned
+        Queue, or a name containing 'queue'/'_q'. dicts also have .get —
+        never treat plain names without the hint as queues."""
+        rn = (final_attr_name(recv) or "").lower()
+        if rn in {"q", "queue"} or rn.endswith(("_q", "_queue")) \
+                or rn.startswith("queue_"):
+            # exclude the serving request *list* named _queue: list.append
+            # etc. never reach here (only put/get do), and a list named
+            # _queue has no put/get — safe.
+            return True
+        if isinstance(recv, ast.Attribute) and f.class_key:
+            cls = self.pkg.classes[f.class_key]
+            return cls.attr_types.get(recv.attr) == "Queue"
+        return False
+
+    def _classify_callback(self, call: ast.Call,
+                           f: FunctionInfo) -> Optional[str]:
+        name = final_attr_name(call.func)
+        if name is None or not _CALLBACK_NAME.search(name):
+            return None
+        # a name that is a method of ANY package class (router.on_join,
+        # the fleet's _on_handoff) is framework code, not a
+        # caller-supplied callback — user callbacks (on_token) have no
+        # definition inside the package
+        if self.pkg.method_index.get(name):
+            return None
+        for site in f.calls:
+            if site.node is call and site.targets:
+                return None
+        return name
+
+    # -- region checks --------------------------------------------------
+    def _check_region(self, f: FunctionInfo, region: LockRegion,
+                      summaries: Dict[str, _Summary],
+                      edges) -> Iterator[Finding]:
+        mod = self.pkg.modules[f.module]
+        held = region.lock_key
+
+        # direct hits inside this with-block
+        for node in iter_shallow(region.with_node):
+            if isinstance(node, ast.Call):
+                hit = self._classify_blocking(node, f, mod)
+                if hit:
+                    code, desc = hit
+                    yield Finding(
+                        rule=self.id, code="blocking-under-lock",
+                        path=mod.key, line=node.lineno,
+                        col=node.col_offset, symbol=f.qualname,
+                        message=f"{desc} while holding "
+                                f"{_lock_display(held)} ({code}) — "
+                                f"move it outside the lock")
+                cb = self._classify_callback(node, f)
+                if cb:
+                    yield Finding(
+                        rule=self.id, code="callback-under-lock",
+                        path=mod.key, line=node.lineno,
+                        col=node.col_offset, symbol=f.qualname,
+                        message=f"user callback {cb}() invoked while "
+                                f"holding {_lock_display(held)} — "
+                                f"caller code under our lock can "
+                                f"re-enter or block the "
+                                f"driver; defer it past the release")
+            elif isinstance(node, ast.With) and node is not region.with_node:
+                for item in node.items:
+                    inner_key = self._region_key_of(f, node)
+                    if inner_key and inner_key != held:
+                        edges.setdefault(
+                            (held, inner_key),
+                            (f, node, f"{f.qualname} (direct)"))
+                    break
+
+        # transitive: calls made while the lock is held
+        for site_node, path, target in self._calls_under(
+                f, region, summaries):
+            tsum = summaries.get(target)
+            tf = self.pkg.functions.get(target)
+            if tsum is None or tf is None:
+                continue
+            for r2 in tsum.locks:
+                if r2.lock_key != held:
+                    edges.setdefault(
+                        (held, r2.lock_key),
+                        (f, site_node, " -> ".join(path)))
+                elif self._lock_ctor(held) == "Lock":
+                    yield Finding(
+                        rule=self.id, code="self-deadlock",
+                        path=mod.key, line=site_node.lineno,
+                        col=site_node.col_offset, symbol=f.qualname,
+                        message=f"re-acquires non-reentrant "
+                                f"{_lock_display(held)} already held "
+                                f"(via {' -> '.join(path)}) — "
+                                f"deadlock; use RLock or split the "
+                                f"locked helper")
+            for bnode, code, desc in tsum.blocking:
+                yield Finding(
+                    rule=self.id, code="blocking-under-lock",
+                    path=mod.key, line=site_node.lineno,
+                    col=site_node.col_offset, symbol=f.qualname,
+                    message=f"{desc} at {self.pkg.functions[target].module}"
+                            f":{bnode.lineno} runs while "
+                            f"{_lock_display(held)} is held "
+                            f"(via {' -> '.join(path)}) — {code}")
+            for cnode, cb in tsum.callbacks:
+                yield Finding(
+                    rule=self.id, code="callback-under-lock",
+                    path=mod.key, line=site_node.lineno,
+                    col=site_node.col_offset, symbol=f.qualname,
+                    message=f"user callback {cb}() (in "
+                            f"{self.pkg.functions[target].qualname}) "
+                            f"runs while {_lock_display(held)} is held "
+                            f"(via {' -> '.join(path)})")
+
+    def _region_key_of(self, f: FunctionInfo,
+                       with_node: ast.With) -> Optional[str]:
+        for r in f.lock_regions:
+            if r.with_node is with_node:
+                return r.lock_key
+        return None
+
+    def _calls_under(self, f: FunctionInfo, region: LockRegion,
+                     summaries: Dict[str, _Summary]
+                     ) -> Iterator[Tuple[ast.AST, List[str], str]]:
+        """(site node, human path, target key) for every package
+        function reachable from inside the with-block, depth-limited."""
+        call_nodes = {id(n) for n in iter_shallow(region.with_node)
+                      if isinstance(n, ast.Call)}
+        start: List[Tuple[ast.AST, str]] = []
+        for site in f.calls:
+            if id(site.node) in call_nodes:
+                for t in site.targets:
+                    start.append((site.node, t))
+        seen: Set[str] = {f.key}
+        frontier = [(node, [self.pkg.functions[t].qualname], t)
+                    for node, t in start if t in self.pkg.functions]
+        depth = 0
+        while frontier and depth < _MAX_DEPTH:
+            nxt = []
+            for node, path, t in frontier:
+                if t in seen:
+                    continue
+                seen.add(t)
+                yield node, path, t
+                tf = self.pkg.functions[t]
+                for site in tf.calls:
+                    for t2 in site.targets:
+                        if t2 not in seen and t2 in self.pkg.functions:
+                            nxt.append(
+                                (node,
+                                 path + [self.pkg.functions[t2].qualname],
+                                 t2))
+            frontier = nxt
+            depth += 1
+
+    # -- graph checks ---------------------------------------------------
+    def _lock_ctor(self, lock_key: str) -> Optional[str]:
+        if "::" not in lock_key:
+            return None
+        left, attr = lock_key.rsplit(".", 1)
+        cls = self.pkg.classes.get(left)
+        if cls is not None:
+            return cls.lock_attrs.get(attr)
+        mod_key, name = lock_key.split("::", 1)
+        mod = self.pkg.modules.get(mod_key)
+        if mod is not None:
+            return mod.module_locks.get(name)
+        return None
+
+    def _order_pos(self, lock_key: str) -> Optional[int]:
+        disp = _lock_display(lock_key)
+        for i, suffix in enumerate(DOCUMENTED_LOCK_ORDER):
+            if disp == suffix or disp.endswith("." + suffix):
+                return i
+        return None
+
+    def _check_graph(self, edges) -> Iterator[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        # documented order
+        for (a, b), (f, node, path) in sorted(
+                edges.items(), key=lambda kv: (kv[1][0].module,
+                                               kv[1][1].lineno)):
+            pa, pb = self._order_pos(a), self._order_pos(b)
+            if pa is not None and pb is not None and pb < pa:
+                yield Finding(
+                    rule=self.id, code="order-violation",
+                    path=self.pkg.functions[f.key].module,
+                    line=node.lineno, col=node.col_offset,
+                    symbol=f.qualname,
+                    message=f"acquires {_lock_display(b)} while holding "
+                            f"{_lock_display(a)} (via {path}) — "
+                            f"documented order is "
+                            f"{' -> '.join(DOCUMENTED_LOCK_ORDER)}")
+        # cycles (DFS)
+        reported: Set[frozenset] = set()
+        for start in sorted(graph):
+            stack = [(start, [start])]
+            while stack:
+                cur, path = stack.pop()
+                for nxt in sorted(graph.get(cur, ())):
+                    if nxt == start and len(path) > 1:
+                        key = frozenset(path)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        f, node, epath = edges[(path[0], path[1])]
+                        cyc = " -> ".join(_lock_display(p)
+                                          for p in path + [start])
+                        yield Finding(
+                            rule=self.id, code="lock-cycle",
+                            path=self.pkg.functions[f.key].module,
+                            line=node.lineno, col=node.col_offset,
+                            symbol=f.qualname,
+                            message=f"lock acquisition cycle {cyc} — "
+                                    f"two threads taking these in "
+                                    f"different orders deadlock")
+                    elif nxt not in path:
+                        stack.append((nxt, path + [nxt]))

@@ -1,0 +1,265 @@
+"""recompile-hazard: jit wrappers constructed or invoked in ways that
+defeat the trace cache.
+
+PR 4's contract is ONE trace per program (`_trace_counts`, the
+recompile guard): a second compile of a step program silently doubles
+step latency and poisons the one-compile telemetry. The classic ways to
+lose the cache without noticing:
+
+* ``jax.jit(...)`` constructed inside a loop — every iteration builds a
+  fresh wrapper with an empty cache;
+* ``jax.jit(f)(x)`` built per call inside a method — same wrapper
+  churn, one compile per invocation (fine at module import or in
+  ``__init__``, where it runs once);
+* unhashable (``list``/``dict``/``set``) literals passed for
+  ``static_argnums``/``static_argnames`` parameters — TypeError at best,
+  retrace-per-call via tuple conversion shims at worst;
+* DIFFERENT constant values at a static position across call sites —
+  each distinct value is its own trace-cache entry, and a per-call
+  varying one compiles forever.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..findings import Finding
+from ..model import (PackageModel, FunctionInfo, ModuleInfo,
+                     final_attr_name, iter_shallow)
+from ..registry import Rule, register
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The ``jax.jit(...)`` / ``partial(jax.jit, ...)`` Call, if any."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = final_attr_name(node.func)
+    if name in _JIT_NAMES:
+        return node
+    if name == "partial" and node.args \
+            and final_attr_name(node.args[0]) in _JIT_NAMES:
+        return node
+    return None
+
+
+def _walk_with_loops(node: ast.AST, depth: int = 0):
+    """Shallow walk yielding (node, loop_depth)."""
+    for child in ast.iter_child_nodes(node):
+        yield child, depth
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        d = depth + 1 if isinstance(child, (ast.For, ast.While)) else depth
+        yield from _walk_with_loops(child, d)
+
+
+def _static_params(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for v in _const_seq(kw.value):
+                if isinstance(v, int):
+                    nums.add(v)
+        elif kw.arg == "static_argnames":
+            for v in _const_seq(kw.value):
+                if isinstance(v, str):
+                    names.add(v)
+    return nums, names
+
+
+def _const_seq(node: ast.AST) -> List:
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant):
+                out.append(e.value)
+        return out
+    return []
+
+
+@register
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    summary = ("jit built in loops/per-call closures, unhashable or "
+               "per-call-varying static args")
+
+    def run(self, pkg: PackageModel) -> Iterator[Finding]:
+        for mod in pkg.modules.values():
+            yield from self._check_module(pkg, mod)
+
+    # -- per-function construction hazards ------------------------------
+    def _check_module(self, pkg: PackageModel,
+                      mod: ModuleInfo) -> Iterator[Finding]:
+        for fk in mod.functions:
+            f = pkg.functions[fk]
+            yield from self._check_function(f, mod)
+        yield from self._check_static_args(pkg, mod)
+
+    def _check_function(self, f: FunctionInfo,
+                        mod: ModuleInfo) -> Iterator[Finding]:
+        is_init = f.name == "__init__"
+        cached_ok = bool({"lru_cache", "cache", "cached_property"}
+                         & f.decorator_names)
+        # locals assigned a jit wrapper, to catch construct-then-call
+        jit_locals: Dict[str, ast.Call] = {}
+        called_names: Set[str] = set()
+        stored_names: Set[str] = set()   # cached on self/module/container
+        flagged: Set[int] = set()
+        for node, loop_depth in _walk_with_loops(f.node):
+            jc = _jit_call(node)
+            if jc is not None and loop_depth > 0 and id(jc) not in flagged:
+                flagged.add(id(jc))
+                yield Finding(
+                    rule=self.id, code="jit-in-loop", path=mod.key,
+                    line=node.lineno, col=node.col_offset,
+                    symbol=f.qualname,
+                    message="jax.jit constructed inside a loop: every "
+                            "iteration gets a fresh wrapper with an "
+                            "empty trace cache — hoist the wrapper out "
+                            "of the loop")
+                continue
+            if isinstance(node, ast.Call):
+                inner = _jit_call(node.func)
+                if inner is not None and id(inner) in flagged:
+                    inner = None
+                elif inner is not None:
+                    flagged.add(id(inner))
+                if inner is not None and not is_init and not cached_ok:
+                    yield Finding(
+                        rule=self.id, code="jit-per-call", path=mod.key,
+                        line=node.lineno, col=node.col_offset,
+                        symbol=f.qualname,
+                        message="jax.jit(f)(...) builds and discards "
+                                "the wrapper per call — compile once "
+                                "(module level, __init__, or a cached "
+                                "builder) and reuse it")
+                name = final_attr_name(node.func)
+                if isinstance(node.func, ast.Name) and name:
+                    called_names.add(name)
+            if isinstance(node, ast.Assign):
+                if len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    jc2 = _jit_call(node.value)
+                    if jc2 is not None:
+                        jit_locals[node.targets[0].id] = jc2
+                # ``self._fns[shape] = fn`` / ``self._fn = fn``: the
+                # wrapper is cached across calls — not a per-call build
+                if isinstance(node.value, ast.Name) and any(
+                        isinstance(t, (ast.Subscript, ast.Attribute))
+                        for t in node.targets):
+                    stored_names.add(node.value.id)
+        if not is_init and not cached_ok:
+            for name, jc in jit_locals.items():
+                if id(jc) in flagged or name in stored_names:
+                    continue
+                if name in called_names:
+                    yield Finding(
+                        rule=self.id, code="jit-per-call", path=mod.key,
+                        line=jc.lineno, col=jc.col_offset,
+                        symbol=f.qualname,
+                        message=f"`{name} = jax.jit(...)` is rebuilt on "
+                                f"every call to {f.name}() and then "
+                                f"invoked — each call recompiles; cache "
+                                f"the wrapper on self or at module "
+                                f"level")
+
+    # -- static-arg hazards at call sites -------------------------------
+    def _check_static_args(self, pkg: PackageModel,
+                           mod: ModuleInfo) -> Iterator[Finding]:
+        """Module-scope view: ``g = jax.jit(f, static_argnums=...)``
+        then calls ``g(...)`` in the same module."""
+        jitted: Dict[str, Tuple[Set[int], Set[str],
+                                Optional[ast.FunctionDef]]] = {}
+        # decorated defs
+        for fk in mod.functions:
+            f = pkg.functions[fk]
+            node = f.node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                jc = _jit_call(dec)
+                if jc is not None:
+                    jitted[f.name] = _static_params(jc) + (node,)
+        # module-level assignments
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                jc = _jit_call(stmt.value)
+                if jc is not None:
+                    wrapped = None
+                    if jc.args:
+                        first = (jc.args[1] if final_attr_name(jc.func)
+                                 == "partial" and len(jc.args) > 1
+                                 else jc.args[0])
+                        wname = final_attr_name(first)
+                        for fk in mod.functions:
+                            g = pkg.functions[fk]
+                            if g.name == wname and isinstance(
+                                    g.node, ast.FunctionDef):
+                                wrapped = g.node
+                                break
+                    jitted[stmt.targets[0].id] = \
+                        _static_params(jc) + (wrapped,)
+        if not jitted:
+            return
+        # observed constants per (callee, static position)
+        seen_consts: Dict[Tuple[str, str], Set] = {}
+        sites: Dict[Tuple[str, str], List[ast.Call]] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Name) \
+                    or node.func.id not in jitted:
+                continue
+            nums, names, wrapped = jitted[node.func.id]
+            if wrapped is not None:
+                params = [a.arg for a in wrapped.args.args]
+                names = names | {params[i] for i in nums
+                                 if i < len(params)}
+                nums = nums | {params.index(n) for n in names
+                               if n in params}
+            for i, arg in enumerate(node.args):
+                if i in nums:
+                    yield from self._static_site(
+                        mod, node, arg, node.func.id, f"arg {i}",
+                        seen_consts, sites)
+            for kw in node.keywords:
+                if kw.arg in names:
+                    yield from self._static_site(
+                        mod, node, kw.value, node.func.id,
+                        f"{kw.arg}=", seen_consts, sites)
+        for key, consts in seen_consts.items():
+            if len(consts) > 1:
+                first = sites[key][0]
+                callee, pos = key
+                yield Finding(
+                    rule=self.id, code="varying-static", path=mod.key,
+                    line=first.lineno, col=first.col_offset,
+                    symbol="<module>",
+                    message=f"static argument {pos} of jitted "
+                            f"`{callee}` receives {len(consts)} "
+                            f"different literal values across call "
+                            f"sites — each value is a separate "
+                            f"compile; make it a traced argument or a "
+                            f"single configuration constant")
+
+    def _static_site(self, mod, call, arg, callee, pos,
+                     seen_consts, sites) -> Iterator[Finding]:
+        if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+            kind = type(arg).__name__.lower()
+            yield Finding(
+                rule=self.id, code="unhashable-static", path=mod.key,
+                line=arg.lineno, col=arg.col_offset, symbol="<module>",
+                message=f"unhashable {kind} literal passed for static "
+                        f"argument {pos} of jitted `{callee}` — static "
+                        f"args must be hashable (use a tuple / "
+                        f"frozen config)")
+        elif isinstance(arg, ast.Constant):
+            seen_consts.setdefault((callee, pos), set()).add(arg.value)
+            sites.setdefault((callee, pos), []).append(call)

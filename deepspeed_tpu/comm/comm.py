@@ -231,6 +231,8 @@ def measure_comm_latencies(mesh=None, iters: int = 10) -> str:
 
     from jax.sharding import PartitionSpec as P
 
+    from ..parallel.mesh import shard_map_compat
+
     for op, sizes in list(log.records.items()):
         for size in list(sizes):
             axis = log.axes.get((op, size))
@@ -250,7 +252,7 @@ def measure_comm_latencies(mesh=None, iters: int = 10) -> str:
                     return x + 1e-30 * jnp.sum(y)  # data dep: no DCE/overlap
                 return jax.lax.fori_loop(0, iters, body, x)
 
-            spmd = jax.shard_map(replay, mesh=mesh, axis_names={axis},
+            spmd = shard_map_compat(replay, mesh=mesh, axis_names={axis},
                                  in_specs=P(axis), out_specs=P(axis),
                                  check_vma=False)
             run = jax.jit(lambda x: jnp.sum(spmd(x)))
@@ -414,7 +416,9 @@ def scatter(x, axis_name: str, src_index: int = 0, axis: int = 0):
     send, so the wire carries a broadcast; the recorded payload is the
     algorithmic per-member chunk (what a point-to-point scatter would
     move)."""
-    world = jax.lax.axis_size(axis_name)  # static inside shard_map
+    from ..parallel.mesh import collective_axis_size
+
+    world = collective_axis_size(axis_name)  # static inside shard_map
     if x.shape[axis] % world:
         raise ValueError(
             f"scatter: dim {axis} size {x.shape[axis]} not divisible by "

@@ -54,7 +54,7 @@ class DDIMSchedule:
         acp = jnp.asarray(self.alphas_cumprod, jnp.float32)
         step = self.num_train_timesteps // self.num_inference_steps
         prev = ts - step
-        final_alpha = 1.0 if self.set_alpha_to_one else float(
+        final_alpha = 1.0 if self.set_alpha_to_one else float(  # dslint: disable=host-sync -- alphas_cumprod is a host numpy table; this folds to a constant at trace time
             self.alphas_cumprod[0])
         alpha_t = acp[ts]
         alpha_prev = jnp.where(prev >= 0, acp[jnp.maximum(prev, 0)],
@@ -78,6 +78,7 @@ class StableDiffusionPipeline:
         self.schedule = schedule or DDIMSchedule()
         self.guidance_scale = guidance_scale
         self._sample = jax.jit(self._sample_impl, static_argnames=("shape",))
+        self._decode_fn = None   # lazily-jitted VAE decode (one trace)
 
     # -- one fully-compiled trajectory ---------------------------------
     def _sample_impl(self, unet_params, cond_ctx, uncond_ctx, rng, *,
@@ -122,4 +123,10 @@ class StableDiffusionPipeline:
                                   height, width)
         if self.vae is None or vae_params is None:
             return lat
-        return jax.jit(self.vae.decode)(vae_params, lat)
+        # cache the jitted decoder: jax.jit(self.vae.decode) binds a
+        # FRESH method object per call, so the wrapper (and its trace
+        # cache) would be rebuilt — one VAE recompile per generated
+        # image (dslint recompile-hazard)
+        if self._decode_fn is None:
+            self._decode_fn = jax.jit(self.vae.decode)
+        return self._decode_fn(vae_params, lat)

@@ -170,7 +170,7 @@ class InferenceEngine:
                 return {"__wq__": q, "s": s}
             return x
 
-        return jax.jit(
+        return jax.jit(  # dslint: disable=recompile-hazard -- one-shot weight quantization at engine construction
             lambda p: jax.tree_util.tree_map_with_path(leaf, p))(params)
 
     def _dequant_tree(self, params):

@@ -1374,16 +1374,11 @@ class RaggedInferenceEngine:
 
             in_specs = (hspec, pspec, pspec, P_(None, None), P_(None),
                         P_(None))
-            if hasattr(jax, "shard_map"):               # jax >= 0.5
-                mapped = jax.shard_map(
-                    local, mesh=self.topo.mesh, axis_names={"model"},
-                    in_specs=in_specs, out_specs=hspec, check_vma=False)
-            else:                                       # 0.4.x spelling
-                from jax.experimental.shard_map import shard_map
+            from ..parallel.mesh import shard_map_compat
 
-                mapped = shard_map(
-                    local, mesh=self.topo.mesh,
-                    in_specs=in_specs, out_specs=hspec, check_rep=False)
+            mapped = shard_map_compat(
+                local, mesh=self.topo.mesh, axis_names={"model"},
+                in_specs=in_specs, out_specs=hspec, check_vma=False)
             return mapped(q, kp, vp, tables, positions, slots)
 
         def norm(x, w, b=None):

@@ -200,7 +200,7 @@ def paged_attention(q, k_pool, v_pool, tables, positions, *,
     out = pl.pallas_call(
         functools.partial(_kernel, scale=scale, block=block, hkv=hkv,
                           group=group, ppc=ppc, num_scalars=len(scalars),
-                          window=int(window)),
+                          window=int(window)),  # dslint: disable=host-sync -- window is a static Python int kernel parameter, never a tracer
         out_shape=jax.ShapeDtypeStruct((T, hkv, group, hd), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import shard_map_compat
+
 # stage_fn(stage_params, x, consts, rng, valid) -> (y, aux_scalar)
 StageFn = Callable[[Any, jnp.ndarray, Any, jnp.ndarray, jnp.ndarray],
                    Tuple[jnp.ndarray, jnp.ndarray]]
@@ -115,7 +117,7 @@ def pipeline_apply(stage_fn: StageFn,
         aux = jax.lax.psum(aux_acc, axis) / jnp.maximum(n_mb, 1)
         return ys, aux
 
-    return jax.shard_map(
+    return shard_map_compat(
         spmd, mesh=mesh, axis_names={axis},
         in_specs=(P(axis), P(), P(), P()),
         out_specs=(P(), P()),

@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+from .mesh import collective_axis_size, shard_map_compat
 
 
 def _block_attn(q, k, v, q_off, k_off, causal, scale):
@@ -54,7 +55,7 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
                    scale: Optional[float] = None):
     """Call INSIDE shard_map. q/k/v: local shards [b, s/P, h, d] where the
     global sequence is contiguously sharded over ``axis_name``."""
-    P_ = jax.lax.axis_size(axis_name)
+    P_ = collective_axis_size(axis_name)   # 0.4.x: no jax.lax.axis_size
     my = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
@@ -97,5 +98,6 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, *, axis_name: str = "seq",
     def inner(q, k, v):
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
 
-    return shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+    return shard_map_compat(
+        inner, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False)(q, k, v)

@@ -22,9 +22,9 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
 from ..ops.attention import dot_product_attention
+from .mesh import collective_axis_size, shard_map_compat
 
 
 def _a2a(x, axis_name: str, split_axis: int, concat_axis: int):
@@ -52,7 +52,7 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
     # enough to scatter — numerics-identical, it's the GQA broadcast done
     # before the a2a instead of inside attention (reference Ulysses does
     # the same for GQA models, sequence/layer.py head-repeat path)
-    P_ = jax.lax.axis_size(axis_name)
+    P_ = collective_axis_size(axis_name)   # 0.4.x: no jax.lax.axis_size
     kvh = k.shape[2]
     if kvh % P_ != 0:
         r = P_ // math.gcd(kvh, P_)
@@ -101,5 +101,6 @@ class DistributedAttention:
                 attn_fn=partial(self.local_attn, causal=causal),
                 comm_dtype=self.comm_dtype)
 
-        return shard_map(inner, mesh=self.mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_rep=False)(q, k, v)
+        return shard_map_compat(
+            inner, mesh=self.mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False)(q, k, v)

@@ -106,7 +106,7 @@ class FlopsProfiler:
             self._params = count_params(params)
         flops = flops_of(fn, *args, **kwargs) or analytic_flops or 0.0
         self._flops = flops
-        jitted = jax.jit(fn)
+        jitted = jax.jit(fn)  # dslint: disable=recompile-hazard -- the profiler measures compile + first-run cost deliberately
         out = jitted(*args, **kwargs)
         jax.block_until_ready(out)
         for _ in range(max(warmup - 1, 0)):

@@ -82,7 +82,10 @@ def checkpoint_wrapper(fn: Callable, policy: Optional[str] = None,
         return fn
     offload = offload if offload is not None else _config.cpu_checkpointing
     if offload:
-        pol = jax.checkpoint_policies.offload_dot_products("device", "pinned_host") \
+        from .engine import host_memory_kind
+
+        pol = jax.checkpoint_policies.offload_dot_products(
+            "device", host_memory_kind()) \
             if hasattr(jax.checkpoint_policies, "offload_dot_products") else None
         return jax.checkpoint(fn, policy=pol)
     if policy not in _POLICIES:

@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.compressed import init_error_feedback, tree_onebit_allreduce
+from ..parallel.mesh import shard_map_compat
 from ..utils.logging import log_dist
 
 
@@ -112,7 +113,7 @@ class OnebitAdam:
                 params, m_new, v_new)
             return params_new, m_new, v_new, we, se, loss
 
-        fn = jax.shard_map(
+        fn = shard_map_compat(
             spmd, mesh=self.mesh, axis_names={axis},
             in_specs=(P(), P(), P(), P(axis), P(axis), P(axis), P()),
             out_specs=(P(), P(), P(), P(axis), P(axis), P()),
@@ -259,7 +260,7 @@ class ZeroOneAdam:
                     .astype(p.dtype), params_new)
             return params_new, m_new, v_new, we, se, loss
 
-        fn = jax.shard_map(
+        fn = shard_map_compat(
             spmd, mesh=self.mesh, axis_names={axis},
             in_specs=(P(), P(), P(), P(axis), P(axis), P(axis), P()),
             out_specs=(P(), P(), P(), P(axis), P(axis), P()),

@@ -591,7 +591,7 @@ class ServingFleet:
         while not self._stop_evt.wait(self.config.health_interval_s):
             try:
                 self.poll()
-            except Exception:
+            except Exception:  # dslint: disable=exception-discipline -- monitor-loop bug guard: a respawn/autoscale crash must not kill the fleet thread; typed faults are handled inside poll()
                 logger.exception("ServingFleet: monitor pass crashed")
 
     def _check_chaos(self) -> None:

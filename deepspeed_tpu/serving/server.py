@@ -502,7 +502,7 @@ class ServingEngine:
                 self._stuck_reported = False
                 self._in_tick = True
                 did_work = self._tick()
-            except Exception:
+            except Exception:  # dslint: disable=exception-discipline -- driver-loop bug guard: tick faults are handled INSIDE _tick; InjectedFault (BaseException) still crashes through
                 # a driver-loop bug must not silently wedge every caller
                 logger.exception("ServingEngine: driver tick crashed")
                 did_work = False
@@ -549,7 +549,23 @@ class ServingEngine:
             self._flush_spans()
             return True
         with self._lock:
-            handoffs = self._dispatch(uids, logits)
+            handoffs, emissions, finished = self._dispatch(uids, logits)
+        # user callbacks run OUTSIDE the serving lock (dslint
+        # lock-discipline): caller code under our lock could re-enter
+        # submit()/cancel() or stall every client of this replica.
+        # Ordering contract for stream(): tokens are delivered BEFORE
+        # the request turns terminal below, so the post-sentinel drain
+        # in stream_tokens() still sees every token.
+        for req, tok in emissions:
+            try:
+                req.on_token(tok)
+            except Exception:  # dslint: disable=exception-discipline -- user-callback isolation: a caller bug cancels only its own stream, never the tick
+                logger.exception(
+                    f"ServingEngine: on_token callback failed "
+                    f"(request {req.uid}); cancelling its stream")
+                req._cancel_requested = True
+        with self._lock:
+            self._finish(finished)
         self._export_handoffs(handoffs)
         self._flush_handoffs()
         self._flush_spans()
@@ -787,13 +803,21 @@ class ServingEngine:
                                  f"retries: {exc}")
                     self._retire(req, RequestState.CANCELLED)
 
-    def _dispatch(self, uids, logits: np.ndarray) -> List[Request]:
+    def _dispatch(self, uids, logits: np.ndarray
+                  ) -> Tuple[List[Request], List[Tuple[Request, int]],
+                             List[int]]:
         """Turn the tick's logits into emitted tokens, completions and
-        telemetry. Returns the requests leaving via the hand-off seam
-        (their KV export happens after the lock drops)."""
+        telemetry. Returns (handoff requests, (request, token) pairs for
+        ``on_token`` delivery, finished uids) — the KV exports, the user
+        callbacks and the FINISHED retirements all happen back in
+        ``_tick`` AFTER this lock-held pass: callbacks must not run
+        under the serving lock, and retirement must come after delivery
+        so ``stream()`` never sees a terminal request with undelivered
+        tokens."""
         now = time.perf_counter()
         finished: List[int] = []
         handoffs: List[Request] = []
+        emissions: List[Tuple[Request, int]] = []
         for row, uid in zip(logits, uids):
             req = self._live.get(uid)
             if req is None or np.isnan(row[0]):
@@ -806,13 +830,7 @@ class ServingEngine:
             req.tokens.append(tok)
             req._pending_token = tok
             if req.on_token is not None:
-                try:
-                    req.on_token(tok)
-                except Exception:
-                    logger.exception(
-                        f"ServingEngine: on_token callback failed "
-                        f"(request {req.uid}); cancelling its stream")
-                    req._cancel_requested = True
+                emissions.append((req, tok))
             if (len(req.tokens) >= req.max_new_tokens
                     or (req.eos_token_id is not None
                         and tok == req.eos_token_id)):
@@ -829,11 +847,19 @@ class ServingEngine:
                 self._requests.pop(uid, None)
                 self._handoffs_in_flight += 1
                 handoffs.append(req)
+        return handoffs, emissions, finished
+
+    def _finish(self, finished: List[int]) -> None:
+        """Retire this tick's completed requests (lock held; runs after
+        token delivery). Only the driver thread pops ``_live``, so the
+        uids are still present — the guard covers nothing but a
+        mid-close evacuate()."""
         for uid in finished:
-            req = self._live.pop(uid)
+            req = self._live.pop(uid, None)
+            if req is None:
+                continue
             self._engine.flush([uid])         # publishes into prefix cache
             self._retire(req, RequestState.FINISHED)
-        return handoffs
 
     # -- shared helpers --------------------------------------------------
     def _release_engine_state(self, uid: int, publish: bool) -> None:
@@ -877,7 +903,7 @@ class ServingEngine:
         for req, export in backlog:
             try:
                 self._on_handoff(req, export)
-            except Exception:
+            except Exception:  # dslint: disable=exception-discipline -- hand-off recovery IS the handler: the loss-free response to any callback failure is local re-queue
                 # the request's engine state is already released; the one
                 # recovery that loses nothing is re-queueing it here
                 logger.exception(
@@ -899,7 +925,7 @@ class ServingEngine:
             if self._on_retire is not None:
                 try:
                     self._on_retire(req)
-                except Exception:
+                except Exception:  # dslint: disable=exception-discipline -- callback isolation: fleet bookkeeping crash must not stop span emission for later requests
                     logger.exception(
                         f"ServingEngine: on_retire callback failed "
                         f"(request {req.uid})")

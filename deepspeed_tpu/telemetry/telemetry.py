@@ -196,8 +196,8 @@ class Telemetry:
             with self._requests_lock:
                 try:
                     if self._requests_sink is None:
-                        self._requests_sink = JsonlSink(self._requests_path)
-                    self._requests_sink.write(record)
+                        self._requests_sink = JsonlSink(self._requests_path)  # dslint: disable=lock-discipline -- _requests_lock is the dedicated sink mutex: it exists to serialize exactly this I/O and is never held together with serving/fleet locks
+                    self._requests_sink.write(record)  # dslint: disable=lock-discipline -- dedicated sink mutex (see line above); spans are already emitted outside the serving lock
                 except Exception as e:   # a broken sink must not kill serving
                     logger.warning(f"telemetry requests sink failed: {e}")
                     if self._requests_sink is None:

@@ -7,9 +7,20 @@
 # After the unit suite, the telemetry smoke test runs a tiny train loop with
 # telemetry enabled and validates every emitted JSONL step record against
 # the schema (scripts/telemetry_smoke.py exits nonzero on violation).
+# dslint gate (docs/static_analysis.md): the AST invariant checker must
+# report ZERO unsuppressed, un-baselined findings on the package —
+# host-sync/trace-hygiene in traced code, recompile hazards, lock
+# discipline (fleet -> replica, nothing blocking under a held lock) and
+# exception discipline. It prints its own findings-count summary line.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m deepspeed_tpu.analysis --check --baseline dslint_baseline.json
+dslint_rc=$?
+
+# -m "not slow" matches the tier-1 lane (ROADMAP.md): the slow-marked
+# autotuner grid searches would otherwise add minutes per run
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-    python -m pytest "${@:-tests/}" -q
+    python -m pytest "${@:-tests/}" -q -m "not slow"
 pytest_rc=$?
 
 smoke_rc=0
@@ -69,6 +80,9 @@ if [ "$#" -eq 0 ]; then
     fi
 fi
 
+if [ "$dslint_rc" -ne 0 ]; then
+    exit "$dslint_rc"
+fi
 if [ "$pytest_rc" -ne 0 ]; then
     exit "$pytest_rc"
 fi

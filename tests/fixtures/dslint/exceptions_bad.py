@@ -1,0 +1,33 @@
+"""dslint fixture: PLANTED exception-discipline violations.
+
+Function names put these in the tick/retry domain the rule guards.
+"""
+
+
+class Driver:
+    def tick(self):
+        try:
+            self._step()
+        except Exception:                 # PLANT: broad-except
+            pass
+
+    def retry_loop(self):
+        try:
+            self._step()
+        except:                           # PLANT: bare-except
+            pass
+
+    def drive(self):
+        try:
+            self._step()
+        except BaseException:             # PLANT: broad-baseexception
+            return None
+
+    def recover(self):
+        try:
+            self._step()
+        except InjectedFault:             # PLANT: caught-injected-fault
+            pass
+
+    def _step(self):
+        raise RuntimeError("boom")

@@ -1,0 +1,55 @@
+"""dslint fixture: near-miss TRUE NEGATIVES for exception-discipline."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class Driver:
+    def tick(self):
+        try:
+            self._step()
+        except TickFault:                 # narrower domain handler first
+            self._requeue()
+        except Exception:                 # ...makes the broad one fine
+            logger.exception("tick crashed")
+
+    def drive(self):
+        try:
+            self._step()
+        except Exception as e:
+            self._on_fault(e)             # hands the fault to recovery
+
+    def retry_loop(self):
+        try:
+            self._step()
+        except Exception:
+            raise                         # re-raise: not swallowing
+
+    def load_config(self):
+        # not a tick/retry path: defensive broad catch is allowed here
+        try:
+            return self._read()
+        except Exception:
+            return None
+
+    def bare_but_reraises(self):
+        try:
+            self._step()
+        except:                           # bare, but re-raises: fine
+            self._cleanup()
+            raise
+
+    def _step(self):
+        raise RuntimeError("boom")
+
+    def _requeue(self):
+        pass
+
+    def _on_fault(self, e):
+        pass
+
+    def _read(self):
+        return {}
+
+    def _cleanup(self):
+        pass

@@ -1,0 +1,35 @@
+"""dslint fixture: PLANTED host-sync violations (one per sub-check).
+
+Analyzed by tests/test_static_analysis.py only — never imported.
+"""
+import jax
+import numpy as np
+
+
+def _helper(y):
+    # not traced by itself, but `step` (traced) calls it -> transitive
+    return y.item()                       # PLANT: host-sync item-call
+
+
+@jax.jit
+def step(x):
+    y = x * 2
+    v = float(y)                          # PLANT: host-sync scalar-cast
+    print(y)                              # PLANT: host-sync print
+    z = np.asarray(y)                     # PLANT: host-sync np-convert
+    y.block_until_ready()                 # PLANT: host-sync block_until_ready
+    return _helper(y) + v + z
+
+
+def scan_driver(xs):
+    def body(carry, x):
+        return carry + x.item(), x        # PLANT: host-sync item-call (scan body)
+
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def _lambda_helper(y):
+    return float(y)                       # PLANT: host-sync scalar-cast (via jitted lambda)
+
+
+run_lambda = jax.jit(lambda x: _lambda_helper(x))

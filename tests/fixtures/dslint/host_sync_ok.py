@@ -1,0 +1,31 @@
+"""dslint fixture: near-miss TRUE NEGATIVES for host-sync.
+
+Every line here looks adjacent to a violation but is legitimate; the
+rule must stay silent on this whole file.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_fetch(y):
+    # NOT in the traced set: host orchestration converts freely
+    print(y)
+    return float(y), np.asarray(y), y.item()
+
+
+@jax.jit
+def step(x):
+    b = int(x.shape[0])                   # static shape cast: trace-time
+    flag = int(os.environ.get("DST_N", 4))  # env read: trace-time constant
+    n = int(len(x.shape))                 # len() of static: fine
+    return jnp.asarray(x) * b + flag + n  # jnp conversion is trace-safe
+
+
+def scan_driver(xs):
+    def body(carry, x):
+        return carry + jnp.sum(x), x      # pure math in the scan body
+
+    return jax.lax.scan(body, 0.0, xs)

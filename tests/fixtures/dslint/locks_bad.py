@@ -1,0 +1,67 @@
+"""dslint fixture: PLANTED lock-discipline violations.
+
+Class names deliberately shadow the real serving classes so the
+documented fleet -> replica order applies to the fixture too (the rule
+matches lock keys by "Class.attr" suffix).
+"""
+import queue
+import threading
+import time
+
+
+class ServingEngine:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._q = queue.Queue()
+
+    def tick(self, on_token=None):
+        with self._lock:
+            time.sleep(0.1)               # PLANT: blocking-under-lock (sleep)
+            on_token(1)                   # PLANT: callback-under-lock
+            self._q.put(1)                # PLANT: blocking-under-lock (queue)
+            self._emit()                  # PLANT: transitive file-io
+
+    def _emit(self):
+        with open("/tmp/x", "w") as fh:
+            fh.write("x")
+
+    def requeue(self, fleet):
+        with self._lock:
+            fleet.reroute(self)           # PLANT: order-violation
+                                          # (replica lock -> fleet lock)
+
+
+class ServingFleet:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def reroute(self, replica):
+        with self._lock:
+            pass
+
+
+class PoolA:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self, other):
+        with self._lock:
+            other.touch_b(self)           # PLANT: lock-cycle (A -> B)
+
+    def touch_a(self):
+        with self._lock:
+            pass
+
+    def locked_twice(self):
+        with self._lock:
+            self.touch_a()                # PLANT: self-deadlock (plain Lock)
+
+
+class PoolB:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def touch_b(self, a):
+        with self._lock:
+            a.touch_a()                   # closes the cycle (reported
+                                          # once, at the A -> B edge)

@@ -1,0 +1,42 @@
+"""dslint fixture: near-miss TRUE NEGATIVES for lock-discipline."""
+import queue
+import threading
+import time
+
+
+class ServingEngine:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._q = queue.Queue()
+        self._backlog = []
+
+    def tick(self, on_token=None):
+        with self._lock:
+            backlog, self._backlog = self._backlog, []
+            label = ", ".join(["a", "b"])   # str.join: not a thread join
+            self._q.put(label, timeout=1.0)  # bounded put: fine
+        for tok in backlog:
+            on_token(tok)                 # callback OUTSIDE the lock
+        time.sleep(0.01)                  # sleep outside the lock
+        self._emit(backlog)
+
+    def _emit(self, backlog):
+        with open("/tmp/x", "w") as fh:   # file I/O outside any lock
+            fh.write(str(len(backlog)))
+
+
+class ServingFleet:
+    def __init__(self, engine: ServingEngine):
+        self._lock = threading.RLock()
+        self.engine = engine
+
+    def route(self):
+        with self._lock:
+            # documented order fleet -> replica: correct direction
+            self.engine.enqueue()
+
+
+class EngineExt(ServingEngine):
+    def enqueue(self):
+        with self._lock:
+            self._backlog.append(1)

@@ -1,0 +1,24 @@
+"""dslint fixture: PLANTED recompile-hazard violations."""
+import jax
+import jax.numpy as jnp
+
+
+def run_many(xs):
+    for x in xs:
+        f = jax.jit(lambda v: v + 1)      # PLANT: jit-in-loop
+        f(x)
+
+
+class Engine:
+    def step(self, x):
+        return jax.jit(lambda v: v * 2)(x)   # PLANT: jit-per-call
+
+    def step_named(self, x):
+        fn = jax.jit(lambda v: v * 3)        # PLANT: jit-per-call (local)
+        return fn(x)
+
+
+g = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+a = g(jnp.ones(2), [1, 2])                # PLANT: unhashable-static
+b = g(jnp.ones(2), 3)                     # PLANT: varying-static (3 vs 4)
+c = g(jnp.ones(2), 4)

@@ -1,0 +1,29 @@
+"""dslint fixture: near-miss TRUE NEGATIVES for recompile-hazard."""
+import jax
+import jax.numpy as jnp
+
+
+class Engine:
+    def __init__(self):
+        # jit in __init__ runs once per object: fine
+        self._fn = jax.jit(lambda v: v + 1)
+        self._cache = {}
+        self._warm = jax.jit(lambda v: v * 0)(jnp.ones(1))
+
+    def step(self, x):
+        fn = self._cache.get(x.shape)
+        if fn is None:
+            fn = jax.jit(lambda v: v * 2)
+            self._cache[x.shape] = fn     # cached across calls: fine
+        return fn(x)
+
+    def build(self):
+        # builder idiom: constructs and RETURNS the wrapper (the caller
+        # caches it); never invoked here
+        return jax.jit(lambda v: v - 1)
+
+
+g2 = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+u = g2(jnp.ones(2), 3)
+v = g2(jnp.ones(3), 3)    # same static value at every call site: fine
+w = g2(jnp.ones(4), 3)

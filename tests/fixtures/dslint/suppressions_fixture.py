@@ -1,0 +1,47 @@
+"""dslint fixture: suppression parsing — valid, reasonless, unknown-rule,
+next-line and unused forms."""
+import jax
+
+
+@jax.jit
+def suppressed_ok(x):
+    print(x)  # dslint: disable=host-sync -- planted: exercising suppression parsing
+    return x
+
+
+@jax.jit
+def reasonless(x):
+    print(x)  # dslint: disable=host-sync
+    return x
+
+
+@jax.jit
+def next_line_form(x):
+    # dslint: disable-next-line=host-sync -- next-line form works too
+    print(x)
+    return x
+
+
+@jax.jit
+def unknown_rule(x):
+    print(x)  # dslint: disable=no-such-rule -- bogus rule id
+    return x
+
+
+def unused_suppression(x):
+    return x  # dslint: disable=host-sync -- nothing on this line fires
+
+
+@jax.jit
+def multi_rule(x):
+    import time
+    print(time.time())  # dslint: disable=host-sync,trace-hygiene -- two families fire on this one line
+    return x
+
+
+@jax.jit
+def multi_rule_partial(x):
+    # only host-sync fires here: the trace-hygiene half is dead and must
+    # be reported as unused (per-rule accounting)
+    print(x)  # dslint: disable=host-sync,trace-hygiene -- partially dead on purpose
+    return x

@@ -1,0 +1,20 @@
+"""dslint fixture: PLANTED trace-hygiene violations."""
+import time
+
+import jax
+import numpy as np
+
+_CALLS = 0
+
+
+class Layer:
+    def apply(self, registry, xs):
+        def body(carry, x):
+            global _CALLS                   # PLANT: global-stmt
+            t = time.time()                 # PLANT: wall-clock
+            n = np.random.randn()           # PLANT: np-random
+            self.calls = 1                  # PLANT: attr-mutation
+            registry.counter("steps").inc()  # PLANT: telemetry-call (.inc)
+            return carry + x + t + n, x
+
+        return jax.lax.scan(body, 0.0, xs)

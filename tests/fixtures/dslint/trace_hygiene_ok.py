@@ -1,0 +1,20 @@
+"""dslint fixture: near-miss TRUE NEGATIVES for trace-hygiene."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+class Layer:
+    def apply(self, registry, xs, key):
+        t0 = time.time()            # host side: timing around the trace
+        self.calls = 1              # host-side attribute bookkeeping
+
+        def body(carry, x):
+            local = {}              # local container mutation is fine
+            local["noise"] = jax.random.normal(key)   # jax RNG: traced
+            return carry + x + local["noise"], x
+
+        out = jax.lax.scan(body, 0.0, xs)
+        registry.counter("steps").inc()   # telemetry on the host: fine
+        return out, time.time() - t0

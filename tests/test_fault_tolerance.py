@@ -353,16 +353,12 @@ def _spmd_all_reduce(topo, fn):
     """One facade all_reduce inside shard_map (version-tolerant wrapper)."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        smap = jax.shard_map  # newer jax
-        kw = {"mesh": topo.mesh, "axis_names": {"data"},
-              "in_specs": P("data"), "out_specs": P(), "check_vma": False}
-    except AttributeError:
-        from jax.experimental.shard_map import shard_map as smap
+    from deepspeed_tpu.parallel.mesh import shard_map_compat
 
-        kw = {"mesh": topo.mesh, "in_specs": P("data"), "out_specs": P(),
-              "check_rep": False}
-    return jax.jit(smap(fn, **kw))(jnp.ones((8,), jnp.float32))
+    smapped = shard_map_compat(fn, mesh=topo.mesh, axis_names={"data"},
+                               in_specs=P("data"), out_specs=P(),
+                               check_vma=False)
+    return jax.jit(smapped)(jnp.ones((8,), jnp.float32))
 
 
 def test_collective_fail_injected_via_facade_hook(topo8):

@@ -315,8 +315,8 @@ def test_shard_places_whole_tree_correctly(topo8):
     batch = {"x": np.ones((16, 8), np.float32),
              "y": np.arange(16, dtype=np.int32)}
     placed = dl.shard(batch)
-    assert placed["x"].sharding.spec[0] == ("data",)  # batch dim over data
-    assert placed["y"].sharding.spec[0] == ("data",)
+    assert placed["x"].sharding.spec[0] == "data"  # batch dim over data
+    assert placed["y"].sharding.spec[0] == "data"
     assert np.array_equal(np.asarray(placed["x"]), batch["x"])
     assert np.array_equal(np.asarray(placed["y"]), batch["y"])
 
