@@ -153,6 +153,9 @@ class ClassInfo:
     attr_types: Dict[str, str] = field(default_factory=dict)
     #: attr name -> constructor name for threading primitives
     lock_attrs: Dict[str, str] = field(default_factory=dict)
+    #: attr name -> "Event" for threading.Event attributes (wall-clock
+    #: rule: raw ``event.wait`` bypasses the injectable clock seam)
+    event_attrs: Dict[str, str] = field(default_factory=dict)
     base_names: List[str] = field(default_factory=list)
 
 
@@ -374,6 +377,8 @@ class _Collector(ast.NodeVisitor):
             ctor = final_attr_name(value.func)
             if ctor in _LOCK_CONSTRUCTORS and self._is_threading(value.func):
                 cls.lock_attrs[attr] = ctor
+            elif ctor == "Event" and self._is_threading(value.func):
+                cls.event_attrs[attr] = ctor
             elif ctor and ctor[:1].isupper():
                 cls.attr_types.setdefault(attr, ctor)
         elif isinstance(value, ast.Name) and self.func_stack:

@@ -5,3 +5,4 @@ from . import trace_hygiene    # noqa: F401
 from . import recompile        # noqa: F401
 from . import locks            # noqa: F401
 from . import exceptions       # noqa: F401
+from . import wall_clock       # noqa: F401

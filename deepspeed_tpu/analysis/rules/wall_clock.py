@@ -1,0 +1,145 @@
+"""wall-clock: direct time access that bypasses the injectable clock
+seam.
+
+The serving / resilience / telemetry layers time everything — deadlines,
+backoffs, poll intervals, span timestamps — through
+:mod:`deepspeed_tpu.resilience.clock` (``get_clock()`` / an injected
+``Clock``), which is what makes the deterministic simulation harness
+(docs/dst.md) possible: a ``SimClock`` swaps in and the whole stack runs
+on virtual time. One stray ``time.perf_counter()`` or raw
+``Event.wait(timeout)`` re-couples the code to the host clock and
+silently breaks simulation determinism — exactly the class of regression
+that only shows up as an unreproducible soak flake months later.
+
+Checks (scope: modules under ``serving/``, ``resilience/`` and
+``telemetry/``; the clock module itself is exempt — it IS the seam):
+
+* ``direct-time`` — calls into ``time.*`` wall-clock/sleep functions or
+  ``datetime.now/utcnow/today``;
+* ``raw-event-wait`` — ``.wait(...)`` on a ``threading.Event`` (a
+  ``self._evt = threading.Event()`` attribute, or an inline
+  ``threading.Event().wait``): use ``clock.wait_event(evt, timeout)``.
+
+Deliberate wall-time sites (none ship today) take the usual
+suppression-with-reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from ..findings import Finding
+from ..model import (FunctionInfo, ModuleInfo, PackageModel,
+                     final_attr_name, iter_shallow)
+from ..registry import Rule, register
+
+#: modules whose timing must flow through the clock seam
+_SCOPE = re.compile(r"(^|/)(serving|resilience|telemetry)/")
+#: the seam itself: the only place wall time is allowed to live
+_EXEMPT_SUFFIX = "resilience/clock.py"
+
+_TIME_FUNCS = {"time", "time_ns", "monotonic", "monotonic_ns",
+               "perf_counter", "perf_counter_ns", "process_time",
+               "process_time_ns", "sleep"}
+_DATETIME_FUNCS = {"now", "utcnow", "today"}
+
+
+def _time_module_of(mod: ModuleInfo, func: ast.AST) -> Optional[str]:
+    """Resolve the real module behind ``alias.attr(...)`` or a
+    from-imported name (same alias-table walk as trace-hygiene)."""
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        head = func.value.id
+        real = mod.alias_to_module.get(head)
+        if real is None:
+            # ``from datetime import datetime`` then ``datetime.now()``:
+            # the head is a from-imported NAME, not a module alias
+            imp = mod.name_imports.get(head)
+            if imp:
+                real = imp[0].lstrip(".") + "." + imp[1]
+        return real
+    if isinstance(func, ast.Name):
+        imp = mod.name_imports.get(func.id)
+        if imp:
+            return imp[0].lstrip(".")
+    return None
+
+
+@register
+class WallClockRule(Rule):
+    id = "wall-clock"
+    summary = ("direct time.*/datetime-now calls or raw Event.wait in "
+               "serving/resilience/telemetry outside the clock seam")
+
+    def run(self, pkg: PackageModel) -> Iterator[Finding]:
+        for mod in pkg.modules.values():
+            if not _SCOPE.search(mod.key):
+                continue
+            if mod.key.endswith(_EXEMPT_SUFFIX):
+                continue
+            for f in pkg.functions_in(mod.key):
+                yield from self._check(pkg, f, mod)
+
+    def _check(self, pkg: PackageModel, f: FunctionInfo,
+               mod: ModuleInfo) -> Iterator[Finding]:
+        for node in iter_shallow(f.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = final_attr_name(node.func)
+            src_mod = _time_module_of(mod, node.func)
+            if src_mod == "time" and name in _TIME_FUNCS:
+                yield Finding(
+                    rule=self.id, code="direct-time", path=mod.key,
+                    line=node.lineno, col=node.col_offset,
+                    symbol=f.qualname,
+                    message=f"time.{name}() bypasses the injectable "
+                            f"clock seam — use get_clock()/self._clock "
+                            f"(resilience/clock.py) so simulation runs "
+                            f"stay on virtual time")
+            elif (src_mod in {"datetime", "datetime.datetime"}
+                    and name in _DATETIME_FUNCS):
+                yield Finding(
+                    rule=self.id, code="direct-time", path=mod.key,
+                    line=node.lineno, col=node.col_offset,
+                    symbol=f.qualname,
+                    message=f"datetime {name}() bypasses the injectable "
+                            f"clock seam — use get_clock().time()")
+            elif name == "wait" and isinstance(node.func, ast.Attribute):
+                if self._is_event_receiver(pkg, f, mod, node.func.value):
+                    yield Finding(
+                        rule=self.id, code="raw-event-wait", path=mod.key,
+                        line=node.lineno, col=node.col_offset,
+                        symbol=f.qualname,
+                        message="raw Event.wait() blocks on the host "
+                                "clock — use clock.wait_event(event, "
+                                "timeout) so a SimClock can pump "
+                                "virtual time instead")
+
+    def _is_event_receiver(self, pkg: PackageModel, f: FunctionInfo,
+                           mod: ModuleInfo, recv: ast.AST) -> bool:
+        # inline: threading.Event().wait(...)
+        if isinstance(recv, ast.Call):
+            ctor = final_attr_name(recv.func)
+            if ctor == "Event":
+                src = _time_module_of(mod, recv.func)
+                return src == "threading" or (
+                    isinstance(recv.func, ast.Name)
+                    and mod.name_imports.get(recv.func.id,
+                                             ("", ""))[0] == "threading")
+        # self._evt.wait(...): the attribute was assigned
+        # threading.Event() in this class (or a single-inheritance base)
+        if (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self" and f.class_key):
+            cls = pkg.classes.get(f.class_key)
+            seen = 0
+            while cls is not None and seen < 8:
+                if recv.attr in cls.event_attrs:
+                    return True
+                if recv.attr in cls.lock_attrs or recv.attr in cls.attr_types:
+                    return False
+                cls = (pkg.resolve_class(cls.base_names[0])
+                       if cls.base_names else None)
+                seen += 1
+        return False

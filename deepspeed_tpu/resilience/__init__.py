@@ -12,6 +12,14 @@ failure mode a seeded, deterministic event so the recovery paths stay
 tested (tests/test_fault_tolerance.py, scripts/chaos_smoke.py).
 """
 
+from .clock import (  # noqa: F401
+    Clock,
+    SimClock,
+    WallClock,
+    get_clock,
+    set_clock,
+    use_clock,
+)
 from .retry import RetryBudget, RetryError, RetryPolicy, retry_call  # noqa: F401
 from .preemption import PreemptionGuard  # noqa: F401
 from .divergence import DivergenceError, DivergenceGuard  # noqa: F401

@@ -41,10 +41,10 @@ import json
 import os
 import random
 import signal
-import time
 from typing import Any, Dict, Optional
 
 from ..utils.logging import logger
+from .clock import get_clock
 
 CHAOS_ENV = "DST_CHAOS"
 
@@ -241,7 +241,9 @@ class FaultInjector:
         if (self.collective_delay_s > 0 and self.collective_delay_every > 0
                 and n % self.collective_delay_every == 0):
             self._count(f"collective_delay/{op}")
-            time.sleep(self.collective_delay_s)
+            # through the injectable clock: under a SimClock the delay
+            # advances virtual time instead of stalling the soak host
+            get_clock().sleep(self.collective_delay_s)
         if op == self.collective_fail_op and n == self.collective_fail_at_call:
             self._count(f"collective_fail/{op}")
             raise CollectiveFault(f"collective_fail:{op}")

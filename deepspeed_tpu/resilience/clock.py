@@ -1,0 +1,220 @@
+"""The clock seam: every timing decision goes through an injectable clock.
+
+Wall-clock reads scattered through the serving / resilience / telemetry
+layers (``time.perf_counter`` deadlines, ``time.sleep`` backoffs, raw
+``Event.wait`` polls) are what make failure-handling untestable: a test
+either races real time (flaky) or sleeps through it (slow), and every
+evidence lane has to build jitter-tolerance bands around host noise.
+This module is the single seam that removes the problem at the root:
+
+* :class:`Clock` — the protocol every timing consumer uses: ``now()``
+  (monotonic seconds, the deadline/latency timebase), ``time()`` (epoch
+  seconds, the telemetry-timestamp timebase), ``sleep()``, and
+  ``wait_event()`` (the clocked replacement for ``threading.Event.wait``).
+* :class:`WallClock` — production behavior, byte-for-byte the calls the
+  code made before the seam existed.
+* :class:`SimClock` — a virtual-time event loop for deterministic
+  simulation testing (:mod:`.dst`): time advances only when the program
+  says so, timers fire in order at exact virtual instants, and blocking
+  waits *pump* a registered drive function instead of parking a thread.
+  Two runs of the same seeded schedule see bit-identical timestamps.
+
+Consumers hold a clock (constructor-injected, defaulting to
+:func:`get_clock`) or call :func:`get_clock` at use time. Tests install a
+``SimClock`` via :func:`set_clock` / :func:`use_clock`. The dslint
+``wall-clock`` rule enforces that no code in ``serving/``,
+``resilience/`` or ``telemetry/`` bypasses this seam (this module is the
+one exemption — it IS the seam).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Iterator, List, Optional, Tuple
+
+
+class Clock:
+    """Injectable time source + waiter (see module docstring)."""
+
+    def now(self) -> float:
+        """Monotonic seconds — the timebase for deadlines and latencies.
+        Only differences are meaningful."""
+        raise NotImplementedError
+
+    def time(self) -> float:
+        """Epoch seconds — the timebase for telemetry timestamps."""
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+    def deadline(self, timeout: float) -> float:
+        """Absolute ``now()``-based deadline ``timeout`` seconds out."""
+        return self.now() + timeout
+
+    def wait_event(self, event: threading.Event,
+                   timeout: Optional[float] = None) -> bool:
+        """Clocked ``event.wait``: True when the event is set before
+        ``timeout`` (clock) seconds elapse."""
+        raise NotImplementedError
+
+
+class WallClock(Clock):
+    """Production clock: real monotonic/epoch time, real sleeps."""
+
+    def now(self) -> float:
+        return time.perf_counter()
+
+    def time(self) -> float:
+        return time.time()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+    def wait_event(self, event: threading.Event,
+                   timeout: Optional[float] = None) -> bool:
+        return event.wait(timeout)
+
+
+class SimClock(Clock):
+    """Virtual-time event loop for deterministic simulation.
+
+    ``now()`` returns virtual seconds since construction; nothing moves
+    until :meth:`advance` (or a clocked ``sleep``/``wait_event``) is
+    called. :meth:`call_at` schedules callbacks on a timer heap; an
+    ``advance`` that crosses their due times fires them IN ORDER with
+    ``now()`` set to each timer's exact instant — so causality inside
+    the simulation is a pure function of the schedule, never of host
+    scheduling.
+
+    ``pump`` is the single-threaded substitute for background threads: a
+    drive function (e.g. ``fleet.step``) that blocking waits invoke while
+    virtual time passes. Re-entrant pumping is suppressed (a sleep inside
+    a pumped step only advances time) because the driven code — one
+    serving tick — is not re-entrant.
+
+    Virtual time is monotone by construction; :meth:`advance` rejects
+    negative deltas instead of silently rewinding history.
+    """
+
+    #: cap for ``wait_event(timeout=None)``: a simulated wait-forever on
+    #: an event nothing will ever set must terminate, not loop eternally
+    max_untimed_wait: float = 1e6
+
+    def __init__(self, start: float = 0.0,
+                 epoch: float = 1_700_000_000.0) -> None:
+        self._now = float(start)
+        self._epoch = float(epoch)
+        self._timers: List[Tuple[float, int, Callable[[], None]]] = []
+        self._timer_seq = itertools.count()
+        self.pump: Optional[Callable[[], object]] = None
+        self._pumping = False
+        #: total virtual seconds ever advanced (monotony audit surface)
+        self.ticks_fired = 0
+
+    # -- time -----------------------------------------------------------
+    def now(self) -> float:
+        return self._now
+
+    def time(self) -> float:
+        return self._epoch + self._now
+
+    def advance(self, seconds: float) -> None:
+        """Move virtual time forward, firing due timers in order."""
+        if seconds < 0:
+            raise ValueError(f"virtual time cannot rewind ({seconds})")
+        target = self._now + seconds
+        while self._timers and self._timers[0][0] <= target:
+            t, _, fn = heapq.heappop(self._timers)
+            self._now = max(self._now, t)   # exact due instant
+            fn()
+        self._now = target
+
+    def call_at(self, when: float, fn: Callable[[], None]) -> None:
+        """Schedule ``fn`` to fire when virtual time reaches ``when``."""
+        if when < self._now:
+            raise ValueError(
+                f"cannot schedule at {when}: virtual time is {self._now}")
+        heapq.heappush(self._timers, (float(when), next(self._timer_seq), fn))
+
+    # -- blocking surfaces ----------------------------------------------
+    #: sentinel distinguishing "no pump installed / re-entrant" from a
+    #: pump that ran and returned None
+    _NOT_PUMPED = object()
+    #: consecutive no-work pump rounds (pump returned False, no timers)
+    #: before a wait gives up and jumps to its limit — without this, a
+    #: wait_event(timeout=None) on an event nothing will set would grind
+    #: through ~max_untimed_wait pump iterations instead of failing fast
+    idle_pump_limit: int = 8
+
+    def _run_pump(self):
+        if self.pump is None or self._pumping:
+            return SimClock._NOT_PUMPED
+        self._pumping = True
+        try:
+            return self.pump()
+        finally:
+            self._pumping = False
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)
+        self._run_pump()
+
+    def wait_event(self, event: threading.Event,
+                   timeout: Optional[float] = None) -> bool:
+        limit = self._now + (timeout if timeout is not None
+                             else self.max_untimed_wait)
+        idle_rounds = 0
+        while not event.is_set() and self._now < limit:
+            result = self._run_pump()
+            if event.is_set():
+                break
+            if result is SimClock._NOT_PUMPED and not self._timers:
+                # nothing can change state: burn the wait in one jump
+                self._now = limit
+                break
+            if result is False and not self._timers:
+                # the pump explicitly reported no work (e.g. fleet.step
+                # when idle): after a few confirming rounds, stop
+                # grinding and burn the remaining wait in one jump
+                idle_rounds += 1
+                if idle_rounds >= self.idle_pump_limit:
+                    self._now = limit
+                    break
+            else:
+                idle_rounds = 0
+            self.advance(min(1.0, limit - self._now))
+        return event.is_set()
+
+
+# ----------------------------------------------------------------------
+_CLOCK: Clock = WallClock()
+
+
+def get_clock() -> Clock:
+    """The process-global clock (WallClock unless a test/sim installed
+    another)."""
+    return _CLOCK
+
+
+def set_clock(clock: Optional[Clock]) -> Clock:
+    """Install ``clock`` process-globally (None restores WallClock).
+    Returns the previously installed clock."""
+    global _CLOCK
+    prev = _CLOCK
+    _CLOCK = clock if clock is not None else WallClock()
+    return prev
+
+
+@contextlib.contextmanager
+def use_clock(clock: Clock) -> Iterator[Clock]:
+    """Scoped :func:`set_clock` — the simulation harness's entry seam."""
+    prev = set_clock(clock)
+    try:
+        yield clock
+    finally:
+        set_clock(prev)

@@ -1,0 +1,921 @@
+"""Deterministic simulation testing (DST) for the serving stack.
+
+A FoundationDB-style harness: the whole :class:`~deepspeed_tpu.serving.ServingFleet`
+— router, replicas, scheduler policies, failover, disaggregated
+hand-off, autoscaler — runs single-threaded under a virtual-time
+:class:`~.clock.SimClock`, driven tick by tick against a *seeded fault
+schedule* (request arrivals, cancellations, injected tick faults,
+replica deaths, preemption latches, scale events, load gaps). No real
+threads, no wall clock, no jitter: the entire execution is a pure
+function of the schedule, so
+
+* one CI run soaks hundreds of randomized schedules
+  (``scripts/dst_soak.py``);
+* every event is followed by an **invariant audit** (KV block-balance
+  partition, request state-machine legality, no-lost-request
+  conservation, span/SLO-ledger consistency, stream-delivery
+  completeness, monotone virtual time);
+* a failure reproduces from ``(seed)`` alone — and
+  :func:`shrink_schedule` delta-debugs the failing schedule down to a
+  minimal event list, emitted as a regression artifact;
+* the same seed produces a **bit-identical event-trace hash**, asserted
+  in tests/test_dst.py.
+
+The device is replaced by :class:`SimEngine` — a host-only model of the
+ragged engine's serving contract that *reuses the real*
+:class:`~deepspeed_tpu.inference.ragged.BlockedAllocator`,
+:class:`~deepspeed_tpu.inference.ragged.PrefixCache` and
+:class:`~deepspeed_tpu.inference.ragged.SequenceDescriptor`, so the
+block-balance audit exercises the actual refcount accounting the
+serving layer must keep balanced; only the model math is replaced by a
+deterministic next-token function of the context. Everything above the
+engine — ``serving/``, the schedulers, the fleet — is the real shipped
+code. See docs/dst.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..inference.ragged import (BlockedAllocator, PoolExhausted, PrefixCache,
+                                SequenceDescriptor, block_balance_report)
+from ..telemetry.registry import MetricsRegistry
+from ..telemetry.telemetry import Telemetry, set_telemetry
+from ..utils.logging import logger
+from .chaos import FaultInjector, TickFault, install_fault_injector
+from .clock import SimClock, use_clock
+
+__all__ = ["SimConfig", "SimEngine", "SimKVExport", "SimEvent", "Schedule",
+           "SimReport", "generate_schedule", "run_schedule",
+           "shrink_schedule", "dump_repro", "load_repro"]
+
+
+# ----------------------------------------------------------------------
+# the simulated engine
+# ----------------------------------------------------------------------
+
+@dataclass
+class SimConfig:
+    """Geometry of a :class:`SimEngine` — the same knobs as
+    :class:`~deepspeed_tpu.inference.ragged.RaggedConfig`, sized small so
+    slot/pool pressure is reachable within a short schedule."""
+
+    token_budget: int = 32
+    max_seqs: int = 4
+    kv_block_size: int = 4
+    n_kv_blocks: int = 40
+    max_context: int = 96
+    enable_prefix_cache: bool = True
+    vocab: int = 48
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
+@dataclass
+class SimKVExport:
+    """The simulation's stand-in for
+    :class:`~deepspeed_tpu.inference.ragged.KVExport`: same bookkeeping
+    fields and import-side validation, no page payload (there are no
+    pages to copy — the importer re-charges the allocator exactly like
+    the real importer does)."""
+
+    uid: int
+    tokens: List[int]
+    seen: int
+    prompt_len: int
+    kv_block_size: int
+    n_pages: int
+
+
+def _next_token(ctx: Sequence[int], vocab: int) -> int:
+    """The simulated model: a deterministic pure function of the full
+    context (FNV-1a fold), so preempt/failover/hand-off resumes are
+    bit-exact iff the serving layer reconstructs the context exactly."""
+    h = 2166136261
+    for t in ctx:
+        h = ((h ^ (int(t) & 0xFFFFFFFF)) * 16777619) & 0xFFFFFFFF
+    return h % vocab
+
+
+class SimEngine:
+    """Host-only ragged engine standing in for
+    :class:`~deepspeed_tpu.inference.ragged.RaggedInferenceEngine` under
+    the serving layer: identical serving-facing surface (``put`` /
+    ``flush`` / ``preempt`` / ``discard`` / ``clear_resume`` /
+    ``export_kv`` / ``import_kv`` / capacity queries) with the real
+    allocator + prefix-cache accounting and Dynamic-SplitFuse admission
+    semantics — tokens are admitted to descriptors BEFORE the pool
+    check, exactly like the device engine, so ``PoolExhausted`` recovery
+    retries with empty continuation chunks."""
+
+    def __init__(self, config: Optional[SimConfig] = None):
+        self.config = config if config is not None else SimConfig()
+        cfg = self.config
+        self.allocator = BlockedAllocator(cfg.n_kv_blocks)
+        self.prefix_cache = (PrefixCache(cfg.kv_block_size)
+                             if cfg.enable_prefix_cache else None)
+        self.seqs: Dict[int, SequenceDescriptor] = {}
+        self._free_slots: List[int] = list(range(cfg.max_seqs))
+        self._resume_uids: set = set()
+        self.tick_count = 0
+
+    # -- capacity queries (formulas identical to the ragged engine) -----
+    def _available_blocks(self) -> int:
+        free = self.allocator.free_blocks
+        if self.prefix_cache is not None:
+            free += self.prefix_cache.reclaimable_blocks(self.allocator)
+        return free
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.config.kv_block_size) + 1
+
+    def can_schedule(self, uids: Sequence[int],
+                     lengths: Sequence[int]) -> bool:
+        bs = self.config.kv_block_size
+        new = [u for u in uids if u not in self.seqs]
+        need_blocks = 0
+        for uid, length in zip(uids, lengths):
+            if uid in self.seqs:
+                seq = self.seqs[uid]
+                total = seq.seen + length
+                need_blocks += max(0, -(-total // bs) - len(seq.blocks))
+            else:
+                need_blocks += self.blocks_needed(length)
+        return (len(new) <= len(self._free_slots)
+                and need_blocks <= self._available_blocks())
+
+    def kv_occupancy(self) -> float:
+        return 1.0 - self.allocator.free_blocks / self.allocator.n_blocks
+
+    def kv_demand(self) -> float:
+        return 1.0 - self._available_blocks() / self.allocator.n_blocks
+
+    # -- lifecycle -------------------------------------------------------
+    def flush(self, uids: Sequence[int]) -> None:
+        for uid in uids:
+            seq = self.seqs.pop(uid, None)
+            if seq is not None:
+                if self.prefix_cache is not None:
+                    self.prefix_cache.publish(seq.tokens, seq.blocks,
+                                              seq.seen, self.allocator)
+                self.allocator.free(seq.blocks)
+                self._free_slots.append(seq.slot)
+
+    def preempt(self, uid: int) -> List[int]:
+        seq = self.seqs.get(uid)
+        if seq is None:
+            return []
+        toks = list(seq.tokens[:seq.seen])
+        self.flush([uid])
+        self._resume_uids.add(uid)
+        return toks
+
+    def discard(self, uid: int) -> None:
+        seq = self.seqs.pop(uid, None)
+        if seq is None:
+            return
+        self.allocator.free(seq.blocks)
+        self._free_slots.append(seq.slot)
+        self._resume_uids.add(uid)
+
+    def clear_resume(self, uid: int) -> None:
+        self._resume_uids.discard(uid)
+
+    # -- KV hand-off seam ------------------------------------------------
+    def export_kv(self, uid: int) -> SimKVExport:
+        seq = self.seqs.get(uid)
+        if seq is None:
+            raise KeyError(f"uid {uid} has no live sequence to export")
+        if seq.pending:
+            raise ValueError(f"uid {uid}: {seq.pending} tokens still "
+                             "pending prefill")
+        if seq.seen == 0 or not seq.blocks:
+            raise ValueError(f"uid {uid}: nothing prefilled yet")
+        return SimKVExport(uid=uid, tokens=list(seq.tokens), seen=seq.seen,
+                           prompt_len=seq.prompt_len,
+                           kv_block_size=self.config.kv_block_size,
+                           n_pages=len(seq.blocks))
+
+    def import_kv(self, uid: int, export: SimKVExport) -> None:
+        cfg = self.config
+        if uid in self.seqs:
+            raise ValueError(f"uid {uid} already live in this engine")
+        if export.kv_block_size != cfg.kv_block_size:
+            raise ValueError("KV geometry mismatch")
+        if export.seen != len(export.tokens):
+            raise ValueError(
+                f"export seen {export.seen} != tokens {len(export.tokens)}")
+        if export.seen > cfg.max_context:
+            raise ValueError("export context exceeds max_context")
+        need = -(-export.seen // cfg.kv_block_size)
+        if export.n_pages != need:
+            raise ValueError(
+                f"export carries {export.n_pages} pages for "
+                f"{export.seen} tokens")
+        if not self._free_slots:
+            raise RuntimeError("no free sequence slots; flush() first")
+        if need > self.allocator.free_blocks and self.prefix_cache is not None:
+            self.prefix_cache.evict_for(self.allocator, need)
+        blocks = self.allocator.allocate(need)    # may raise PoolExhausted
+        self.seqs[uid] = SequenceDescriptor(
+            uid=uid, slot=self._free_slots.pop(),
+            tokens=[int(t) for t in export.tokens], seen=int(export.seen),
+            blocks=blocks, t_admitted=None, t_created=None,
+            prompt_len=int(export.prompt_len))
+        self._resume_uids.discard(uid)
+
+    # -- the step --------------------------------------------------------
+    def put(self, uids: Sequence[int],
+            tokens: Sequence[Sequence[int]]) -> np.ndarray:
+        cfg = self.config
+        for uid, toks in zip(uids, tokens):
+            new = uid not in self.seqs
+            if new:
+                if not self._free_slots:
+                    raise RuntimeError("no free sequence slots; flush() first")
+                self._resume_uids.discard(uid)
+                self.seqs[uid] = SequenceDescriptor(
+                    uid=uid, slot=self._free_slots.pop())
+            seq = self.seqs[uid]
+            seq.tokens.extend(int(t) for t in toks)
+            if new:
+                seq.prompt_len = len(seq.tokens)
+                if self.prefix_cache is not None and seq.tokens:
+                    shared, blocks = self.prefix_cache.match(seq.tokens)
+                    if shared:
+                        self.allocator.retain(blocks)
+                        seq.blocks = list(blocks)
+                        seq.seen = shared
+        # Dynamic SplitFuse packing: shortest-pending first into the one
+        # token budget (same policy as the device engine)
+        sched: List[Tuple[SequenceDescriptor, int]] = []
+        budget = cfg.token_budget
+        pending = sorted((s for s in self.seqs.values() if s.pending > 0),
+                         key=lambda s: s.pending)
+        for seq in pending:
+            take = min(seq.pending, budget)
+            if take == 0:
+                break
+            sched.append((seq, take))
+            budget -= take
+        if not sched:
+            raise ValueError("put() called with no pending tokens")
+        needs = []
+        for seq, take in sched:
+            total = seq.seen + take
+            if total > cfg.max_context:
+                raise ValueError(
+                    f"uid {seq.uid}: context {total} exceeds max_context")
+            needs.append(max(0, -(-total // cfg.kv_block_size)
+                             - len(seq.blocks)))
+        need_total = sum(needs)
+        # whole-schedule pool check BEFORE any allocation, evicting cached
+        # prefixes first — an exhausted pool must leave every descriptor
+        # consistent (tokens admitted, seen unchanged) for the retry path
+        if (need_total > self.allocator.free_blocks
+                and self.prefix_cache is not None):
+            self.prefix_cache.evict_for(self.allocator, need_total)
+        if need_total > self.allocator.free_blocks:
+            raise PoolExhausted(
+                f"KV pool exhausted: need {need_total}, have "
+                f"{self.allocator.free_blocks}")
+        for (seq, take), n in zip(sched, needs):
+            if n:
+                seq.blocks.extend(self.allocator.allocate(n))
+            seq.seen += take
+        self.tick_count += 1
+        scheduled = {seq.uid for seq, _ in sched}
+        out = np.full((len(uids), cfg.vocab), np.nan, np.float32)
+        for i, uid in enumerate(uids):
+            seq = self.seqs[uid]
+            if seq.pending == 0 and uid in scheduled:
+                out[i] = 0.0
+                out[i, _next_token(seq.tokens, cfg.vocab)] = 1.0
+        return out
+
+
+# ----------------------------------------------------------------------
+# schedules
+# ----------------------------------------------------------------------
+
+@dataclass
+class SimEvent:
+    """One scheduled simulation event at virtual time ``t``. Kinds:
+
+    * ``submit`` — one request (``ix`` is its stable logical id);
+    * ``cancel`` — cancel submit ``target`` if still live;
+    * ``tick_fault`` — arm ``n`` injected :class:`TickFault` ticks;
+    * ``replica_death`` — kill the ``which``-th healthy replica;
+    * ``latch`` — trip the preemption guard (graceful drain);
+    * ``scale`` — ``fleet.scale_to(n)``;
+    * ``stall`` — advance virtual time by ``dt`` without ticking (a load
+      gap: queued deadlines keep running).
+    """
+
+    t: float
+    kind: str
+    payload: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"t": self.t, "kind": self.kind, **self.payload}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SimEvent":
+        d = dict(d)
+        return cls(t=float(d.pop("t")), kind=str(d.pop("kind")), payload=d)
+
+
+@dataclass
+class Schedule:
+    """A complete, replayable simulation input: configs + event list.
+    ``run_schedule(generate_schedule(seed))`` is a pure function — same
+    seed, same trace hash."""
+
+    seed: int
+    horizon: float
+    engine_cfg: Dict[str, Any]
+    fleet_cfg: Dict[str, Any]
+    serving_cfg: Dict[str, Any]
+    events: List[SimEvent]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"seed": self.seed, "horizon": self.horizon,
+                "engine_cfg": self.engine_cfg, "fleet_cfg": self.fleet_cfg,
+                "serving_cfg": self.serving_cfg,
+                "events": [e.to_dict() for e in self.events]}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Schedule":
+        return cls(seed=int(d["seed"]), horizon=float(d["horizon"]),
+                   engine_cfg=dict(d["engine_cfg"]),
+                   fleet_cfg=dict(d["fleet_cfg"]),
+                   serving_cfg=dict(d["serving_cfg"]),
+                   events=[SimEvent.from_dict(e) for e in d["events"]])
+
+    def replace_events(self, events: List[SimEvent]) -> "Schedule":
+        return Schedule(seed=self.seed, horizon=self.horizon,
+                        engine_cfg=dict(self.engine_cfg),
+                        fleet_cfg=dict(self.fleet_cfg),
+                        serving_cfg=dict(self.serving_cfg),
+                        events=list(events))
+
+
+def _event_order(e: SimEvent):
+    """Deterministic total order for schedule events (repr-keyed payload
+    tie-break: payload values are mixed types, so direct comparison
+    could raise)."""
+    return (e.t, e.kind, sorted(map(repr, e.payload.items())))
+
+
+def generate_schedule(seed: int) -> Schedule:
+    """Expand a seed into a randomized fault schedule: fleet/serving
+    config draws plus a time-ordered event list composing the existing
+    injectors (tick faults, replica death, preemption latch) with
+    request traffic sized to hit slot/KV pressure, deadline expiry,
+    rejection and cancellation paths."""
+    import random
+
+    rng = random.Random(seed)
+    engine_cfg = SimConfig().to_dict()
+    disaggregated = rng.random() < 0.20
+    replicas = rng.randint(1, 3)
+    fleet_cfg: Dict[str, Any] = {
+        "replicas": replicas,
+        "router": rng.choice(["least_loaded", "prefix_affinity"]),
+        "failover": True,
+        "respawn": rng.random() < 0.5,
+        "autoscale": rng.random() < 0.25,
+        "autoscale_interval_s": 4.0,
+        "min_replicas": 1,
+        "max_replicas": 4,
+    }
+    if disaggregated:
+        fleet_cfg.update(disaggregated=True, prefill_replicas=1,
+                         replicas=max(1, replicas - 1))
+    serving_cfg: Dict[str, Any] = {
+        "policy": "slo" if rng.random() < 0.8 else "fcfs",
+        "max_queue": rng.choice([4, 8, 32]),
+        "tick_retry_limit": rng.randint(0, 2),
+        "reserve_output_blocks": rng.random() < 0.7,
+        "kv_pressure": rng.choice([0.5, 0.8, 0.9]),
+        "stuck_tick_timeout_s": 0.0,      # no watchdog thread in the sim
+        "drain_timeout_s": 600.0,
+        # drain loops sleep this long per pump step; the default 2ms
+        # would take 60k pumped fleet steps to burn a virtual timeout
+        "poll_interval_s": 0.25,
+    }
+    horizon = float(rng.randint(30, 70))
+    vocab = engine_cfg["vocab"]
+    events: List[SimEvent] = []
+    n_req = rng.randint(6, 16)
+    # a few shared prefixes so prefix-cache adoption + affinity routing
+    # actually trigger
+    prefixes = [[rng.randrange(1, vocab) for _ in range(8)]
+                for _ in range(2)]
+    for ix in range(n_req):
+        t = round(rng.uniform(0.0, horizon * 0.6), 3)
+        if rng.random() < 0.3:
+            prompt = list(rng.choice(prefixes)) + [
+                rng.randrange(1, vocab) for _ in range(rng.randint(1, 4))]
+        else:
+            prompt = [rng.randrange(1, vocab)
+                      for _ in range(rng.randint(3, 14))]
+        payload: Dict[str, Any] = {
+            "ix": ix, "prompt": prompt,
+            "max_new": rng.randint(1, 12),
+            "priority": rng.randint(0, 2),
+        }
+        if rng.random() < 0.5:
+            payload["deadline"] = round(rng.uniform(4.0, 40.0), 3)
+        if rng.random() < 0.3:
+            payload["ttft_deadline"] = round(rng.uniform(2.0, 12.0), 3)
+        if rng.random() < 0.2:
+            payload["eos"] = rng.randrange(0, vocab)
+        if rng.random() < 0.06:
+            # hopeless geometry: exercises the up-front reject paths
+            payload["max_new"] = engine_cfg["max_context"] * 2
+        events.append(SimEvent(t=t, kind="submit", payload=payload))
+        if rng.random() < 0.15:
+            events.append(SimEvent(
+                t=round(t + rng.uniform(0.5, 10.0), 3), kind="cancel",
+                payload={"target": ix}))
+    for _ in range(rng.randint(0, 3)):
+        events.append(SimEvent(t=round(rng.uniform(1.0, horizon * 0.7), 3),
+                               kind="tick_fault",
+                               payload={"n": rng.randint(1, 2)}))
+    for _ in range(rng.randint(0, 2) if replicas > 1 or fleet_cfg["respawn"]
+                   else 0):
+        events.append(SimEvent(t=round(rng.uniform(2.0, horizon * 0.8), 3),
+                               kind="replica_death",
+                               payload={"which": rng.randint(0, 3)}))
+    if rng.random() < 0.10:
+        events.append(SimEvent(t=round(rng.uniform(horizon * 0.5,
+                                                   horizon * 0.9), 3),
+                               kind="latch", payload={}))
+    if not disaggregated and rng.random() < 0.3:
+        for _ in range(rng.randint(1, 2)):
+            events.append(SimEvent(
+                t=round(rng.uniform(2.0, horizon * 0.8), 3), kind="scale",
+                payload={"n": rng.randint(1, 3)}))
+    if rng.random() < 0.25:
+        events.append(SimEvent(t=round(rng.uniform(1.0, horizon * 0.6), 3),
+                               kind="stall",
+                               payload={"dt": round(rng.uniform(3.0,
+                                                                20.0), 3)}))
+    events.sort(key=_event_order)
+    return Schedule(seed=seed, horizon=horizon, engine_cfg=engine_cfg,
+                    fleet_cfg=fleet_cfg, serving_cfg=serving_cfg,
+                    events=events)
+
+
+# ----------------------------------------------------------------------
+# harness internals
+# ----------------------------------------------------------------------
+
+class _ScheduledFaultInjector(FaultInjector):
+    """The soak's tick-fault arm: schedule events arm N failures, the
+    next N serving ticks (fleet-wide) raise :class:`TickFault` through
+    the production injector hook."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._armed = 0
+
+    def arm(self, n: int) -> None:
+        self._armed += int(n)
+
+    def on_serving_tick(self, tick: int) -> None:
+        if self._armed > 0:
+            self._armed -= 1
+            self._count("serving_tick_fail")
+            raise TickFault(f"dst: injected serving tick fault (tick {tick})")
+
+
+class _CaptureTelemetry(Telemetry):
+    """Enabled telemetry with a fresh registry and an in-memory span
+    capture instead of file sinks — the auditor's ledger view."""
+
+    def __init__(self) -> None:
+        super().__init__(config=None, registry=MetricsRegistry())
+        self.enabled = True
+        self.spans: List[Any] = []
+
+    def record_request_span(self, stats):
+        record = super().record_request_span(stats)
+        self.spans.append(stats)
+        return record
+
+
+class _SimGuard:
+    """Preemption-latch stand-in (the production guard is signal-bound)."""
+
+    def __init__(self) -> None:
+        self.should_stop = False
+
+
+@dataclass
+class _Tracked:
+    """Harness-side bookkeeping for one submitted request."""
+
+    ix: int
+    req: Any
+    delivered: List[int] = field(default_factory=list)
+
+
+class _Trace:
+    """Canonical event trace; its hash is the determinism witness."""
+
+    def __init__(self) -> None:
+        self.rows: List[tuple] = []
+
+    def event(self, vt: float, kind: str, payload: Dict[str, Any]) -> None:
+        canon = tuple(sorted((k, self._c(v)) for k, v in payload.items()))
+        self.rows.append(("E", round(vt, 6), kind, canon))
+
+    def tick(self, n: int, vt: float, fleet, tracked: List[_Tracked]) -> None:
+        reps = tuple((r.name, r.state, r.serving._tick_count,
+                      len(r.serving._queue), len(r.serving._live),
+                      r.serving.pending_work)
+                     for r in fleet.replicas)
+        states: Dict[str, int] = {}
+        total_tokens = 0
+        for t in tracked:
+            states[t.req.state.value] = states.get(t.req.state.value, 0) + 1
+            total_tokens += len(t.req.tokens)
+        self.rows.append(("T", n, round(vt, 6), reps,
+                          tuple(sorted(states.items())), total_tokens))
+
+    def finish(self, tracked: List[_Tracked]) -> None:
+        self.rows.append(("F", tuple(
+            (t.ix, t.req.state.value, tuple(t.req.tokens),
+             round(t.req.t_finish, 6) if t.req.t_finish is not None else None)
+            for t in tracked)))
+
+    def hash(self) -> str:
+        payload = "\n".join(repr(r) for r in self.rows)
+        return hashlib.sha256(payload.encode("utf-8")).hexdigest()
+
+    @staticmethod
+    def _c(v):
+        if isinstance(v, list):
+            return tuple(v)
+        return v
+
+
+class InvariantAuditor:
+    """The post-event audits. Each returns a list of violation strings;
+    an empty list after every event of every schedule is the soak's
+    pass condition."""
+
+    def __init__(self, fleet, clock, capture: _CaptureTelemetry) -> None:
+        self.fleet = fleet
+        self.clock = clock
+        self.capture = capture
+        self._last_now = clock.now()
+
+    def audit(self, tracked: List[_Tracked]) -> List[str]:
+        from ..serving.request import RequestState
+
+        v: List[str] = []
+        # 5. monotone virtual time
+        now = self.clock.now()
+        if now < self._last_now:
+            v.append(f"[time] virtual time went backwards: "
+                     f"{self._last_now} -> {now}")
+        self._last_now = now
+        # 1. KV block-balance partition, every replica incl. dead ones
+        for rep in self.fleet.replicas:
+            for p in block_balance_report(rep.engine)["problems"]:
+                v.append(f"[block-balance] {rep.name}: {p}")
+        # 2. request state-machine legality / containment
+        for rep in self.fleet.replicas:
+            srv = rep.serving
+            for r in srv._queue:
+                if r.state is not RequestState.QUEUED:
+                    v.append(f"[state] {rep.name}: request {r.uid} in queue "
+                             f"with state {r.state.name}")
+            for uid, r in srv._live.items():
+                if r.state not in (RequestState.PREFILL, RequestState.DECODE):
+                    v.append(f"[state] {rep.name}: live request {uid} in "
+                             f"state {r.state.name}")
+            for uid, r in srv._requests.items():
+                if r.is_terminal:
+                    v.append(f"[state] {rep.name}: terminal request {uid} "
+                             f"still registered")
+        # 3. conservation: every submitted request is terminal or owned
+        # by exactly one replica (no lost, no duplicated requests)
+        for t in tracked:
+            owners = [rep.name for rep in self.fleet.replicas
+                      if t.req.uid in rep.serving._requests]
+            if t.req.is_terminal:
+                if owners:
+                    v.append(f"[conservation] r{t.ix} terminal but still "
+                             f"owned by {owners}")
+            elif len(owners) != 1:
+                v.append(f"[conservation] r{t.ix} ({t.req.state.name}) "
+                         f"owned by {owners} — expected exactly one owner")
+        # 4. span / SLO ledger consistency
+        span_count: Dict[int, int] = {}
+        for s in self.capture.spans:
+            span_count[s.uid] = span_count.get(s.uid, 0) + 1
+        known = {t.req.uid for t in tracked}
+        for uid in span_count:
+            if uid not in known:
+                v.append(f"[span-ledger] span for unknown uid {uid}")
+        for t in tracked:
+            n = span_count.get(t.req.uid, 0)
+            if t.req.is_terminal and n != 1:
+                v.append(f"[span-ledger] r{t.ix} terminal with {n} spans "
+                         f"(exactly one expected)")
+            elif not t.req.is_terminal and n != 0:
+                v.append(f"[span-ledger] r{t.ix} live with {n} spans")
+        judged = sum(1 for s in self.capture.spans if s.in_slo is not None)
+        met = sum(1 for s in self.capture.spans if s.in_slo is True)
+        reg = self.capture.registry
+        if reg.counter("serving/slo_judged").value != judged:
+            v.append(f"[slo-ledger] slo_judged counter "
+                     f"{reg.counter('serving/slo_judged').value} != "
+                     f"{judged} judged spans")
+        if reg.counter("serving/slo_met").value != met:
+            v.append(f"[slo-ledger] slo_met counter "
+                     f"{reg.counter('serving/slo_met').value} != {met} "
+                     f"met spans")
+        # 6. stream-delivery completeness: on_token delivered exactly the
+        # emitted stream, in order, across preempt/retry/failover
+        for t in tracked:
+            if t.delivered != list(t.req.tokens):
+                v.append(f"[delivery] r{t.ix}: delivered {t.delivered} != "
+                         f"emitted {list(t.req.tokens)}")
+        return v
+
+    def final(self, tracked: List[_Tracked], engines: List[SimEngine]
+              ) -> List[str]:
+        """Post-close audit: everything terminal, zero leaked pages on
+        every engine ever built (dead replicas included)."""
+        v: List[str] = []
+        for t in tracked:
+            if not t.req.is_terminal:
+                v.append(f"[liveness] r{t.ix} not terminal after close "
+                         f"({t.req.state.name})")
+        for i, eng in enumerate(engines):
+            for p in block_balance_report(eng)["problems"]:
+                v.append(f"[leak] engine{i}: {p}")
+            if eng.prefix_cache is not None:
+                eng.prefix_cache.drop_all(eng.allocator)
+            if eng.allocator.free_blocks != eng.allocator.n_blocks:
+                v.append(f"[leak] engine{i}: "
+                         f"{eng.allocator.n_blocks - eng.allocator.free_blocks}"
+                         f" pages never freed")
+        return v
+
+
+# ----------------------------------------------------------------------
+# the simulation driver
+# ----------------------------------------------------------------------
+
+@dataclass
+class SimReport:
+    """Outcome of one schedule run."""
+
+    seed: int
+    trace_hash: str
+    violations: List[str]
+    n_ticks: int
+    n_events: int
+    submitted: int
+    finished: int
+    cancelled: int
+    rejected: int
+    tokens: Dict[int, List[int]]          # logical ix -> emitted stream
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> Dict[str, Any]:
+        return {"seed": self.seed, "trace_hash": self.trace_hash,
+                "violations": self.violations, "ticks": self.n_ticks,
+                "events": self.n_events, "submitted": self.submitted,
+                "finished": self.finished, "cancelled": self.cancelled,
+                "rejected": self.rejected}
+
+
+#: extra virtual ticks past the last event before a non-quiescent fleet
+#: counts as a liveness violation (a request parked forever IS a lost
+#: request — the conservation invariant's temporal half)
+LIVENESS_SLACK_TICKS = 600
+
+
+def run_schedule(schedule: Schedule,
+                 engine_factory: Optional[Callable[[], SimEngine]] = None,
+                 stop_on_violation: bool = True) -> SimReport:
+    """Execute one schedule under virtual time and audit every event.
+    Pure: same schedule, same report (bit-identical ``trace_hash``)."""
+    from ..serving.fleet import ServingFleet
+    from ..serving.request import RequestState
+    from ..telemetry.registry import get_registry, set_registry
+    from ..telemetry.telemetry import get_telemetry
+
+    clock = SimClock()
+    capture = _CaptureTelemetry()
+    injector = _ScheduledFaultInjector()
+    prev_telemetry = get_telemetry()
+    # set_telemetry(capture) below also swaps the process-default
+    # registry; restoring telemetry alone would leave the default
+    # registry pointing at the sim's capture forever (set_telemetry(None)
+    # deliberately does not touch the registry) — save it explicitly
+    prev_registry = get_registry()
+    engines: List[SimEngine] = []
+    sim_cfg = SimConfig(**schedule.engine_cfg)
+
+    def factory() -> SimEngine:
+        eng = (engine_factory() if engine_factory is not None
+               else SimEngine(sim_cfg))
+        engines.append(eng)
+        return eng
+
+    trace = _Trace()
+    tracked: List[_Tracked] = []
+    violations: List[str] = []
+    n_ticks = 0
+    with use_clock(clock):
+        set_telemetry(capture)
+        install_fault_injector(injector)
+        try:
+            guard = _SimGuard()
+            fleet = ServingFleet(factory, dict(schedule.fleet_cfg),
+                                 dict(schedule.serving_cfg),
+                                 preemption_guard=guard, start=False)
+            auditor = InvariantAuditor(fleet, clock, capture)
+            events = sorted(schedule.events, key=_event_order)
+            i = 0
+            while True:
+                while i < len(events) and events[i].t <= clock.now() + 1e-9:
+                    ev = events[i]
+                    i += 1
+                    _apply_event(fleet, ev, tracked, guard, injector, clock)
+                    trace.event(clock.now(), ev.kind, ev.payload)
+                    step_violations = auditor.audit(tracked)
+                    violations.extend(step_violations)
+                    if step_violations and stop_on_violation:
+                        break
+                if violations and stop_on_violation:
+                    break
+                did = fleet.step()
+                clock.advance(1.0)
+                n_ticks += 1
+                step_violations = auditor.audit(tracked)
+                violations.extend(step_violations)
+                trace.tick(n_ticks, clock.now(), fleet, tracked)
+                if step_violations and stop_on_violation:
+                    break
+                quiescent = (not did and fleet.queue_depth == 0
+                             and all(t.req.is_terminal for t in tracked))
+                if i >= len(events) and quiescent:
+                    break
+                if not did and i < len(events) and events[i].t > clock.now():
+                    clock.advance(events[i].t - clock.now())
+                if n_ticks > schedule.horizon + LIVENESS_SLACK_TICKS:
+                    stuck = [t.ix for t in tracked if not t.req.is_terminal]
+                    violations.append(
+                        f"[liveness] simulation did not quiesce within "
+                        f"{n_ticks} ticks; live requests: {stuck}")
+                    break
+            # shutdown: the drain loops sleep on the clock; the pump
+            # steps the fleet so virtual time AND work both progress
+            clock.pump = fleet.step
+            fleet.close(timeout=30.0)
+            clock.pump = None
+            violations.extend(auditor.audit(tracked))
+            violations.extend(auditor.final(tracked, engines))
+            trace.finish(tracked)
+        finally:
+            install_fault_injector(None)
+            set_telemetry(prev_telemetry
+                          if prev_telemetry is not None
+                          and prev_telemetry.enabled else None)
+            set_registry(prev_registry)
+    states = [t.req.state for t in tracked]
+    return SimReport(
+        seed=schedule.seed, trace_hash=trace.hash(),
+        violations=violations, n_ticks=n_ticks, n_events=len(schedule.events),
+        submitted=len(tracked),
+        finished=sum(s is RequestState.FINISHED for s in states),
+        cancelled=sum(s is RequestState.CANCELLED for s in states),
+        rejected=sum(s is RequestState.REJECTED for s in states),
+        tokens={t.ix: list(t.req.tokens) for t in tracked})
+
+
+def _apply_event(fleet, ev: SimEvent, tracked: List[_Tracked], guard,
+                 injector: _ScheduledFaultInjector, clock: SimClock) -> None:
+    p = ev.payload
+    if ev.kind == "submit":
+        entry = _Tracked(ix=int(p["ix"]), req=None)
+        entry.req = fleet.submit(
+            list(p["prompt"]), max_new_tokens=int(p["max_new"]),
+            priority=int(p.get("priority", 0)),
+            deadline_s=p.get("deadline"),
+            ttft_deadline_s=p.get("ttft_deadline"),
+            eos_token_id=p.get("eos"),
+            on_token=entry.delivered.append)
+        tracked.append(entry)
+    elif ev.kind == "cancel":
+        target = int(p["target"])
+        for t in tracked:
+            if t.ix == target and not t.req.is_terminal:
+                fleet.cancel(t.req)
+                break
+    elif ev.kind == "tick_fault":
+        injector.arm(int(p.get("n", 1)))
+    elif ev.kind == "replica_death":
+        healthy = sorted(r.name for r in fleet.healthy_replicas)
+        if healthy:
+            name = healthy[int(p.get("which", 0)) % len(healthy)]
+            fleet.kill_replica(name, reason="dst: scheduled death")
+    elif ev.kind == "latch":
+        guard.should_stop = True
+    elif ev.kind == "scale":
+        fleet.scale_to(int(p["n"]))
+    elif ev.kind == "stall":
+        clock.advance(float(p.get("dt", 1.0)))
+    else:
+        raise ValueError(f"unknown simulation event kind '{ev.kind}'")
+
+
+# ----------------------------------------------------------------------
+# shrinking + regression artifacts
+# ----------------------------------------------------------------------
+
+def shrink_schedule(schedule: Schedule,
+                    fails: Optional[Callable[[Schedule], bool]] = None,
+                    max_runs: int = 500) -> Schedule:
+    """Delta-debug a failing schedule to a minimal reproduction (ddmin
+    over the event list; configs are kept — they are part of the seed's
+    identity). ``fails(schedule) -> bool`` defaults to "run_schedule
+    reports violations". The result still fails, and is 1-minimal up to
+    the run budget: removing any single remaining event makes it pass."""
+    if fails is None:
+        def fails(s: Schedule) -> bool:
+            return bool(run_schedule(s).violations)
+
+    events = list(schedule.events)
+    if not fails(schedule.replace_events(events)):
+        raise ValueError("shrink_schedule needs a failing schedule")
+    runs = 0
+    n = 2
+    while len(events) >= 2 and runs < max_runs:
+        chunk = max(1, len(events) // n)
+        reduced = False
+        for start in range(0, len(events), chunk):
+            candidate = events[:start] + events[start + chunk:]
+            if not candidate:
+                continue
+            runs += 1
+            if fails(schedule.replace_events(candidate)):
+                events = candidate
+                n = max(2, n - 1)
+                reduced = True
+                break
+            if runs >= max_runs:
+                break
+        if not reduced:
+            if chunk == 1:
+                break
+            n = min(len(events), n * 2)
+    # final 1-minimality pass: try dropping each remaining event once
+    i = 0
+    while i < len(events) and runs < max_runs and len(events) > 1:
+        candidate = events[:i] + events[i + 1:]
+        runs += 1
+        if fails(schedule.replace_events(candidate)):
+            events = candidate
+        else:
+            i += 1
+    logger.info(f"dst: shrank schedule from {len(schedule.events)} to "
+                f"{len(events)} events in {runs} runs")
+    return schedule.replace_events(events)
+
+
+def dump_repro(schedule: Schedule, violations: List[str],
+               path: str) -> str:
+    """Write a failing (ideally shrunk) schedule as a JSON regression
+    artifact; ``load_repro`` + ``run_schedule`` replays it exactly."""
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "violations": violations,
+                   "schedule": schedule.to_dict()}, fh, indent=1,
+                  sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def load_repro(path: str) -> Tuple[Schedule, List[str]]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return (Schedule.from_dict(data["schedule"]),
+            list(data.get("violations", [])))

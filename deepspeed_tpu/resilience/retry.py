@@ -16,11 +16,11 @@ from __future__ import annotations
 
 import random
 import threading
-import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Tuple, Type
 
 from ..utils.logging import logger
+from .clock import get_clock
 from .counters import record_attempt, record_failure, record_retry
 
 
@@ -78,7 +78,7 @@ _JITTER_RNG = random.Random()
 def retry_call(fn: Callable[..., Any], *args,
                policy: RetryPolicy = RetryPolicy(),
                op: str = "default",
-               sleep: Callable[[float], None] = time.sleep,
+               sleep: Optional[Callable[[float], None]] = None,
                budget: Optional[RetryBudget] = None,
                rng: Optional[random.Random] = None,
                **kwargs) -> Any:
@@ -87,8 +87,12 @@ def retry_call(fn: Callable[..., Any], *args,
     retry up to ``policy.max_attempts`` total attempts, or until ``budget``
     is exhausted. Every attempt is counted under
     ``resilience/attempts/{op}``; retries/failures under
-    ``resilience/{retries,failures}/{op}``.
+    ``resilience/{retries,failures}/{op}``. ``sleep`` defaults to the
+    injectable clock's sleep (:mod:`.clock`), so simulated backoff
+    advances virtual time instead of stalling the host.
     """
+    if sleep is None:
+        sleep = get_clock().sleep
     delay = policy.backoff_s
     last: BaseException
     for attempt in range(1, policy.max_attempts + 1):

@@ -50,9 +50,9 @@ from __future__ import annotations
 import collections
 import itertools
 import threading
-import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..resilience.clock import Clock, get_clock
 from ..utils.logging import log_dist, logger
 from .request import Request, RequestState
 from .router import (NoHealthyReplica, PrefixAffinityRouter, RouterPolicy,
@@ -112,7 +112,8 @@ class ServingFleet:
                  serving_config: Any = None,
                  router: Optional[RouterPolicy] = None,
                  preemption_guard: Any = None,
-                 start: bool = True):
+                 start: bool = True,
+                 clock: Optional[Clock] = None):
         from ..config import FleetConfig, ServingConfig
 
         if config is None:
@@ -128,6 +129,10 @@ class ServingFleet:
         self._factory = engine_factory
         self._guard = preemption_guard
         self._start_drivers = start
+        # the fleet's timebase: health/autoscale intervals, respawn
+        # backoff, drain budgets, request submit stamps — and every
+        # replica it spawns inherits it (docs/dst.md)
+        self._clock = clock if clock is not None else get_clock()
         self._lock = threading.RLock()
         self._replicas: Dict[str, Replica] = {}
         self._requests: Dict[int, Tuple[Request, str]] = {}  # uid -> (req, replica)
@@ -218,7 +223,8 @@ class ServingFleet:
             start=self._start_drivers,
             replica_id=name,
             on_handoff=(self._on_handoff if role == "prefill" else None),
-            on_retire=self._on_retire)
+            on_retire=self._on_retire,
+            clock=self._clock)
         rep = Replica(name, engine, serving, role=role)
         with self._lock:
             self._replicas[name] = rep
@@ -275,7 +281,11 @@ class ServingFleet:
             eos_token_id=eos_token_id, priority=priority,
             deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
             client_request_id=client_request_id, on_token=on_token)
-        req.t_submit = time.perf_counter()
+        # adopt the fleet's clock before stamping (same timebase rule as
+        # ServingEngine.submit_request: injected clock != global clock
+        # must not split a request's lifecycle across two timebases)
+        req._clock = self._clock
+        req.t_submit = self._clock.now()
         self._route(req)
         self._flush_shed()
         return req
@@ -369,14 +379,14 @@ class ServingFleet:
                 r.serving.stop_admission()
         budget = (timeout if timeout is not None
                   else self._serving_config.drain_timeout_s)
-        deadline = time.perf_counter() + budget
+        deadline = self._clock.deadline(budget)
         ordered = ([r for r in replicas if r.role == "prefill"]
                    + [r for r in replicas if r.role != "prefill"])
         ok = True
         for r in ordered:
             if r.state == ReplicaState.DEAD:
                 continue
-            left = max(0.0, deadline - time.perf_counter())
+            left = max(0.0, deadline - self._clock.now())
             ok = r.serving.drain(timeout=left, reject_queued=reject_queued) \
                 and ok
         return ok
@@ -580,7 +590,7 @@ class ServingFleet:
         self._check_health()
         self._check_respawn()
         if self.config.autoscale:
-            now = time.perf_counter()
+            now = self._clock.now()
             if now - self._last_autoscale >= self.config.autoscale_interval_s:
                 self._last_autoscale = now
                 self.autoscale_once()
@@ -588,7 +598,8 @@ class ServingFleet:
         self._update_gauges()
 
     def _monitor_loop(self) -> None:
-        while not self._stop_evt.wait(self.config.health_interval_s):
+        while not self._clock.wait_event(self._stop_evt,
+                                         self.config.health_interval_s):
             try:
                 self.poll()
             except Exception:  # dslint: disable=exception-discipline -- monitor-loop bug guard: a respawn/autoscale crash must not kill the fleet thread; typed faults are handled inside poll()
@@ -657,9 +668,9 @@ class ServingFleet:
                 return
             if not self._accepting:
                 return
-            if time.perf_counter() < self._respawn_after:
+            if self._clock.now() < self._respawn_after:
                 return
-            self._respawn_after = time.perf_counter() + self._respawn_delay
+            self._respawn_after = self._clock.now() + self._respawn_delay
             self._respawn_delay = min(self._respawn_delay * 2.0, 30.0)
         rep = self._spawn(role=role)
         self._count("respawns")

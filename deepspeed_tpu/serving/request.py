@@ -29,9 +29,10 @@ from __future__ import annotations
 import enum
 import itertools
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
+
+from ..resilience.clock import get_clock
 
 
 class RequestState(enum.Enum):
@@ -98,7 +99,7 @@ class Request:
     error: Optional[str] = None
     preemptions: int = 0
     retries: int = 0          # tick-fault re-queues (distinct from preempts)
-    t_submit: Optional[float] = None     # perf_counter clocks
+    t_submit: Optional[float] = None     # clock.now() stamps
     t_admit: Optional[float] = None      # last admission (re-set on resume)
     t_first_admit: Optional[float] = None
     t_first_token: Optional[float] = None
@@ -116,6 +117,12 @@ class Request:
         elif not isinstance(self.client_request_id, str):
             raise ValueError("client_request_id must be a string")
         self._done = threading.Event()
+        # the clock this request's whole lifecycle is timed on, captured
+        # at construction: deadlines, terminal stamps and SLO verdicts
+        # must all read ONE timebase even if the global seam is swapped
+        # mid-flight (a request submitted under a SimClock is judged
+        # under it to the end)
+        self._clock = get_clock()
         # driver-internal: the next token to feed the engine (produced by
         # the previous tick's logits, not yet admitted as context)
         self._pending_token: Optional[int] = None
@@ -132,7 +139,7 @@ class Request:
                 f"{self.state.name} -> {new.name}")
         self.state = new
         if new in TERMINAL_STATES:
-            self.t_finish = time.perf_counter()
+            self.t_finish = self._clock.now()
             self._done.set()
 
     @property
@@ -158,12 +165,12 @@ class Request:
         verdicts = []
         if dl is not None:
             t = self.t_finish if self.t_finish is not None else \
-                (now if now is not None else time.perf_counter())
+                (now if now is not None else self._clock.now())
             verdicts.append(t <= dl)
         if self.ttft_deadline_s is not None and self.t_submit is not None:
             t = self.t_first_token
             if t is None:
-                t = now if now is not None else time.perf_counter()
+                t = now if now is not None else self._clock.now()
             verdicts.append(t <= self.t_submit + self.ttft_deadline_s)
         if not verdicts:
             return None
@@ -171,8 +178,10 @@ class Request:
 
     # -- results --------------------------------------------------------
     def wait(self, timeout: Optional[float] = None) -> bool:
-        """Block until terminal. Returns False on timeout."""
-        return self._done.wait(timeout)
+        """Block until terminal. Returns False on timeout. Waits on the
+        request's clock: under a SimClock this pumps the simulation's
+        drive function instead of parking the thread."""
+        return self._clock.wait_event(self._done, timeout)
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Wait and return the emitted tokens. Raises on non-FINISHED

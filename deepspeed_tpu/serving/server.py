@@ -42,12 +42,12 @@ from __future__ import annotations
 
 import queue as queue_mod
 import threading
-import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..inference.ragged import PoolExhausted
+from ..resilience.clock import Clock, get_clock
 from ..utils.logging import log_dist, logger
 from .request import Request, RequestState
 from .scheduler import CapacityView, SchedulerPolicy, make_policy
@@ -143,7 +143,8 @@ class ServingEngine:
                  start: bool = True,
                  replica_id: Optional[str] = None,
                  on_handoff=None,
-                 on_retire=None):
+                 on_retire=None,
+                 clock: Optional[Clock] = None):
         from ..config import ServingConfig
 
         if config is None:
@@ -168,6 +169,10 @@ class ServingEngine:
                                else "serving")
         self._on_handoff = on_handoff
         self._on_retire = on_retire
+        # every deadline, latency stamp and poll interval reads this
+        # clock; a SimClock here makes the whole driver virtual-time
+        # (docs/dst.md)
+        self._clock = clock if clock is not None else get_clock()
         self._lock = threading.RLock()
         self._queue: List[Request] = []
         self._live: Dict[int, Request] = {}
@@ -256,8 +261,13 @@ class ServingEngine:
         if req.state is not RequestState.QUEUED:
             raise ValueError(
                 f"submit_request needs a QUEUED request, got {req.state.name}")
+        # the request's whole lifecycle is timed on ITS owner's clock: a
+        # Request built under the global clock but submitted to an
+        # engine with an injected one would otherwise mix timebases
+        # (virtual t_submit vs wall t_finish corrupts every SLO verdict)
+        req._clock = self._clock
         if req.t_submit is None:
-            req.t_submit = time.perf_counter()
+            req.t_submit = self._clock.now()
         with self._lock:
             if requeue and self._stop_evt.is_set():
                 return None
@@ -399,13 +409,13 @@ class ServingEngine:
                     self._queue.remove(req)
                     self._reject(req, "rejected at drain")
         self._flush_spans()
-        deadline = time.perf_counter() + (
+        deadline = self._clock.deadline(
             timeout if timeout is not None else self.config.drain_timeout_s)
-        while time.perf_counter() < deadline:
+        while self._clock.now() < deadline:
             with self._lock:
                 if self._idle_locked():
                     return True
-            time.sleep(0.002)
+            self._clock.sleep(self.config.poll_interval_s)
         with self._lock:
             return self._idle_locked()
 
@@ -427,12 +437,12 @@ class ServingEngine:
                          + [req for req, _ in self._adoptions])
             for req in stuck:
                 self.cancel(req)
-            t0 = time.perf_counter()
-            while time.perf_counter() - t0 < 5.0:
+            t0 = self._clock.now()
+            while self._clock.now() - t0 < 5.0:
                 with self._lock:
                     if self._idle_locked():
                         break
-                time.sleep(0.002)
+                self._clock.sleep(self.config.poll_interval_s)
         self._stop_evt.set()
         for t in (self._driver, self._watchdog):
             if t is not None:
@@ -479,26 +489,22 @@ class ServingEngine:
         return block_balance_report(self._engine)["problems"]
 
     # -- driver ----------------------------------------------------------
+    def step(self) -> bool:
+        """One deterministic driver iteration — the manual-driving seam
+        (``start=False``) the fleet's :meth:`~.fleet.ServingFleet.step`
+        and the DST harness (docs/dst.md) use instead of the background
+        thread. Returns False when idle."""
+        return self._tick()
+
     def _drive(self) -> None:
         poll = self.config.poll_interval_s
         while not self._stop_evt.is_set():
-            if (self._guard is not None and self._guard.should_stop
-                    and self._accepting):
-                logger.warning("ServingEngine: preemption latched — "
-                               "draining (finishing live requests, "
-                               "rejecting the queue)")
-                with self._lock:
-                    self._accepting = False
-                    for req in list(self._queue):
-                        self._queue.remove(req)
-                        self._reject(req, "preemption drain")
-                self._flush_spans()
             try:
                 # start-time/flag writes must precede _in_tick: the
                 # watchdog samples these fields without the lock, and the
                 # reverse order lets it judge a fresh tick against the
                 # previous tick's stale clock after an idle stretch
-                self._tick_started = time.perf_counter()
+                self._tick_started = self._clock.now()
                 self._stuck_reported = False
                 self._in_tick = True
                 did_work = self._tick()
@@ -509,23 +515,42 @@ class ServingEngine:
             finally:
                 self._in_tick = False
             if not did_work:
-                self._stop_evt.wait(poll)
+                self._clock.wait_event(self._stop_evt, poll)
 
     def _watch(self) -> None:
         timeout = self.config.stuck_tick_timeout_s
-        while not self._stop_evt.wait(min(1.0, timeout / 4)):
+        while not self._clock.wait_event(self._stop_evt,
+                                         min(1.0, timeout / 4)):
             if (self._in_tick and not self._stuck_reported
-                    and time.perf_counter() - self._tick_started > timeout):
+                    and self._clock.now() - self._tick_started > timeout):
                 self._stuck_reported = True
                 self._count("stuck_ticks")
                 logger.warning(
                     f"ServingEngine: tick {self._tick_count} stuck for "
                     f"> {timeout:.0f}s (device call wedged?)")
 
+    def _check_latch(self) -> None:
+        """Preemption-latch poll, at the top of every tick (driver thread
+        OR manual stepping — it used to live in the thread loop only,
+        which made the latch invisible to deterministically-driven
+        tests/simulations)."""
+        if (self._guard is None or not self._guard.should_stop
+                or not self._accepting):
+            return
+        logger.warning("ServingEngine: preemption latched — draining "
+                       "(finishing live requests, rejecting the queue)")
+        with self._lock:
+            self._accepting = False
+            for req in list(self._queue):
+                self._queue.remove(req)
+                self._reject(req, "preemption drain")
+        self._flush_spans()
+
     def _tick(self) -> bool:
-        """One driver iteration: adoptions, cancellations, admission
-        (+ preemption), one engine ``put()``, token dispatch. Returns
-        False when idle."""
+        """One driver iteration: latch poll, adoptions, cancellations,
+        admission (+ preemption), one engine ``put()``, token dispatch.
+        Returns False when idle."""
+        self._check_latch()
         self._import_adoptions()
         with self._lock:
             self._process_cancellations()
@@ -587,7 +612,7 @@ class ServingEngine:
                 return
             adoptions, self._adoptions = self._adoptions, []
         deferred = []
-        now = time.perf_counter()
+        now = self._clock.now()
         for req, export in adoptions:
             if req._cancel_requested:
                 with self._lock:
@@ -658,7 +683,7 @@ class ServingEngine:
                 self._retire(req, RequestState.CANCELLED)
 
     def _admit(self) -> None:
-        now = time.perf_counter()
+        now = self._clock.now()
         capacity = CapacityView(self._engine,
                                 reserve_output=self.config.reserve_output_blocks,
                                 live=list(self._live.values()))
@@ -814,7 +839,7 @@ class ServingEngine:
         under the serving lock, and retirement must come after delivery
         so ``stream()`` never sees a terminal request with undelivered
         tokens."""
-        now = time.perf_counter()
+        now = self._clock.now()
         finished: List[int] = []
         handoffs: List[Request] = []
         emissions: List[Tuple[Request, int]] = []

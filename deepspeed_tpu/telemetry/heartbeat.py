@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import os
 import statistics
-import time
 from collections import deque
 from typing import Callable, Deque, Optional
 
@@ -85,7 +84,9 @@ class Heartbeat:
             os.makedirs(d, exist_ok=True)
 
     def beat(self, step: int) -> None:
+        from ..resilience.clock import get_clock  # lazy: import-order cycle
         from ..utils.fileio import write_json_atomic
 
-        write_json_atomic(self.path, {"step": int(step), "time": time.time(),
+        write_json_atomic(self.path, {"step": int(step),
+                                      "time": get_clock().time(),
                                       "state": "running"})

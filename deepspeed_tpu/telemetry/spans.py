@@ -29,11 +29,21 @@ scaled, per-step host overhead is ``(host_ms + data_wait_ms) / n_steps``.
 from __future__ import annotations
 
 import dataclasses
-import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 SCHEMA_VERSION = 1
+
+
+def _clock_timestamp() -> float:
+    """Span timestamps come from the injectable clock seam — epoch
+    seconds under the WallClock (so archived v1 JSONL streams keep
+    validating unchanged), virtual-epoch seconds under a SimClock (so
+    simulated runs are bit-reproducible). Imported lazily: telemetry
+    loads before the resilience package in some import orders."""
+    from ..resilience.clock import get_clock
+
+    return get_clock().time()
 
 # field -> (types, required). Required fields must be present and non-None
 # in every emitted record; optional fields must type-check when present.
@@ -93,7 +103,7 @@ class StepStats:
     # device-memory watermarks from utils/memory.py (hbm_peak_gb, ...)
     memory: Dict[str, float] = field(default_factory=dict)
     stalled: bool = False
-    timestamp: float = field(default_factory=time.time)
+    timestamp: float = field(default_factory=_clock_timestamp)
 
     def to_record(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -155,7 +165,7 @@ class RequestStats:
     retries: int = 0
     in_slo: Optional[bool] = None      # None = request carried no SLO
     error: Optional[str] = None
-    timestamp: float = field(default_factory=time.time)
+    timestamp: float = field(default_factory=_clock_timestamp)
 
     def to_record(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)

@@ -42,11 +42,27 @@ if [ "$#" -eq 0 ]; then
         smoke_rc=$chaos_rc
     fi
 
-    # serving-scheduler smoke (CPU evidence lane, docs/serving.md):
-    # under the same seeded overload the SLO-aware policy must sustain
-    # strictly higher in-SLA goodput than FCFS, and allocator block
-    # balance must be exactly zero after drain() on every leg —
-    # including injected tick faults and mid-stream cancellations
+    # DST soak (CPU evidence lane, docs/dst.md): >= 200 seeded
+    # randomized fault schedules through the real serving fleet on
+    # virtual time — zero invariant violations (block balance, request
+    # state machine, no-lost-request conservation, span/SLO ledger,
+    # stream delivery, monotone time), and a replay sample must produce
+    # bit-identical event-trace hashes. Failures are auto-shrunk to
+    # minimal repro JSONs.
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/dst_soak.py
+    dst_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$dst_rc
+    fi
+
+    # serving-scheduler smoke (CPU evidence lane, docs/serving.md): on
+    # VIRTUAL time (SimClock; deterministic, no calibration or jitter
+    # bands) the SLO-aware policy must serve every offered request
+    # in-SLA while FCFS head-of-line blocking misses every interactive
+    # deadline, and allocator block balance must be exactly zero after
+    # drain() on every leg — including injected tick faults and
+    # mid-stream cancellations
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
         python scripts/serving_smoke.py
     serve_rc=$?
@@ -55,12 +71,13 @@ if [ "$#" -eq 0 ]; then
     fi
 
     # serving-fleet smoke (CPU evidence lane, docs/serving.md): in-SLA
-    # goodput must scale >= 1.8x from 1 -> 2 replicas under the seeded
-    # overload; prefix-affinity routing must beat least-loaded on
-    # prefix-cache hit rate; injected replica death (failover) and the
-    # disaggregated prefill->decode handoff must be bit-identical to an
-    # uninterrupted single-engine run; zero leaked KV pages on every
-    # replica on every leg
+    # goodput must scale EXACTLY 2x from 1 -> 2 replicas under the
+    # seeded overload on virtual time (one full wave per replica, exact
+    # tick-count TTFT gate); prefix-affinity routing must beat
+    # least-loaded on prefix-cache hit rate; injected replica death
+    # (failover) and the disaggregated prefill->decode handoff must be
+    # bit-identical to an uninterrupted single-engine run (real
+    # threads); zero leaked KV pages on every replica on every leg
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
         python scripts/fleet_smoke.py
     fleet_rc=$?

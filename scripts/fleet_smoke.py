@@ -1,57 +1,54 @@
 #!/usr/bin/env python
 """Serving-fleet smoke: goodput scaling, prefix-affinity routing,
 replica-death failover, and disaggregated prefill/decode hand-off
-(docs/serving.md).
+(docs/serving.md, docs/dst.md).
 
 CPU evidence lane for the fleet subsystem (run by run_tests.sh):
 
 * **scaling** — the SERVE_SCHED-style seeded overload (a burst of
   equal-priority interactive requests with a tight TTFT SLO) replayed
-  against a 1-replica and a 2-replica fleet. Gate: in-SLA goodput
-  scales >= 1.8x from 1 -> 2 replicas. The win is structural: a TTFT
-  deadline of ~half a wave of service admits exactly one wave of
-  ``max_seqs`` requests per replica (wave 1 sees first tokens within a
-  couple of ticks; wave 2's first token cannot arrive before wave 1's
-  ~25-tick decode finishes), so doubling replicas doubles the in-SLA
-  count. Judging TTFT instead of completion keeps both margins
-  tick-scale: the verdict flips only if the serving tick runs >2x
-  faster or >6x slower than calibration — far outside the co-located
-  2-replica scheduling noise on a shared host;
+  against a 1-replica and a 2-replica fleet, on **virtual time**
+  (SimClock + manual ``fleet.step()`` driving — the DST clock seam):
+  one fleet step is one virtual second, the TTFT deadline is an exact
+  tick count, and the verdict is deterministic. A TTFT deadline of 6
+  ticks admits exactly one wave of ``max_seqs`` requests per replica
+  (wave 1 sees first tokens on the first tick; wave 2's first token
+  cannot arrive before wave 1's ~25-tick decode finishes), so doubling
+  replicas exactly doubles the in-SLA count. The pre-DST design needed
+  a per-host tick calibration, a 12-tick deadline and a documented
+  0.5x..6x jitter-tolerance band; all three are deleted — the gates are
+  exact counts and the scaling ratio gate is tightened from >= 1.8x to
+  exactly 2.0x;
 * **affinity** — repeat-prefix traffic (P shared full-block prefixes,
   R rounds each, shuffled per round) routed once by least-loaded and
-  once by the prefix-affinity consistent hash. Gate: the affinity router
-  achieves a strictly higher aggregate prefix-cache hit rate (repeats
-  land on the replica already holding the prefix KV pages; least-loaded
-  scatters them and every replica pays its own cold miss);
+  once by the prefix-affinity consistent hash, also on virtual time.
+  Gate: exact deterministic hit rates — affinity keeps every repeat
+  round on its prefix's home replica (5/6 rounds hit) while
+  least-loaded scatters them;
 * **failover** — a seeded replica death (chaos ``replica_die_at_tick``)
-  mid-decode: the fleet harvests the dead replica's in-flight requests
-  and re-queues them on the survivor via the bit-exact resume path.
-  Gate: every greedy token stream is IDENTICAL to an uninterrupted
-  single-engine run, and the dead replica's allocator balances (suspect
-  KV discarded, never published);
+  mid-decode under REAL threads: the fleet harvests the dead replica's
+  in-flight requests and re-queues them on the survivor via the
+  bit-exact resume path. Gate: every greedy token stream is IDENTICAL
+  to an uninterrupted single-engine run, and the dead replica's
+  allocator balances (suspect KV discarded, never published);
 * **disaggregated** — 1 prefill + 1 decode replica: prompt KV crosses
   the export/import seam, decode continues elsewhere. Gate: greedy
   streams identical to the single-engine run, one hand-off per request;
 * zero leaked KV pages on EVERY replica of EVERY leg after drain
   (prefix caches dropped, every page back on the free list).
 
-Deadlines are expressed in calibrated tick units (the measured
-steady-state decode-tick latency of this machine), so the scaling
-verdict does not depend on host speed. Writes FLEET_<round>.json
-(round via DST_ROUND, default r06).
+Writes FLEET_<round>.json (round via DST_ROUND, default r07).
 
     JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
 """
 
 from __future__ import annotations
 
-import json
 import os
 import sys
-import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("DST_ROUND", "r06")
+os.environ.setdefault("DST_ROUND", "r07")
 
 import numpy as np  # noqa: E402
 
@@ -62,12 +59,14 @@ sys.path.insert(0, os.path.join(HERE, "scripts"))
 SEED = 0
 PROMPT_LEN = 12
 
-# scaling leg: one wave of max_seqs requests per replica meets the
-# TTFT deadline, the second structurally cannot (see module docstring):
-# wave-1 TTFT ~2 ticks, wave-2 TTFT >= the ~25-tick wave-1 decode.
+# scaling leg: one wave of max_seqs requests per replica meets the TTFT
+# deadline, the second structurally cannot: wave-1 TTFT is 0-1 virtual
+# ticks, wave-2 TTFT >= the ~25-tick wave-1 decode. 6 ticks sits between
+# them with deterministic margin on BOTH sides (no jitter band needed on
+# virtual time).
 N_SCALE = 16
 SCALE_OUT = 24
-SCALE_TTFT_DEADLINE_TICKS = 12.0
+SCALE_TTFT_DEADLINE_TICKS = 6.0
 
 # affinity leg
 N_PREFIXES = 6
@@ -77,6 +76,9 @@ AFFINITY_OUT = 4
 # failover / disaggregation legs
 N_EXACT = 8
 EXACT_OUT = 16
+
+#: liveness rail for the manually-driven virtual-time legs
+MAX_VTICKS = 4000
 
 
 def _build_engine():
@@ -101,26 +103,6 @@ def _init_model():
                   vocab_size=256, max_seq_len=128, use_flash=False,
                   remat=False)
     _build_engine._cache = (model, model.init(jax.random.PRNGKey(0)))
-
-
-def _warmup_and_calibrate(eng) -> float:
-    """Compile every step shape the legs will hit (prefill bucket + each
-    live-pages bucket at full slot occupancy) and return the median
-    steady-state tick latency. Leaves the engine empty."""
-    rng = np.random.default_rng(99)
-    uids = [900_000 + i for i in range(eng.config.max_seqs)]
-    logits = eng.put(uids, [rng.integers(1, 256, (PROMPT_LEN,)).tolist()
-                            for _ in uids])
-    toks = [int(np.argmax(row)) for row in logits]
-    samples = []
-    for _ in range(eng.config.max_context - PROMPT_LEN - 1):
-        t0 = time.perf_counter()
-        logits = eng.put(uids, [[t] for t in toks])
-        samples.append(time.perf_counter() - t0)
-        toks = [int(np.argmax(row)) for row in logits]
-    eng.flush(uids)
-    _reset(eng)
-    return float(np.median(samples[-12:]))
 
 
 def _reset(eng) -> None:
@@ -149,12 +131,21 @@ def _leak_check(engines) -> dict:
             "zero_leak": not problems and free_ok}
 
 
-def _fleet_over(engines, fleet_cfg: dict, serving_cfg: dict):
+def _fleet_over(engines, fleet_cfg: dict, serving_cfg: dict,
+                start: bool = True):
     from deepspeed_tpu.serving import ServingFleet
 
     pool = list(engines)
     return ServingFleet(lambda: pool.pop(0), fleet_cfg, serving_cfg,
-                        start=True)
+                        start=start)
+
+
+def _drive_until_terminal(fleet, clock, reqs) -> None:
+    """Virtual-time driving loop: one fleet step per virtual second."""
+    while not all(r.is_terminal for r in reqs):
+        fleet.step()
+        clock.advance(1.0)
+        assert clock.now() < MAX_VTICKS, "virtual-time leg did not quiesce"
 
 
 def _reference_tokens(eng, prompts, max_new) -> list:
@@ -174,20 +165,28 @@ def _reference_tokens(eng, prompts, max_new) -> list:
 
 
 # ----------------------------------------------------------------------
-def _scaling_leg(engines, tick_s: float) -> dict:
-    """Seeded burst overload against a fleet of len(engines) replicas."""
-    fleet = _fleet_over(engines, {"replicas": len(engines)},
-                        {"policy": "slo", "max_queue": 256,
-                         "drain_timeout_s": 300.0})
+def _scaling_leg(engines) -> dict:
+    """Seeded burst overload against a fleet of len(engines) replicas,
+    manually stepped on a fresh SimClock."""
+    from deepspeed_tpu.resilience import SimClock, use_clock
+
     rng = np.random.default_rng(SEED)
-    t0 = time.perf_counter()
-    reqs = [fleet.submit(rng.integers(1, 256, (PROMPT_LEN,)).tolist(),
-                         max_new_tokens=SCALE_OUT,
-                         ttft_deadline_s=SCALE_TTFT_DEADLINE_TICKS * tick_s)
-            for _ in range(N_SCALE)]
-    drained = fleet.drain(timeout=300.0)
-    fleet.close()
-    wall = time.perf_counter() - t0
+    prompts = [rng.integers(1, 256, (PROMPT_LEN,)).tolist()
+               for _ in range(N_SCALE)]
+    clock = SimClock()
+    with use_clock(clock):
+        fleet = _fleet_over(engines, {"replicas": len(engines)},
+                            {"policy": "slo", "max_queue": 256,
+                             "stuck_tick_timeout_s": 0.0,
+                             "drain_timeout_s": 300.0}, start=False)
+        clock.pump = fleet.step
+        reqs = [fleet.submit(p, max_new_tokens=SCALE_OUT,
+                             ttft_deadline_s=SCALE_TTFT_DEADLINE_TICKS)
+                for p in prompts]
+        _drive_until_terminal(fleet, clock, reqs)
+        vticks = clock.now()
+        drained = fleet.drain(timeout=300.0)
+        fleet.close()
     in_sla = sum(r.state.value == "finished" and r.in_slo() is True
                  for r in reqs)
     leak = _leak_check(engines)
@@ -196,39 +195,43 @@ def _scaling_leg(engines, tick_s: float) -> dict:
     return {"replicas": len(engines), "offered": N_SCALE,
             "finished": sum(r.state.value == "finished" for r in reqs),
             "rejected": sum(r.state.value == "rejected" for r in reqs),
-            "in_sla": in_sla, "wall_s": round(wall, 2),
-            "goodput_rps": round(in_sla / wall, 3),
+            "in_sla": in_sla, "virtual_ticks": round(vticks),
             "drained": drained, "leak_check": leak}
 
 
-def _affinity_leg(engines, router: str, tick_s: float) -> dict:
-    """Repeat-prefix traffic; measures the aggregate prefix-cache hit
-    rate under the given router."""
-    fleet = _fleet_over(engines, {"replicas": len(engines),
-                                  "router": router},
-                        {"policy": "slo", "max_queue": 256,
-                         "drain_timeout_s": 300.0})
+def _affinity_leg(engines, router: str) -> dict:
+    """Repeat-prefix traffic on virtual time; measures the aggregate
+    prefix-cache hit rate under the given router."""
+    from deepspeed_tpu.resilience import SimClock, use_clock
+
     rng = np.random.default_rng(SEED + 1)
     bs = engines[0].config.kv_block_size
     prefixes = [rng.integers(1, 256, (2 * bs,)).tolist()
                 for _ in range(N_PREFIXES)]
     h0 = sum(e.prefix_cache.hits for e in engines)
     m0 = sum(e.prefix_cache.misses for e in engines)
-    t0 = time.perf_counter()
     n_ok = 0
-    for rnd in range(N_ROUNDS):
-        order = rng.permutation(N_PREFIXES)     # break accidental
-        reqs = []                               # least-loaded stickiness
-        for i in order:
-            tail = rng.integers(1, 256, (4,)).tolist()
-            reqs.append(fleet.submit(prefixes[int(i)] + tail,
-                                     max_new_tokens=AFFINITY_OUT))
-        for r in reqs:                          # round barrier: repeats
-            r.wait(timeout=300.0)               # only hit PUBLISHED KV
-            n_ok += r.state.value == "finished"
-    drained = fleet.drain(timeout=300.0)
-    fleet.close()
-    wall = time.perf_counter() - t0
+    clock = SimClock()
+    with use_clock(clock):
+        fleet = _fleet_over(engines, {"replicas": len(engines),
+                                      "router": router},
+                            {"policy": "slo", "max_queue": 256,
+                             "stuck_tick_timeout_s": 0.0,
+                             "drain_timeout_s": 300.0}, start=False)
+        clock.pump = fleet.step
+        for _rnd in range(N_ROUNDS):
+            order = rng.permutation(N_PREFIXES)     # break accidental
+            reqs = []                               # least-loaded stickiness
+            for i in order:
+                tail = rng.integers(1, 256, (4,)).tolist()
+                reqs.append(fleet.submit(prefixes[int(i)] + tail,
+                                         max_new_tokens=AFFINITY_OUT))
+            # round barrier: repeats only hit PUBLISHED KV
+            _drive_until_terminal(fleet, clock, reqs)
+            n_ok += sum(r.state.value == "finished" for r in reqs)
+        vticks = clock.now()
+        drained = fleet.drain(timeout=300.0)
+        fleet.close()
     hits = sum(e.prefix_cache.hits for e in engines) - h0
     misses = sum(e.prefix_cache.misses for e in engines) - m0
     leak = _leak_check(engines)
@@ -237,13 +240,13 @@ def _affinity_leg(engines, router: str, tick_s: float) -> dict:
     return {"router": router, "offered": N_PREFIXES * N_ROUNDS,
             "finished": n_ok, "cache_hits": hits, "cache_misses": misses,
             "hit_rate": round(hits / max(1, hits + misses), 3),
-            "wall_s": round(wall, 2), "drained": drained,
+            "virtual_ticks": round(vticks), "drained": drained,
             "leak_check": leak}
 
 
 def _failover_leg(engines, prompts, ref) -> dict:
-    """Chaos-injected replica death mid-decode; survivors absorb the
-    in-flight work bit-exactly."""
+    """Chaos-injected replica death mid-decode (REAL threads); survivors
+    absorb the in-flight work bit-exactly."""
     from deepspeed_tpu.resilience import FaultInjector, install_fault_injector
 
     inj = FaultInjector(replica_die_at_tick=10, replica_die_index=0)
@@ -271,7 +274,8 @@ def _failover_leg(engines, prompts, ref) -> dict:
 
 
 def _disagg_leg(engines, prompts, ref) -> dict:
-    """1 prefill + 1 decode replica: KV crosses the export/import seam."""
+    """1 prefill + 1 decode replica (REAL threads): KV crosses the
+    export/import seam."""
     from deepspeed_tpu.telemetry import get_telemetry
 
     handoffs = get_telemetry().registry.counter("serving/fleet/handoffs")
@@ -298,9 +302,6 @@ def _disagg_leg(engines, prompts, ref) -> dict:
 def main() -> int:
     _init_model()
     e1, e2 = _build_engine(), _build_engine()
-    tick_s = _warmup_and_calibrate(e1)
-    _warmup_and_calibrate(e2)
-    print(f"[fleet-smoke] calibrated tick: {tick_s * 1e3:.2f} ms")
 
     rng = np.random.default_rng(SEED + 2)
     exact_prompts = [rng.integers(1, 256, (PROMPT_LEN,)).tolist()
@@ -308,12 +309,10 @@ def main() -> int:
     ref = _reference_tokens(e1, exact_prompts, EXACT_OUT)
 
     legs = {}
-    legs["scale_1"] = _scaling_leg([e1], tick_s)
-    legs["scale_2"] = _scaling_leg([e1, e2], tick_s)
-    legs["affinity_least_loaded"] = _affinity_leg([e1, e2], "least_loaded",
-                                                  tick_s)
-    legs["affinity_prefix"] = _affinity_leg([e1, e2], "prefix_affinity",
-                                            tick_s)
+    legs["scale_1"] = _scaling_leg([e1])
+    legs["scale_2"] = _scaling_leg([e1, e2])
+    legs["affinity_least_loaded"] = _affinity_leg([e1, e2], "least_loaded")
+    legs["affinity_prefix"] = _affinity_leg([e1, e2], "prefix_affinity")
     legs["failover"] = _failover_leg([e1, e2], exact_prompts, ref)
     legs["disaggregated"] = _disagg_leg([e1, e2], exact_prompts, ref)
 
@@ -327,8 +326,12 @@ def main() -> int:
 
     in1, in2 = legs["scale_1"]["in_sla"], legs["scale_2"]["in_sla"]
     ratio = in2 / in1 if in1 else float("inf")
+    max_seqs = e1.config.max_seqs
     gates = {
-        "goodput_scales_1p8x": in1 > 0 and in2 >= 1.8 * in1,
+        # strictly tighter than the pre-DST (FLEET_r06) ">= 1.8x with
+        # jitter band" gate: EXACT wave counts, EXACT 2x scaling
+        "goodput_scales_exactly_2x":
+            in1 == max_seqs and in2 == 2 * max_seqs,
         "affinity_beats_least_loaded_hit_rate":
             legs["affinity_prefix"]["hit_rate"]
             > legs["affinity_least_loaded"]["hit_rate"],
@@ -346,7 +349,9 @@ def main() -> int:
     report = {
         "metric": "fleet_in_sla_goodput_scaling_1_to_2_replicas",
         "seed": SEED,
-        "tick_ms": round(tick_s * 1e3, 3),
+        "clock": "virtual for scaling/affinity legs (SimClock; 1 fleet "
+                 "step = 1 virtual second); real threads for "
+                 "failover/disaggregated legs",
         "workload": {"n_scale": N_SCALE, "scale_out": SCALE_OUT,
                      "scale_ttft_deadline_ticks": SCALE_TTFT_DEADLINE_TICKS,
                      "prompt_len": PROMPT_LEN,
@@ -368,11 +373,11 @@ def main() -> int:
         print(f"fleet smoke: FAILED gates {failed}")
         return 1
     print(f"fleet smoke: OK — in-SLA goodput {in1} -> {in2} "
-          f"({ratio:.2f}x) from 1 -> 2 replicas; affinity hit rate "
-          f"{legs['affinity_prefix']['hit_rate']} > least-loaded "
-          f"{legs['affinity_least_loaded']['hit_rate']}; failover and "
-          f"disaggregated hand-off bit-exact; zero leaked KV pages "
-          f"everywhere")
+          f"(exactly {ratio:.2f}x) from 1 -> 2 replicas on virtual time; "
+          f"affinity hit rate {legs['affinity_prefix']['hit_rate']} > "
+          f"least-loaded {legs['affinity_least_loaded']['hit_rate']}; "
+          f"failover and disaggregated hand-off bit-exact; zero leaked "
+          f"KV pages everywhere")
     return 0
 
 

@@ -1,40 +1,40 @@
 #!/usr/bin/env python
 """Serving-scheduler smoke: seeded overload, FCFS vs SLO-aware goodput,
-and zero-leak KV accounting under faults + cancellations (docs/serving.md).
+and zero-leak KV accounting under faults + cancellations
+(docs/serving.md, docs/dst.md).
 
 CPU evidence lane for the serving subsystem (run by run_tests.sh):
 
 * one seeded workload — a burst of long low-priority "batch" requests
   followed by Poisson arrivals of short high-priority "interactive"
-  requests with tight end-to-end deadlines — replayed against a fresh
+  requests with tight end-to-end deadlines — replayed against the same
   engine under each scheduler policy;
-* gate 1: the SLO-aware policy must sustain STRICTLY higher in-SLA
-  goodput than FCFS at the same offered load. The win is structural:
-  FCFS head-of-line blocking parks every interactive request behind the
-  batch backlog for ~(N_batch/slots) x batch-service-time, far past the
-  interactive deadline, while the SLO policy admits them next tick via
-  priority-tier slot preemption (preempted batch requests re-prefill off
-  the prefix cache and still meet their loose deadlines);
+* every leg runs on **virtual time** (SimClock + manual ``step()``
+  driving — the DST clock seam): one engine tick is exactly one virtual
+  second, deadlines are denominated in ticks, and the whole leg is
+  deterministic. The pre-DST design needed a per-host tick calibration
+  and a ~25% jitter-tolerance band engineered into the deadline choice;
+  both are deleted — the gates below are exact;
+* gate 1: the SLO-aware policy serves EVERY request in-SLA at an
+  offered load where FCFS head-of-line blocking makes every interactive
+  request miss structurally (the batch backlog is ~100 ticks of
+  service; the last interactive deadline expires by tick ~44);
 * gate 2: after drain(), allocator block balance is EXACTLY zero-leak on
   every leg — including a chaos leg with injected tick faults
   (serving_tick_fail_every) and mid-stream cancellations.
 
-Deadlines are expressed in calibrated tick units (the measured per-tick
-latency of this machine), so the verdict does not depend on host speed.
-Writes SERVE_SCHED_<round>.json (round via DST_ROUND, default r06).
+Writes SERVE_SCHED_<round>.json (round via DST_ROUND, default r07).
 
     JAX_PLATFORMS=cpu python scripts/serving_smoke.py
 """
 
 from __future__ import annotations
 
-import json
 import os
 import sys
-import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("DST_ROUND", "r06")
+os.environ.setdefault("DST_ROUND", "r07")
 
 import numpy as np  # noqa: E402
 
@@ -43,21 +43,22 @@ sys.path.insert(0, HERE)
 sys.path.insert(0, os.path.join(HERE, "scripts"))
 
 SEED = 0
+MAX_VTICKS = 4000     # liveness rail for the virtual-time drive loops
 N_BATCH = 16          # long, low-priority, loose deadline, burst at t=0
 BATCH_OUT = 24
 N_INTERACTIVE = 16    # short, high-priority, tight deadline, Poisson
 INTER_OUT = 6
 PROMPT_LEN = 12
 INTER_WINDOW_TICKS = 20.0     # interactive arrivals land in [0, 20] ticks
-# ~8x the ideal interactive latency (7 ticks). FCFS cannot meet it
-# structurally: head-of-line FIFO parks every interactive request behind
-# the whole batch burst, >= (N_BATCH / max_seqs) * (BATCH_OUT + 1) = 100
-# ticks of service, while even the LAST interactive arrival's absolute
-# deadline is ~INTER_WINDOW + INTER_DEADLINE = 76 ticks — so every
-# interactive request misses under FCFS even if the host runs the legs
-# ~25% faster than its own calibration (observed jitter is ~10%), while
-# the SLO policy's slot preemption serves them with ~4x headroom.
-INTER_DEADLINE_TICKS = 56.0
+# ~3.5x the ideal interactive latency (7 ticks) — tightened from the
+# pre-DST 56: FCFS cannot meet it structurally (head-of-line FIFO parks
+# every interactive request behind >= (N_BATCH / max_seqs) *
+# (BATCH_OUT + 1) = 100 ticks of batch service, while even the LAST
+# interactive arrival's absolute deadline is ~INTER_WINDOW +
+# INTER_DEADLINE = 44 ticks), and on virtual time the margin needs no
+# host-jitter allowance at all: the SLO policy's slot preemption serves
+# every interactive request with deterministic tick-exact headroom.
+INTER_DEADLINE_TICKS = 24.0
 BATCH_DEADLINE_TICKS = 4000.0
 
 
@@ -79,43 +80,22 @@ def _build_engine():
         jax.random.PRNGKey(0)))
 
 
-def _warmup_and_calibrate(eng) -> float:
-    """Compile every step shape the serving run will hit — the prefill
-    bucket and each live-pages bucket up to full context, at full slot
-    occupancy — then return the median steady-state tick latency. Without
-    this, mid-run XLA compiles land on the serving clock and every
-    tick-denominated deadline is judged against compile time, not serving
-    time. Leaves the engine empty (flushed, cache dropped)."""
-    rng = np.random.default_rng(99)
-    uids = [900_000 + i for i in range(eng.config.max_seqs)]
-    logits = eng.put(uids, [rng.integers(1, 256, (PROMPT_LEN,)).tolist()
-                            for _ in uids])
-    toks = [int(np.argmax(row)) for row in logits]
-    samples = []
-    for _ in range(eng.config.max_context - PROMPT_LEN - 1):
-        t0 = time.perf_counter()
-        logits = eng.put(uids, [[t] for t in toks])
-        samples.append(time.perf_counter() - t0)
-        toks = [int(np.argmax(row)) for row in logits]
-    eng.flush(uids)
-    eng.prefix_cache.drop_all(eng.allocator)
-    return float(np.median(samples[-12:]))
-
-
-def _workload(rng: np.random.Generator, tick_s: float):
-    """(arrival_s, kind, prompt, max_new, priority, deadline_s) rows,
-    sorted by arrival. Same seed -> same workload on every leg."""
+def _workload(rng: np.random.Generator):
+    """(arrival_ticks, kind, prompt, max_new, priority, deadline_ticks)
+    rows, sorted by arrival. Same seed -> same workload on every leg.
+    All times are VIRTUAL ticks — the SimClock advances exactly 1.0 per
+    engine tick, so deadlines need no per-host calibration."""
     rows = []
-    for i in range(N_BATCH):
+    for _ in range(N_BATCH):
         rows.append((0.0, "batch",
                      rng.integers(1, 256, (PROMPT_LEN,)).tolist(),
-                     BATCH_OUT, 0, BATCH_DEADLINE_TICKS * tick_s))
+                     BATCH_OUT, 0, BATCH_DEADLINE_TICKS))
     t = 0.0
-    for i in range(N_INTERACTIVE):
-        t += rng.exponential(INTER_WINDOW_TICKS / N_INTERACTIVE) * tick_s
+    for _ in range(N_INTERACTIVE):
+        t += rng.exponential(INTER_WINDOW_TICKS / N_INTERACTIVE)
         rows.append((t, "interactive",
                      rng.integers(1, 256, (PROMPT_LEN,)).tolist(),
-                     INTER_OUT, 2, INTER_DEADLINE_TICKS * tick_s))
+                     INTER_OUT, 2, INTER_DEADLINE_TICKS))
     rows.sort(key=lambda r: r[0])
     return rows
 
@@ -135,48 +115,62 @@ def _leak_check(eng) -> dict:
                           and free_after == eng.allocator.n_blocks)}
 
 
-def _run_leg(eng, policy: str, tick_s: float, chaos: bool = False) -> dict:
-    """One policy leg over the SHARED warmed engine (fresh engines would
-    re-trace their jitted step mid-leg and bill compile time to the
-    deadlines). Starts and ends with an empty engine + empty cache."""
-    from deepspeed_tpu.resilience import FaultInjector, install_fault_injector
+def _run_leg(eng, policy: str, chaos: bool = False) -> dict:
+    """One policy leg over the SHARED engine, manually stepped on a
+    fresh SimClock: submit arrivals at their virtual instants, one
+    engine tick per virtual second, until every request is terminal.
+    Deterministic — two runs produce identical per-request outcomes."""
+    from deepspeed_tpu.resilience import (FaultInjector, SimClock,
+                                          install_fault_injector, use_clock)
     from deepspeed_tpu.serving import ServingEngine
 
     install_fault_injector(
         FaultInjector(serving_tick_fail_every=13) if chaos else None)
-    srv = ServingEngine(eng, {"policy": policy, "max_queue": 256,
-                              "tick_retry_limit": 3,
-                              "drain_timeout_s": 300.0})
-    rows = _workload(np.random.default_rng(SEED), tick_s)
-    t0 = time.perf_counter()
-    reqs = []
-    cancelled = []
-    for i, (arrival_s, kind, prompt, max_new, priority, deadline_s) in \
-            enumerate(rows):
-        wait = arrival_s - (time.perf_counter() - t0)
-        if wait > 0:
-            time.sleep(wait)
-        reqs.append((kind, srv.submit(prompt, max_new_tokens=max_new,
-                                      priority=priority,
-                                      deadline_s=deadline_s)))
-        if chaos and i == N_BATCH + 8:
-            # mid-stream cancellations while the system is loaded: the
-            # interactive request just submitted (queued or prefilling)
-            # and a batch request still live in its decode — picked
-            # dynamically so a fast host that already finished the early
-            # batch rows cannot dodge the cancellation coverage
-            victims = [reqs[-1][1]]
-            victims += [r for k, r in reqs
-                        if k == "batch" and not r.is_terminal][:1]
-            for victim in victims:
-                if srv.cancel(victim):
-                    cancelled.append(victim.uid)
-    drained = srv.drain()
-    srv.close()
+    rows = _workload(np.random.default_rng(SEED))
+    clock = SimClock()
+    with use_clock(clock):
+        srv = ServingEngine(eng, {"policy": policy, "max_queue": 256,
+                                  "tick_retry_limit": 3,
+                                  "stuck_tick_timeout_s": 0.0,
+                                  "drain_timeout_s": 300.0},
+                            start=False)
+        clock.pump = srv.step
+        reqs = []
+        cancelled = []
+        i = 0
+        while True:
+            while i < len(rows) and rows[i][0] <= clock.now() + 1e-9:
+                _arrival, kind, prompt, max_new, priority, deadline = rows[i]
+                reqs.append((kind, srv.submit(prompt,
+                                              max_new_tokens=max_new,
+                                              priority=priority,
+                                              deadline_s=deadline)))
+                if chaos and i == N_BATCH + 8:
+                    # mid-stream cancellations while the system is
+                    # loaded: the interactive request just submitted and
+                    # a batch request still live in its decode
+                    victims = [reqs[-1][1]]
+                    victims += [r for k, r in reqs
+                                if k == "batch" and not r.is_terminal][:1]
+                    for victim in victims:
+                        if srv.cancel(victim):
+                            cancelled.append(victim.uid)
+                i += 1
+            did = srv.step()
+            clock.advance(1.0)
+            if not did:
+                if i < len(rows):
+                    clock.advance(max(0.0, rows[i][0] - clock.now()))
+                elif all(r.is_terminal for _, r in reqs):
+                    break
+            assert clock.now() < MAX_VTICKS, \
+                "virtual-time leg did not quiesce (stranded request?)"
+        vticks = clock.now()
+        drained = srv.drain()
+        srv.close()
     install_fault_injector(None)
-    wall = time.perf_counter() - t0
 
-    out = {"policy": policy, "chaos": chaos, "wall_s": round(wall, 2),
+    out = {"policy": policy, "chaos": chaos, "virtual_ticks": round(vticks),
            "drained": drained, "cancelled_uids": cancelled}
     for kind in ("batch", "interactive"):
         sel = [r for k, r in reqs if k == kind]
@@ -191,20 +185,17 @@ def _run_leg(eng, policy: str, tick_s: float, chaos: bool = False) -> dict:
             "retries": sum(r.retries for r in sel),
         }
     out["in_sla_total"] = out["batch"]["in_sla"] + out["interactive"]["in_sla"]
-    out["goodput_rps"] = round(out["in_sla_total"] / wall, 2)
     out["leak_check"] = _leak_check(eng)
     return out
 
 
 def main() -> int:
     eng = _build_engine()
-    tick_s = _warmup_and_calibrate(eng)
-    print(f"[serving-smoke] calibrated tick: {tick_s * 1e3:.2f} ms")
 
     legs = {
-        "fcfs": _run_leg(eng, "fcfs", tick_s),
-        "slo": _run_leg(eng, "slo", tick_s),
-        "slo_chaos": _run_leg(eng, "slo", tick_s, chaos=True),
+        "fcfs": _run_leg(eng, "fcfs"),
+        "slo": _run_leg(eng, "slo"),
+        "slo_chaos": _run_leg(eng, "slo", chaos=True),
     }
     for name, leg in legs.items():
         print(f"[serving-smoke] {name}: in_sla={leg['in_sla_total']} "
@@ -212,11 +203,20 @@ def main() -> int:
               f"interactive {leg['interactive']['in_sla']}"
               f"/{leg['interactive']['offered']}) "
               f"preempted={leg['batch']['preemptions']} "
+              f"vticks={leg['virtual_ticks']} "
               f"zero_leak={leg['leak_check']['zero_leak']}")
 
+    # exact gates — virtual time makes every count deterministic, so the
+    # old ">" goodput comparison is tightened to the structural verdict:
+    # FCFS head-of-line starves EVERY interactive request past its
+    # deadline; the SLO policy serves EVERY offered request in-SLA
     gates = {
         "slo_beats_fcfs_goodput":
             legs["slo"]["in_sla_total"] > legs["fcfs"]["in_sla_total"],
+        "fcfs_interactive_all_miss":
+            legs["fcfs"]["interactive"]["in_sla"] == 0,
+        "slo_all_offered_in_sla":
+            legs["slo"]["in_sla_total"] == N_BATCH + N_INTERACTIVE,
         "all_legs_drained": all(l["drained"] for l in legs.values()),
         "zero_leak_all_legs": all(l["leak_check"]["zero_leak"]
                                   for l in legs.values()),
@@ -228,7 +228,7 @@ def main() -> int:
     report = {
         "metric": "in_sla_goodput_slo_vs_fcfs",
         "seed": SEED,
-        "tick_ms": round(tick_s * 1e3, 3),
+        "clock": "virtual (SimClock; 1 engine tick = 1 virtual second)",
         "workload": {"n_batch": N_BATCH, "batch_out": BATCH_OUT,
                      "n_interactive": N_INTERACTIVE,
                      "interactive_out": INTER_OUT,
@@ -252,8 +252,8 @@ def main() -> int:
         return 1
     print(f"serving smoke: OK — SLO in-SLA goodput "
           f"{legs['slo']['in_sla_total']} > FCFS "
-          f"{legs['fcfs']['in_sla_total']} at the same offered load; "
-          f"zero leaked KV blocks on all legs")
+          f"{legs['fcfs']['in_sla_total']} at the same offered load "
+          f"on virtual time; zero leaked KV blocks on all legs")
     return 0
 
 

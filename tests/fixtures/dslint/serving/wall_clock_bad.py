@@ -1,0 +1,36 @@
+"""dslint fixture: PLANTED wall-clock violations (one per sub-check).
+
+Lives under a ``serving/`` directory because the wall-clock rule is
+scoped to the clocked layers (serving/, resilience/, telemetry/).
+Analyzed by tests/test_static_analysis.py only — never imported.
+"""
+import threading
+import time
+from datetime import datetime
+
+from time import perf_counter
+
+
+class Driver:
+    def __init__(self):
+        self._stop_evt = threading.Event()
+
+    def tick_deadline(self, timeout):
+        return time.perf_counter() + timeout  # PLANT: wall-clock direct-time
+
+    def poll(self, interval):
+        time.sleep(interval)                  # PLANT: wall-clock direct-time
+        return self._stop_evt.wait(interval)  # PLANT: wall-clock raw-event-wait
+
+
+def stamp():
+    t = time.time()                           # PLANT: wall-clock direct-time
+    return t, datetime.now()                  # PLANT: wall-clock direct-time
+
+
+def imported_name(budget):
+    return perf_counter() + budget            # PLANT: wall-clock direct-time
+
+
+def inline_event():
+    return threading.Event().wait(0.1)        # PLANT: wall-clock raw-event-wait

@@ -1,0 +1,49 @@
+"""dslint fixture: near-miss wall-clock NON-violations.
+
+Everything here times through the clock seam (or is out of the rule's
+reach): zero findings expected. Never imported.
+"""
+import threading
+
+
+def get_clock():
+    """Stands in for deepspeed_tpu.resilience.clock.get_clock."""
+    raise NotImplementedError
+
+
+class Request:
+    def __init__(self):
+        self._done = threading.Event()
+        self._clock = get_clock()
+
+    def wait(self, timeout=None):
+        # clocked wait: the event is an ARGUMENT, not the receiver
+        return self._clock.wait_event(self._done, timeout)
+
+
+class Driver:
+    def __init__(self, clock):
+        self._clock = clock
+        self._stop_evt = threading.Event()
+        self._lock = threading.Lock()
+
+    def deadline(self, timeout):
+        return self._clock.deadline(timeout)
+
+    def poll(self, interval):
+        self._clock.sleep(interval)
+        return self._clock.wait_event(self._stop_evt, interval)
+
+    def join_worker(self, worker, req):
+        # .wait on receivers that are NOT threading.Event attrs: a
+        # request object's own wait(), and a Condition (lock-discipline
+        # territory, not wall-clock)
+        req.wait(1.0)
+        with self._lock:
+            pass
+
+
+def measure(samples):
+    # arithmetic on times someone else stamped is fine — only CALLS into
+    # the wall clock are the seam bypass
+    return max(samples) - min(samples)

@@ -44,7 +44,7 @@ def test_rule_catalog():
     rules = all_rules()
     assert set(rules) == {"host-sync", "trace-hygiene",
                           "recompile-hazard", "lock-discipline",
-                          "exception-discipline"}
+                          "exception-discipline", "wall-clock"}
     assert "suppression" in known_rule_ids()
     for cls in rules.values():
         assert cls.summary
@@ -58,6 +58,10 @@ def test_rule_catalog():
     ("recompile-hazard", "recompile_bad.py", "recompile_ok.py"),
     ("lock-discipline", "locks_bad.py", "locks_ok.py"),
     ("exception-discipline", "exceptions_bad.py", "exceptions_ok.py"),
+    # wall-clock fixtures sit under a serving/ subdir: the rule is
+    # scoped to the clocked layers by module path
+    ("wall-clock", os.path.join("serving", "wall_clock_bad.py"),
+     os.path.join("serving", "wall_clock_ok.py")),
 ])
 def test_rule_golden(rule, bad, ok):
     bad_found = live(analyze([fixture(bad)]), rule)
@@ -93,6 +97,21 @@ def test_lock_subchecks_all_fire():
                                   "lock-discipline")}
     assert {"blocking-under-lock", "callback-under-lock",
             "order-violation", "lock-cycle", "self-deadlock"} <= codes
+
+
+def test_wall_clock_subchecks_all_fire():
+    codes = {f.code
+             for f in live(analyze([fixture(os.path.join(
+                 "serving", "wall_clock_bad.py"))]), "wall-clock")}
+    assert {"direct-time", "raw-event-wait"} == codes
+
+
+def test_wall_clock_out_of_scope_module_is_ignored():
+    # the same violations OUTSIDE serving//resilience//telemetry/ are
+    # not this rule's business (the engine's host-overhead ledger etc.
+    # legitimately reads wall time)
+    found = live(analyze([fixture("host_sync_bad.py")]), "wall-clock")
+    assert found == []
 
 
 def test_exception_subchecks_all_fire():
