@@ -6,3 +6,4 @@ from . import recompile        # noqa: F401
 from . import locks            # noqa: F401
 from . import exceptions       # noqa: F401
 from . import wall_clock       # noqa: F401
+from . import comm_facade      # noqa: F401

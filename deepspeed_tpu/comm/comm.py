@@ -86,9 +86,15 @@ class CommsLogger:
     records: Dict[str, Dict[int, List[float]]] = field(default_factory=dict)
     axes: Dict[tuple, str] = field(default_factory=dict)
     worlds: Dict[tuple, int] = field(default_factory=dict)
+    # bytes-on-wire ledger (docs/communication.md): cumulative PHYSICAL
+    # bytes per (op, logical_size) — differs from the logical payload only
+    # for compressed collectives (comm/compressed.py), where the wire
+    # carries int8/int4 + scales instead of the fp tensor
+    wire: Dict[tuple, float] = field(default_factory=dict)
 
     def append(self, op_name: str, size_bytes: int, duration_s: float,
-               world: int, axis_name: Optional[str] = None) -> None:
+               world: int, axis_name: Optional[str] = None,
+               wire_bytes: Optional[int] = None) -> None:
         if not self.enabled:
             return
         per_op = self.records.setdefault(op_name, {})
@@ -97,6 +103,9 @@ class CommsLogger:
             self.axes[(op_name, size_bytes)] = axis_name
         if world:
             self.worlds[(op_name, size_bytes)] = world
+        wire = size_bytes if wire_bytes is None else int(wire_bytes)
+        key = (op_name, size_bytes)
+        self.wire[key] = self.wire.get(key, 0.0) + wire
         # unified telemetry: every recorded collective also lands in the
         # shared metrics registry, so comm volume shows up next to step
         # time in the exporters without a separate pipeline
@@ -105,6 +114,12 @@ class CommsLogger:
         reg = get_registry()
         reg.counter(f"comm/{op_name}/calls").inc()
         reg.counter(f"comm/{op_name}/bytes").inc(size_bytes)
+        reg.counter(f"comm/{op_name}/wire_bytes").inc(wire)
+        if wire < size_bytes:
+            # compression ratio is a trace-time static (shapes + dtypes),
+            # safe to observe here; per-op history for the exporters
+            reg.histogram(f"comm/{op_name}/compression_ratio").observe(
+                size_bytes / max(wire, 1))
         if self.verbose:
             algbw, busbw = _get_bw(op_name, size_bytes, duration_s, world)
             log_dist(
@@ -137,25 +152,30 @@ class CommsLogger:
 
     def snapshot_totals(self) -> Dict[str, Dict[str, float]]:
         """Aggregate per-op totals for StepStats: {op: {count, bytes,
-        time_s}}. Counts/bytes are trace-time facts (the collectives the
-        compiled program contains); time_s sums the recorded durations,
-        which are real only after :func:`measure_comm_latencies` backfills
-        them."""
+        wire_bytes, time_s}}. Counts/bytes are trace-time facts (the
+        collectives the compiled program contains); ``wire_bytes`` is the
+        physical volume after compression (== ``bytes`` for uncompressed
+        ops — the v2 schema field; archived v1 snapshots without it keep
+        validating, see telemetry.spans.validate_step_record); time_s sums
+        the recorded durations, which are real only after
+        :func:`measure_comm_latencies` backfills them."""
         out: Dict[str, Dict[str, float]] = {}
         for op, sizes in self.records.items():
-            count = bytes_total = time_total = 0.0
+            count = bytes_total = wire_total = time_total = 0.0
             for size, durs in sizes.items():
                 count += len(durs)
                 bytes_total += size * len(durs)
+                wire_total += self.wire.get((op, size), size * len(durs))
                 time_total += sum(durs)
             out[op] = {"count": count, "bytes": bytes_total,
-                       "time_s": time_total}
+                       "wire_bytes": wire_total, "time_s": time_total}
         return out
 
     def reset(self) -> None:
         self.records.clear()
         self.axes.clear()
         self.worlds.clear()
+        self.wire.clear()
 
 
 _COMMS_LOGGER = CommsLogger()
@@ -196,6 +216,19 @@ def _record(op: str, x: Any, axis_name: Optional[str]) -> None:
     _COMMS_LOGGER.append(op, _nbytes(x), 0.0, 0, axis_name)
 
 
+def record_collective(op: str, logical_bytes: int, wire_bytes: int,
+                      axis_name: Optional[str] = None, world: int = 0) -> None:
+    """Bytes-on-wire ledger entry for a facade-issued collective
+    (comm/compressed.py): ``logical_bytes`` is what the uncompressed path
+    would move per rank, ``wire_bytes`` the physical payload actually on
+    the wire (quantized + scales). Routes through the same chaos hook and
+    CommsLogger as the thin lax wrappers above."""
+    if _CHAOS_HOOK is not None:
+        _CHAOS_HOOK(op)
+    _COMMS_LOGGER.append(op, int(logical_bytes), 0.0, world, axis_name,
+                         wire_bytes=int(wire_bytes))
+
+
 def measure_comm_latencies(mesh=None, iters: int = 10) -> str:
     """Replay every recorded collective on the live mesh and backfill real
     per-op latencies (reference timed_op comm.py:101 / comms benchmark
@@ -210,15 +243,27 @@ def measure_comm_latencies(mesh=None, iters: int = 10) -> str:
     log = _COMMS_LOGGER
 
     def collective(op, axis):
-        if op in ("all_reduce", "reduce"):
+        if op in ("all_reduce", "reduce",
+                  # facade dense reduce hops (comm/compressed.py): the
+                  # wire is a psum/pmean over the axis
+                  "qgz_intra_reduce", "qgz_inter_reduce_dense"):
             return lambda x: jax.lax.psum(x, axis)
-        if op in ("all_gather", "gather", "sparse_allreduce"):
+        if op in ("all_gather", "gather", "sparse_allreduce",
+                  # facade gather hops: the wire is an all_gather of the
+                  # (quantized) payload — the replay buffer is sized by
+                  # the recorded WIRE bytes below, so latency reflects
+                  # what the compressed program actually moves
+                  "qwz_all_gather", "hpz_all_gather",
+                  "qgz_inter_all_gather", "qgz_intra_all_gather"):
             # sparse_allreduce's wire cost IS its all_gathers (rows+indices,
             # recorded as one combined payload); the scatter-add is local
             return lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=True)
-        if op == "reduce_scatter":
+        if op in ("reduce_scatter", "qgz_intra_reduce_scatter"):
             return lambda x: jax.lax.psum_scatter(x, axis, tiled=True)
-        if op == "all_to_all":
+        if op in ("all_to_all",
+                  # facade quantized reduce-scatter hop: the wire is a
+                  # chunk exchange (all_to_all) of the quantized payload
+                  "qgz_inter_reduce_scatter"):
             return lambda x: jax.lax.all_to_all(x, axis, 0, 0, tiled=True)
         if op in ("broadcast", "scatter"):
             # scatter's wire IS a broadcast (see scatter()); replay as one
@@ -241,7 +286,12 @@ def measure_comm_latencies(mesh=None, iters: int = 10) -> str:
             world = mesh.shape[axis]
             log.worlds[(op, size)] = world
             fn = collective(op, axis)
-            n = max(size // 4, world)
+            # replay the PHYSICAL payload: for compressed facade ops the
+            # wire ledger's per-call bytes, for dense ops wire == logical
+            durs = log.records[op][size]
+            wire_pc = log.wire.get((op, size), size * len(durs))
+            wire_pc = wire_pc / max(len(durs), 1)
+            n = max(int(wire_pc) // 4, world)
             n -= n % world or 0
             if fn is None or n < world:
                 continue

@@ -563,6 +563,92 @@ class CommsLoggerConfig:
 
 
 @dataclass
+class CommCompressionConfig:
+    """The ``comm_compression`` block: the compressed-collectives facade
+    (comm/compressed.py, docs/communication.md) — quantized weight
+    all-gather (qwZ), hierarchical quantized gradient reduce-scatter
+    (qgZ) and the T3-style staged overlap schedule as the shipped ZeRO-3
+    path on large meshes.
+
+    ``enabled`` is tri-state: ``"auto"`` (default) turns compression on
+    exactly when the ZeRO data-parallel group reaches
+    ``mesh_size_threshold`` ranks — small meshes keep the dense path
+    (the pack/unpack bracket only pays for itself across slow links, see
+    scripts/tpu_quant_comm_bench.py break-even analysis); ``true``/
+    ``false`` force it. The explicit ZeRO++ knobs
+    (``zero_optimization.zero_quantized_weights`` / ``_gradients``)
+    still opt individual legs in regardless of the threshold.
+
+    ``grad_bits`` applies to the INTER-slice gradient hop only — the
+    intra-slice (fast-ICI) hop always reduces dense fp (the ZeRO++
+    hierarchical positioning). ``overlap`` picks the per-block issue
+    order of the staged schedule for models exposing ``zero3_blocks``:
+    ``"staged"`` prefetches the next block's gather and defers the
+    previous block's reduce (T3), ``"serial"`` issues each collective
+    immediately at its consumer, ``"off"`` disables the block schedule.
+    ``error_stats`` adds traced quantization-error scalars to the step
+    metrics (one extra host fetch per step when telemetry is on)."""
+
+    enabled: Any = "auto"      # "auto" | True | False
+    mesh_size_threshold: int = 16
+    weight_bits: int = 8
+    weight_block: int = 256
+    grad_bits: int = 8
+    grad_block: int = 256
+    overlap: str = "staged"    # staged | serial | off
+    error_stats: bool = False
+
+    def resolve_enabled(self, dp_size: int) -> bool:
+        if isinstance(self.enabled, bool):
+            return self.enabled
+        return dp_size >= self.mesh_size_threshold
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "CommCompressionConfig":
+        if not d:
+            return cls()
+        d = dict(d)
+        enabled = _take(d, "enabled", "auto")
+        if not isinstance(enabled, bool):
+            if str(enabled).lower() != "auto":
+                raise ConfigError(
+                    f"comm_compression.enabled must be true/false/'auto', "
+                    f"got {enabled!r}")
+            enabled = "auto"
+        out = cls(
+            enabled=enabled,
+            mesh_size_threshold=int(_take(d, "mesh_size_threshold", 16)),
+            weight_bits=int(_take(d, "weight_bits", 8)),
+            weight_block=int(_take(d, "weight_block", 256)),
+            grad_bits=int(_take(d, "grad_bits", 8)),
+            grad_block=int(_take(d, "grad_block", 256)),
+            overlap=str(_take(d, "overlap", "staged")),
+            error_stats=bool(_take(d, "error_stats", False)),
+        )
+        for name, bits in (("weight_bits", out.weight_bits),
+                           ("grad_bits", out.grad_bits)):
+            if bits not in (4, 8):
+                raise ConfigError(
+                    f"comm_compression.{name} must be 4 or 8, got {bits}")
+        for name, block in (("weight_block", out.weight_block),
+                            ("grad_block", out.grad_block)):
+            if block <= 0 or block % 2:
+                raise ConfigError(
+                    f"comm_compression.{name} must be positive and even, "
+                    f"got {block}")
+        if out.overlap not in ("staged", "serial", "off"):
+            raise ConfigError(
+                f"comm_compression.overlap must be 'staged', 'serial' or "
+                f"'off', got '{out.overlap}'")
+        if out.mesh_size_threshold < 1:
+            raise ConfigError(
+                f"comm_compression.mesh_size_threshold must be >= 1, got "
+                f"{out.mesh_size_threshold}")
+        _warn_unknown(d, "comm_compression")
+        return out
+
+
+@dataclass
 class PipelineConfig:
     """Pipeline execution knobs (reference: PipelineModule/PipelineEngine args)."""
 
@@ -989,6 +1075,7 @@ class Config:
     compile: CompileConfig = field(default_factory=CompileConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+    comm_compression: CommCompressionConfig = field(default_factory=CommCompressionConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
@@ -1055,6 +1142,7 @@ class Config:
             compile=CompileConfig.from_dict(_take(d, "compile", None)),
             flops_profiler=FlopsProfilerConfig.from_dict(_take(d, "flops_profiler", None)),
             comms_logger=CommsLoggerConfig.from_dict(_take(d, "comms_logger", None)),
+            comm_compression=CommCompressionConfig.from_dict(_take(d, "comm_compression", None)),
             pipeline=PipelineConfig.from_dict(_take(d, "pipeline", None)),
             checkpoint=CheckpointConfig.from_dict(_take(d, "checkpoint", None)),
             resilience=ResilienceConfig.from_dict(_take(d, "resilience", None)),

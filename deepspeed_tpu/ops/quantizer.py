@@ -100,3 +100,23 @@ def quantized_nbytes(numel: int, bits: int, block: int) -> int:
     payload = numel * bits // 8
     scales = (numel // block) * 4
     return payload + scales
+
+
+def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """Pack int4 values (int8 storage in [-8, 7], even length) two nibbles
+    per byte, so an inter-host int4 collective really moves half the
+    elements — the wire-volume claim is carried by the program, not just
+    the ledger. Layout: element 2k in the low nibble, 2k+1 in the high."""
+    flat = q.reshape(-1).astype(jnp.int32)
+    lo = flat[0::2] & 0x0F
+    hi = (flat[1::2] & 0x0F) << 4
+    return (lo | hi).astype(jnp.uint8)
+
+
+def unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`pack_int4`: uint8 [n] -> int8 [2n] in [-8, 7]."""
+    p = packed.reshape(-1).astype(jnp.int32)
+    lo = p & 0x0F
+    hi = (p >> 4) & 0x0F
+    both = jnp.stack([lo, hi], axis=-1).reshape(-1)
+    return jnp.where(both >= 8, both - 16, both).astype(jnp.int8)

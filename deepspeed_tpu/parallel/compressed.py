@@ -18,6 +18,12 @@ side streams; here both phases are XLA collectives inside shard_map —
 the wire volume drops ~4x (plus one fp32 scale per chunk), then the
 reduced chunk is re-compressed and ``all_gather``-ed. Same convergence
 contract, compiler-scheduled transfers riding ICI.
+
+NB: this module is the error-feedback compression layer behind the
+1-bit OPTIMIZERS (runtime/onebit.py). The engine's ZeRO-3 qwZ/qgZ hot
+path moved to the metered compression facade in ``comm/compressed.py``
+(docs/communication.md) — new collective call sites should go there so
+the bytes-on-wire ledger and the mesh-size compression policy see them.
 """
 
 from __future__ import annotations

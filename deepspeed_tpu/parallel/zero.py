@@ -37,9 +37,11 @@ group as ZeRO's process group when Ulysses is active (engine.py:1122).
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
@@ -237,3 +239,180 @@ def compute_param_bytes(param_shapes: Any) -> int:
     for leaf in jax.tree_util.tree_leaves(param_shapes):
         total += int(np.prod(leaf.shape)) * jax.numpy.dtype(leaf.dtype).itemsize
     return total
+
+
+# ======================================================================
+# T3-style staged ZeRO-3 overlap schedule (docs/communication.md)
+#
+# GSPMD inserts ZeRO-3's all-gathers and reduce-scatters wherever the
+# sharding constraints demand them, but the whole backward is one opaque
+# jax.grad: the compiler sees one giant gather-everything /
+# reduce-everything dataflow and its latency-hiding scheduler has nothing
+# block-shaped to pipeline. The staged schedule splits the model into
+# sequential blocks and issues each block's collectives EAGERLY — block
+# i+1's weight all-gather before block i's forward compute, block i+1's
+# gradient reduce-scatter deferred behind block i's backward — which is
+# exactly the software-pipelined schedule T3 (arxiv 2401.16677) fuses in
+# hardware and the reference builds with fetch/release hooks + prefetch
+# (partitioned_param_coordinator.py:256). Same dataflow, per-block
+# granularity, overlap-friendly issue order; serial mode issues every
+# collective immediately at its consumer for the A/B.
+
+@dataclass
+class BlockProgram:
+    """A model decomposed into sequential blocks for the staged ZeRO-3
+    schedule. ``block_fns[i](p_i, h) -> h'`` consumes the FULL (gathered)
+    params of block i; ``h0`` is the first block's input (derived from
+    the batch); ``loss_tail(h) -> scalar loss`` closes over batch/rng;
+    ``merge(block_trees) -> params_tree`` reassembles per-block pytrees
+    (e.g. gradients) into the model's parameter-tree structure. A model
+    opts into the staged engine path by exposing
+    ``zero3_blocks(params, batch, rng) -> BlockProgram``; the params
+    argument must be handled structurally (the engine also calls it on a
+    PartitionSpec tree to learn per-block shardings)."""
+
+    block_fns: List[Callable[[Any, Any], Any]]
+    blocks: List[Any]
+    h0: Any
+    loss_tail: Callable[[Any], Any]
+    merge: Callable[[List[Any]], Any]
+
+
+class Zero3BlockSchedule:
+    """Explicit per-block forward/backward with pluggable (compressed)
+    collectives. ``gather(i, block_shard) -> block_full`` and
+    ``reduce(i, block_grads_full) -> block_grads_reduced`` come from the
+    comm facade; ``overlapped`` picks the issue order (True = T3-style
+    prefetch/defer, False = serial). Both orders have identical dataflow
+    — results are bit-exact to each other by construction, and the tests
+    pin that so neither path can drift semantically.
+
+    Memory contract (the stage-3 point): the forward keeps only the
+    per-block ACTIVATIONS; full block params are live just for their own
+    stage. The backward RE-GATHERS each block and recomputes its forward
+    to build the vjp (activation checkpointing at block boundaries —
+    the reference's fetch/release + prefetch schedule,
+    partitioned_param_coordinator.py:256). That is the 2-gathers + 1-
+    reduce per step ``comm.compressed.modeled_exposure`` books; holding
+    every vjp residual instead would keep the whole unsharded model
+    resident and forfeit ZeRO-3 partitioning at exactly the scale this
+    schedule targets."""
+
+    def __init__(self, gather: Callable[[int, Any], Any],
+                 reduce: Callable[[int, Any], Any],
+                 overlapped: bool = True):
+        self.gather = gather
+        self.reduce = reduce
+        self.overlapped = overlapped
+
+    def loss_and_grads(self, prog: BlockProgram, scale) -> Tuple[Any, List[Any]]:
+        """(loss, per-block grad trees). Grads are wrt the FULL block
+        params (each rank's local-batch contribution, reduced across the
+        ZeRO group by ``reduce``); the loss comes back unreduced — the
+        caller averages it over the data axes."""
+        L = len(prog.block_fns)
+        assert L == len(prog.blocks) and L > 0
+        # -- forward: prefetch next gather, save activations only
+        hs: List[Any] = [prog.h0]
+        h = prog.h0
+        full = self.gather(0, prog.blocks[0])
+        for i in range(L):
+            nxt = None
+            if self.overlapped and i + 1 < L:
+                # prefetch: next block's gather issued BEFORE this
+                # block's compute consumes anything
+                nxt = self.gather(i + 1, prog.blocks[i + 1])
+            h = prog.block_fns[i](full, h)
+            hs.append(h)
+            if i + 1 < L:
+                full = nxt if self.overlapped else self.gather(
+                    i + 1, prog.blocks[i + 1])
+        loss, tail_vjp = jax.vjp(prog.loss_tail, h)
+        (g_h,) = tail_vjp(jnp.ones_like(loss) * scale)
+        # -- backward: re-gather + recompute each block's vjp; defer the
+        # previous block's reduce behind this block's compute
+        grads: List[Any] = [None] * L
+        pending = None
+        pending_i = -1
+        full = self.gather(L - 1, prog.blocks[L - 1])
+        for i in reversed(range(L)):
+            nxt = None
+            if self.overlapped and i > 0:
+                nxt = self.gather(i - 1, prog.blocks[i - 1])
+            _, vjp = jax.vjp(prog.block_fns[i], full, hs[i])
+            g_full, g_h = vjp(g_h)
+            if self.overlapped:
+                if pending is not None:
+                    grads[pending_i] = self.reduce(pending_i, pending)
+                pending, pending_i = g_full, i
+            else:
+                grads[i] = self.reduce(i, g_full)
+            if i > 0:
+                full = nxt if self.overlapped else self.gather(
+                    i - 1, prog.blocks[i - 1])
+        if pending is not None:
+            grads[pending_i] = self.reduce(pending_i, pending)
+        return loss, grads
+
+
+class SequentialBlockModel:
+    """Reference implementation of the ``zero3_blocks`` protocol: a stack
+    of dense layers with a mean-squared-error tail. This is the model
+    the staged-schedule tests, the quant-comm smoke and the MULTICHIP
+    comm lane drive — small enough to verify bit-level on CPU, block-
+    structured enough that every per-block collective is visible.
+
+    ``loss(params, batch, rng)`` is the composed (non-staged) path, used
+    for eval parity and as the bit-level reference for the schedule."""
+
+    def __init__(self, dims: Sequence[int], seed: int = 0):
+        if len(dims) < 3:
+            raise ValueError("SequentialBlockModel needs >= 2 layers")
+        self.dims = tuple(int(d) for d in dims)
+        self.seed = seed
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.dims) - 1
+
+    def init(self, rng) -> Any:
+        params = {}
+        for i in range(self.n_blocks):
+            rng, k = jax.random.split(rng)
+            params[f"block_{i}"] = {
+                "w": jax.random.normal(
+                    k, (self.dims[i], self.dims[i + 1]), jnp.float32) * 0.05,
+                "b": jnp.zeros((self.dims[i + 1],), jnp.float32),
+            }
+        return params
+
+    @staticmethod
+    def _apply_block(p: Any, h: Any, last: bool) -> Any:
+        y = h @ p["w"] + p["b"]
+        return y if last else jnp.tanh(y)
+
+    def loss(self, params, batch, rng=None):
+        h = batch["x"]
+        for i in range(self.n_blocks):
+            h = self._apply_block(params[f"block_{i}"], h,
+                                  last=(i == self.n_blocks - 1))
+        return jnp.mean((h - batch["y"]) ** 2)
+
+    def zero3_blocks(self, params, batch, rng=None) -> BlockProgram:
+        L = self.n_blocks
+        blocks = [params[f"block_{i}"] for i in range(L)]
+
+        def block_fn(i):
+            last = i == L - 1
+            return lambda p, h: self._apply_block(p, h, last)
+
+        def loss_tail(h):
+            return jnp.mean((h - batch["y"]) ** 2)
+
+        def merge(trees: List[Any]) -> Any:
+            return {f"block_{i}": t for i, t in enumerate(trees)}
+
+        h0 = batch["x"] if isinstance(batch, dict) else batch
+        return BlockProgram(block_fns=[block_fn(i) for i in range(L)],
+                            blocks=blocks, h0=h0, loss_tail=loss_tail,
+                            merge=merge)

@@ -203,10 +203,47 @@ class TrainEngine:
         #   hpZ  — compute copy sharded over the inner 'zshard' axes only, so
         #          per-layer all-gathers stay on fast ICI (:883)
         #   qgZ  — gradients reduced across the outer 'data' axis through the
-        #          int8 collective (parallel/compressed.py:int8_pmean)
-        self._qwz = bool(config.zero.zero_quantized_weights) and config.zero.stage >= 3
-        self._qgz = bool(config.zero.zero_quantized_gradients)
+        #          hierarchical quantized collective (comm/compressed.py)
+        # The comm_compression block (docs/communication.md) makes both
+        # quantized legs the DEFAULT above its mesh-size threshold; the
+        # explicit zero_optimization knobs opt individual legs in below it.
+        self._cc = config.comm_compression
+        cc_on = self._cc.resolve_enabled(self.topo.data_parallel_size)
+        self._qwz = ((bool(config.zero.zero_quantized_weights) or cc_on)
+                     and config.zero.stage >= 3)
+        self._qgz = (bool(config.zero.zero_quantized_gradients)
+                     or (cc_on and config.zero.stage >= 2))
         self._hpz = self.zero_rules.hpz
+        # manual shard_map axes of the facade-routed grad/weight paths:
+        # the factored data-parallel dimension (outer 'data' = the slow
+        # inter-slice hop, inner 'zshard' = fast ICI)
+        self._dp_manual_axes = tuple(
+            a for a in ("data", "zshard") if self.topo.axis_size(a) > 1)
+        # T3-style staged block schedule (parallel/zero.py): models
+        # exposing zero3_blocks get per-block eager collective issue
+        # inside the fused step; "serial" keeps just-in-time issue (A/B).
+        # Only when the engine trains the MODEL'S OWN loss: the staged
+        # path computes loss from zero3_blocks' loss_tail, so silently
+        # engaging it under a user-supplied loss_fn would optimize a
+        # different objective than the one passed to initialize().
+        self._staged_mode = None
+        if (config.zero.stage >= 3 and not self._pipelined
+                and self._dp_manual_axes
+                and model is not None and hasattr(model, "zero3_blocks")
+                and self._cc.overlap != "off"):
+            if self._raw_loss_fn == getattr(model, "loss", None):
+                self._staged_mode = self._cc.overlap
+            else:
+                logger.warning(
+                    "staged ZeRO-3 overlap disabled: a custom loss_fn was "
+                    "supplied, but the model's zero3_blocks defines its own "
+                    "loss_tail — training proceeds on the (unstaged) facade "
+                    "path with the custom loss")
+        # quant-error stats only exist where a quantized facade path runs
+        self._wants_quant_err = bool(
+            self._cc.error_stats
+            and (self._staged_mode is not None
+                 or (self._qgz and self._dp_manual_axes)))
         self._secondary_shardings = None
         if self._hpz or (self._qwz and self.zero_rules.zero_size > 1):
             self._secondary_shardings = self.zero_rules.secondary_param_shardings(
@@ -512,47 +549,45 @@ class TrainEngine:
     # core jitted programs
     def _compute_copy(self, params):
         """Compute-dtype copy of the fp32 master params with the ZeRO++
-        transforms applied at this boundary: qwZ fake-quantizes through int8
-        with the int8 tensor carrying the gather placement (so the
-        cross-'data' all-gather moves 1 byte/elt), hpZ re-shards onto the
-        inner axes only (per-layer gathers stay on fast ICI)."""
+        transforms applied at this boundary: qwZ fake-quantizes through the
+        facade's STE gather (comm/compressed.py — the int8 tensor carries
+        the gather placement, so the cross-'data' all-gather moves
+        1 byte/elt), hpZ re-shards onto the inner axes only (per-layer
+        gathers stay on fast ICI). The facade shard_map paths use
+        :meth:`_facade_compute_copy` instead, which keeps the sharded
+        layout so the gather happens inside the metered region."""
         pc = _cast_tree(params, self.compute_dtype)
         if self._param_transform is not None:
             pc = self._param_transform(pc)
         if self._secondary_shardings is None:
             return pc
-        from ..ops.quantizer import dequantize_blockwise, quantize_blockwise
+        from ..comm.compressed import QuantSpec, ste_quant_gather
 
-        def ste_quant(x, sh):
-            """Fake-quantize with a straight-through estimator: the forward
-            gathers int8 (the qwZ comm saving), the backward passes the
-            cotangent through unchanged — differentiating through
-            round() would zero the gradient for all but the per-block
-            argmax elements, silently freezing every quantized weight."""
+        wq = QuantSpec(self._cc.weight_bits, self._cc.weight_block)
 
-            def primal(v):
-                q, s, _ = quantize_blockwise(v, bits=8, block=256)
-                q = jax.lax.with_sharding_constraint(q, sh)
-                return dequantize_blockwise(
-                    q, s, block=256, dtype=self.compute_dtype).reshape(v.shape)
-
-            fq = jax.custom_vjp(primal)
-            fq.defvjp(lambda v: (primal(v), None), lambda _, g: (g,))
-            return fq(x)
+        # no 'data' hop (e.g. hpZ partition == dp): the re-shard moves
+        # nothing across a slow link, so fake-quantizing it would pay the
+        # bracket + error with no wire to save (intra-slice stays dense,
+        # docs/communication.md)
+        qwz_here = self._qwz and "data" in self._dp_manual_axes
 
         def leaf(x, sh):
             if not (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)):
                 return x
-            if self._qwz and x.size % 256 == 0 and x.size >= 4096:
-                return ste_quant(x, sh)
+            if qwz_here and x.size % wq.block == 0 and x.size >= 4096:
+                return ste_quant_gather(x, sh, wq, self.compute_dtype)
             return jax.lax.with_sharding_constraint(x, sh)
 
         return jax.tree_util.tree_map(leaf, pc, self._secondary_shardings)
 
     def _loss_and_grads(self, params, batch, rng, scale):
         """One microbatch: grads of (scaled) loss wrt fp32 master params,
-        computed in the compute dtype."""
-        if self._qgz and self.topo.axis_size("data") > 1:
+        computed in the compute dtype. Dispatch: the staged block schedule
+        (T3 overlap) when the model exposes it, else the facade qgZ path
+        when quantized gradients are on, else the plain GSPMD path."""
+        if self._staged_mode is not None:
+            return self._loss_and_grads_staged(params, batch, rng, scale)
+        if self._qgz and self._dp_manual_axes:
             return self._loss_and_grads_qgz(params, batch, rng, scale)
 
         def scaled_loss(p):
@@ -577,56 +612,194 @@ class TrainEngine:
                 out.append(e if e in keep else None)
         return PartitionSpec(*out)
 
-    def _loss_and_grads_qgz(self, params, batch, rng, scale):
-        """qgZ: the cross-'data' gradient reduction goes through the
-        blockwise-int8 collective instead of a dense psum. The loss/grad is
-        computed under shard_map with ONLY the outer 'data' axis manual —
-        zshard/seq/model stay auto (GSPMD), so hpZ/TP placement inside the
-        model is untouched; data-sharded param leaves are all-gathered
-        locally first (the stage-3 fetch, in the compute dtype)."""
-        from ..parallel.compressed import tree_int8_pmean
+    def _facade_compute_copy(self, params):
+        """Compute-dtype copy for the facade shard_map paths: keeps the
+        stage-3 SHARDED layout so the per-leaf (quantized) gather happens
+        INSIDE the shard_map region where the facade can meter it. Under
+        hpZ the secondary (inner-sharded) copy is used instead, with the
+        STE fake-quant booking the one outer hop at the cast boundary —
+        the facade then only issues the fast-ICI inner gathers.
+        Returns (pc, pc_shardings)."""
+        if self._hpz and self._secondary_shardings is not None:
+            return self._compute_copy(params), self._secondary_shardings
+        pc = _cast_tree(params, self.compute_dtype)
+        if self._param_transform is not None:
+            pc = self._param_transform(pc)
+        pc = jax.lax.with_sharding_constraint(pc, self.param_shardings)
+        return pc, self.param_shardings
 
-        mesh = self.topo.mesh
-        world = self.topo.axis_size("data")
-        pc_shardings = (self._secondary_shardings if self._secondary_shardings
-                        is not None else self.param_shardings)
+    def _facade_axes(self):
+        """(outer, outer_world, inner, inner_world) of the hierarchical
+        comm layout: 'data' is the slow inter-slice hop, 'zshard' the
+        fast-ICI intra-slice hop when the mesh factors it out. When the
+        whole DP group is the inner slice (data=1, e.g. hpZ partition ==
+        dp), there IS no slow hop: outer comes back None/world-1 so every
+        quantized leg degrades to the dense fast-ICI path — the contract
+        ("the intra-slice hop always reduces dense fp",
+        docs/communication.md) must hold on degenerate meshes too."""
+        axes = self._dp_manual_axes
+        outer = "data" if "data" in axes else None
+        inner = "zshard" if "zshard" in axes else None
+        return (outer, self.topo.axis_size("data") if outer else 1,
+                inner, self.topo.axis_size("zshard") if inner else 1)
+
+    def _facade_qspecs(self):
+        from ..comm.compressed import QuantSpec
+
+        wq = (QuantSpec(self._cc.weight_bits, self._cc.weight_block)
+              if self._qwz else None)
+        gq = (QuantSpec(self._cc.grad_bits, self._cc.grad_block)
+              if self._qgz else None)
+        return wq, gq
+
+    def _facade_prelude(self, params, batch):
+        """Shared setup of the facade shard_map paths (qgZ + staged):
+        axis layout, quant specs, sharded compute copy, stripped in/out
+        specs. One site to change when the facade contract moves."""
+        axes = self._dp_manual_axes
+        outer, outer_world, inner, inner_world = self._facade_axes()
+        wq, gq = self._facade_qspecs()
+        pc, pc_shardings = self._facade_compute_copy(params)
+        is_spec = lambda x: isinstance(x, PartitionSpec)  # noqa: E731
         pc_specs = jax.tree_util.tree_map(
-            lambda sh: self._strip_spec_to_axes(sh.spec, {"data"}), pc_shardings)
-        batch_specs = jax.tree_util.tree_map(
-            lambda _: PartitionSpec("data"), batch)
-        pc = self._compute_copy(params)
+            lambda sh: self._strip_spec_to_axes(sh.spec, set(axes)),
+            pc_shardings)
+        bspec = PartitionSpec(axes[0] if len(axes) == 1 else axes)
+        batch_specs = jax.tree_util.tree_map(lambda _: bspec, batch)
+        rep = PartitionSpec()
+        rep_tree = jax.tree_util.tree_map(lambda _: rep, pc_specs,
+                                          is_leaf=is_spec)
+        return dict(axes=axes, outer=outer, outer_world=outer_world,
+                    inner=inner, inner_world=inner_world, wq=wq, gq=gq,
+                    pc=pc, pc_specs=pc_specs, batch_specs=batch_specs,
+                    rep=rep, rep_tree=rep_tree, is_spec=is_spec)
 
-        def gather_full(x, spec):
-            for dim, e in enumerate(spec):
-                if e is not None:
-                    return jax.lax.all_gather(x, "data", axis=dim, tiled=True)
-            return x
+    @staticmethod
+    def _facade_err_scalar(stats, axes):
+        """Replicated max quantization error: each rank's local max must
+        be pmax-reduced over the manual axes before the out_spec declares
+        it replicated — otherwise the host reads an arbitrary shard's
+        value and a single-rank bound violation is invisible."""
+        from ..comm import compressed as ccomm
+
+        local = (jnp.max(jnp.stack(stats)) if stats
+                 else jnp.zeros([], jnp.float32))
+        return ccomm.pmax(local, axes)
+
+    def _run_facade_spmd(self, spmd, env, batch, rng, scale, aux_spec):
+        """jit-traceable shard_map wrapper shared by the facade paths:
+        manual over the factored DP axes, replicated outputs, fp32 grad
+        cast (the linear master->compute chain rule)."""
+        from ..parallel.mesh import shard_map_compat
+
+        grads_c, loss, aux = shard_map_compat(
+            spmd, mesh=self.topo.mesh, axis_names=set(env["axes"]),
+            in_specs=(env["pc_specs"], env["batch_specs"], env["rep"],
+                      env["rep"]),
+            out_specs=(env["rep_tree"], env["rep"], aux_spec),
+            check_vma=False)(env["pc"], batch, rng, scale)
+        grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32),
+                                       grads_c)
+        return grads, loss, aux
+
+    def _loss_and_grads_qgz(self, params, batch, rng, scale):
+        """qgZ/qwZ through the compressed-collectives facade
+        (docs/communication.md): the stage-3 weight fetch is a facade
+        all-gather per sharded leaf — quantized across the outer 'data'
+        hop when qwZ is on, dense across the fast-ICI 'zshard' hop — and
+        the cross-replica gradient reduction is the hierarchical chunked
+        mean (fp reduce-scatter inside the slice, int8/int4 exchange on
+        the chunk across slices, fp all-gather back). Runs under
+        shard_map with the factored data-parallel axes manual; model/seq
+        axes stay on their GSPMD placement as before."""
+        from ..comm import compressed as ccomm
+
+        env = self._facade_prelude(params, batch)
+        wants_err = self._wants_quant_err
 
         def spmd(pc, mb, rng, scale):
+            stats = [] if wants_err else None
             pc_full = jax.tree_util.tree_map(
-                gather_full, pc, pc_specs,
-                is_leaf=lambda x: isinstance(x, PartitionSpec))
+                lambda x, spec: ccomm.gather_param_leaf(
+                    x, spec,
+                    outer_axes=(env["outer"],) if env["outer"] else (),
+                    qspec=env["wq"], stats=stats),
+                pc, env["pc_specs"], is_leaf=env["is_spec"])
 
             def scaled_loss(p):
                 loss, aux = self.loss_fn(p, mb, rng)
                 return loss.astype(jnp.float32) * scale, (loss, aux)
 
             grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(pc_full)
-            grads = tree_int8_pmean(grads, "data", world)
-            return grads, jax.lax.pmean(loss, "data"), aux
+            grads = ccomm.tree_hierarchical_pmean(
+                grads, outer_axis=env["outer"],
+                outer_world=env["outer_world"], inner_axis=env["inner"],
+                inner_world=env["inner_world"], qspec=env["gq"],
+                stats=stats)
+            loss = ccomm.pmean(loss, env["axes"])
+            if wants_err:
+                aux = dict(aux)
+                aux["quant_rel_err"] = self._facade_err_scalar(
+                    stats, env["axes"])
+            return grads, loss, aux
 
-        from ..parallel.mesh import shard_map_compat
+        return self._run_facade_spmd(spmd, env, batch, rng, scale,
+                                     aux_spec=env["rep"])
 
-        grads_c, loss, aux = shard_map_compat(
-            spmd, mesh=mesh, axis_names={"data"},
-            in_specs=(pc_specs, batch_specs, PartitionSpec(), PartitionSpec()),
-            out_specs=(jax.tree_util.tree_map(lambda _: PartitionSpec(), pc_specs,
-                                              is_leaf=lambda x: isinstance(x, PartitionSpec)),
-                       PartitionSpec(), PartitionSpec()),
-            check_vma=False)(pc, batch, rng, scale)
-        # chain through the (linear) master->compute cast
-        grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads_c)
-        return grads, loss, aux
+    def _loss_and_grads_staged(self, params, batch, rng, scale):
+        """T3-style staged ZeRO-3 step (parallel/zero.py
+        Zero3BlockSchedule): the model's sequential blocks run with
+        per-block facade collectives — block i+1's weight all-gather
+        issued before block i's forward, the backward re-gathers each
+        block (2-gather schedule, bounded param residency) and defers
+        the previous block's gradient reduce behind the current block's
+        compute — so the compiler can hide the ZeRO-3 comm behind
+        compute. Serial mode ("comm_compression.overlap": "serial")
+        issues each collective just-in-time instead; both orders are
+        bit-exact to each other (identical dataflow) and that is pinned
+        by tests."""
+        from ..comm import compressed as ccomm
+        from ..parallel.zero import Zero3BlockSchedule
+
+        env = self._facade_prelude(params, batch)
+        # per-block spec subtrees: zero3_blocks is structural in params
+        block_specs = self.model.zero3_blocks(env["pc_specs"], None).blocks
+        overlapped = self._staged_mode == "staged"
+        wants_err = self._wants_quant_err
+
+        def spmd(pc, mb, rng, scale):
+            stats = [] if wants_err else None
+            prog = self.model.zero3_blocks(pc, mb, rng)
+
+            def gather(i, blk):
+                return jax.tree_util.tree_map(
+                    lambda x, spec: ccomm.gather_param_leaf(
+                        x, spec,
+                        outer_axes=(env["outer"],) if env["outer"] else (),
+                        qspec=env["wq"], stats=stats),
+                    blk, block_specs[i], is_leaf=env["is_spec"])
+
+            def reduce(i, g):
+                return ccomm.tree_hierarchical_pmean(
+                    g, outer_axis=env["outer"],
+                    outer_world=env["outer_world"],
+                    inner_axis=env["inner"],
+                    inner_world=env["inner_world"], qspec=env["gq"],
+                    stats=stats)
+
+            sched = Zero3BlockSchedule(gather, reduce, overlapped=overlapped)
+            loss, block_grads = sched.loss_and_grads(prog, scale)
+            grads = prog.merge(block_grads)
+            loss = ccomm.pmean(loss.astype(jnp.float32), env["axes"])
+            aux = {}
+            if wants_err:
+                aux["quant_rel_err"] = self._facade_err_scalar(
+                    stats, env["axes"])
+            return grads, loss, aux
+
+        aux_spec = {"quant_rel_err": env["rep"]} if wants_err else {}
+        return self._run_facade_spmd(spmd, env, batch, rng, scale,
+                                     aux_spec=aux_spec)
 
     def _build_train_step(self):
         cfg = self.config
@@ -641,6 +814,8 @@ class TrainEngine:
             self._trace_counts["train_step"] += 1  # dslint: disable=trace-hygiene -- deliberate trace-time counter: bumps once per (re)trace, which IS the recompile telemetry
             scale = scaler_state.scale if fp16 else jnp.ones([], jnp.float32)
 
+            wants_err = self._wants_quant_err
+
             def micro(carry, mb):
                 acc, rng = carry
                 rng, sub = jax.random.split(rng)
@@ -648,8 +823,10 @@ class TrainEngine:
                 grads = jax.lax.with_sharding_constraint(grads, self.grad_shardings)
                 acc_g, acc_loss = acc
                 acc_g = jax.tree_util.tree_map(lambda a, g: a + g.astype(jnp.float32), acc_g, grads)
-                return ((acc_g, acc_loss + loss.astype(jnp.float32)), rng), None
+                err = _aux.get("quant_rel_err") if wants_err else None
+                return ((acc_g, acc_loss + loss.astype(jnp.float32)), rng), err
 
+            quant_err = None
             if gas > 1:
                 # [global_batch, ...] -> [gas, global_batch/gas, ...]
                 mb_batch = jax.tree_util.tree_map(
@@ -657,18 +834,22 @@ class TrainEngine:
                 zero_acc = jax.tree_util.tree_map(
                     lambda s: jnp.zeros(s.shape, jnp.float32), jax.eval_shape(lambda p: p, params))
                 zero_acc = jax.lax.with_sharding_constraint(zero_acc, self.grad_shardings)
-                (carry, rng), _ = jax.lax.scan(
+                (carry, rng), errs = jax.lax.scan(
                     micro, ((zero_acc, jnp.zeros([], jnp.float32)), rng), mb_batch)
                 grads, loss_sum = carry
                 inv = 1.0 / gas
                 grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
                 loss = loss_sum * inv
+                if wants_err:
+                    quant_err = jnp.max(errs)
             else:
                 rng, sub = jax.random.split(rng)
                 grads, loss, _aux = self._loss_and_grads(params, batch, sub, scale)
                 grads = jax.lax.with_sharding_constraint(
                     jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads),
                     self.grad_shardings)
+                if wants_err:
+                    quant_err = _aux["quant_rel_err"]
 
             new_params, new_opt, new_scaler, gnorm, skipped = self._update(
                 params, opt_state, scaler_state, grads, scale,
@@ -680,6 +861,10 @@ class TrainEngine:
                 "loss_scale": new_scaler.scale,
                 "skipped": skipped,
             }
+            if wants_err:
+                # max local quantization round-trip rel error across this
+                # step's facade collectives (docs/communication.md)
+                metrics["quant_rel_err"] = quant_err
             return new_params, new_opt, new_scaler, rng, metrics
 
         self._train_step_raw = train_step
@@ -698,6 +883,8 @@ class TrainEngine:
         scaler_sh = jax.tree_util.tree_map(lambda _: repl, self.scaler_state)
         metrics_sh = {"loss": repl, "grad_norm": repl, "loss_scale": repl,
                       "skipped": repl}
+        if self._wants_quant_err:
+            metrics_sh["quant_rel_err"] = repl
         return (self.param_shardings, self.opt_state_shardings, scaler_sh,
                 repl, metrics_sh)
 
@@ -1471,6 +1658,14 @@ class TrainEngine:
         if dt > 0 and self._step_flops and self._get_peak_flops():
             mfu = self._step_flops * n_steps / dt / self._get_peak_flops()
         host = host or {}
+        quant_err = None
+        if metrics.get("quant_rel_err") is not None:
+            # one extra host fetch, paid only when comm_compression.
+            # error_stats is on (docs/communication.md#error-bounds)
+            quant_err = float(metrics["quant_rel_err"])
+            from ..telemetry.registry import get_registry
+
+            get_registry().histogram("comm/quant_rel_err").observe(quant_err)
         return StepStats(
             step=self.global_steps,
             n_steps=n_steps,
@@ -1492,6 +1687,7 @@ class TrainEngine:
             optimizer_s=(phase_times or {}).get("optimizer"),
             comm_s=comm_s,
             comm=comm,
+            quant_rel_err=quant_err,
             memory=memory,
         )
 
@@ -1526,6 +1722,11 @@ class TrainEngine:
         dp = self.topo.data_parallel_size
         if dp <= 1 or not self._grad_bytes:
             return None
+        if self._qgz or self._staged_mode is not None:
+            # the facade paths record their own (quantized, wire-accurate)
+            # ledger entries at trace time — a synthetic dense booking on
+            # top would double-count traffic that never happens
+            return None
         from ..comm.comm import get_comms_logger
 
         log = get_comms_logger()
@@ -1543,10 +1744,11 @@ class TrainEngine:
             reg = get_registry()
             reg.counter(f"comm/{op}/calls").inc()
             reg.counter(f"comm/{op}/bytes").inc(self._grad_bytes)
+            reg.counter(f"comm/{op}/wire_bytes").inc(self._grad_bytes)
         durs = log.records.get(op, {}).get(self._grad_bytes, [])
         t = durs[0] if durs and durs[0] > 0 else 0.0
         return op, {"count": 1.0, "bytes": float(self._grad_bytes),
-                    "time_s": t}
+                    "wire_bytes": float(self._grad_bytes), "time_s": t}
 
     def _comm_step_delta(self):
         """Per-step comm breakdown: delta of the CommsLogger's cumulative
@@ -1565,8 +1767,8 @@ class TrainEngine:
         totals = get_comms_logger().snapshot_totals()
         if grad is not None and grad[0] in totals:
             cur = totals[grad[0]]
-            for k in ("count", "bytes", "time_s"):
-                cur[k] = max(0.0, cur[k] - grad[1][k])
+            for k in ("count", "bytes", "wire_bytes", "time_s"):
+                cur[k] = max(0.0, cur.get(k, 0.0) - grad[1].get(k, 0.0))
         delta: Dict[str, Dict[str, float]] = {}
         comm_s = 0.0
         for op, cur in totals.items():

@@ -65,6 +65,9 @@ STEP_RECORD_SCHEMA: Dict[str, tuple] = {
     "optimizer_s": ((float, int), False),
     "comm_s": ((float, int), False),
     "comm": ((dict,), True),
+    # max local quantization round-trip rel error across the step's
+    # compressed collectives (comm_compression.error_stats)
+    "quant_rel_err": ((float, int), False),
     "memory": ((dict,), True),
     "stalled": ((bool,), True),
     "n_steps": ((int,), False),
@@ -92,6 +95,7 @@ class StepStats:
     backward_s: Optional[float] = None
     optimizer_s: Optional[float] = None
     comm_s: Optional[float] = None
+    quant_rel_err: Optional[float] = None
     # optimizer steps covered by this record (>1 for train_steps(k) blocks)
     n_steps: int = 1
     # host-overhead ledger (see module docstring)
@@ -222,6 +226,12 @@ def validate_step_record(record: Dict[str, Any]) -> List[str]:
                 if not isinstance(entry.get(k), (int, float)) or \
                         isinstance(entry.get(k), bool):
                     errors.append(f"comm['{op}']['{k}'] missing or non-numeric")
+            # v2 bytes-on-wire ledger field: optional so archived v1
+            # snapshots keep validating, but must be numeric when present
+            if "wire_bytes" in entry and (
+                    not isinstance(entry["wire_bytes"], (int, float))
+                    or isinstance(entry["wire_bytes"], bool)):
+                errors.append(f"comm['{op}']['wire_bytes'] non-numeric")
     if isinstance(record.get("memory"), dict):
         for k, v in record["memory"].items():
             if not isinstance(v, (int, float)) or isinstance(v, bool):

@@ -95,6 +95,22 @@ if [ "$#" -eq 0 ]; then
     if [ "$smoke_rc" -eq 0 ]; then
         smoke_rc=$perf_rc
     fi
+
+    # quant-comm gate (CPU evidence lane, docs/communication.md): the
+    # compressed-collectives facade must show >= 2x wire-byte reduction
+    # on the int8 weight all-gather and >= 4x on the int4 inter-slice
+    # gradient hop per the bytes-on-wire ledger, quantization error
+    # within the documented QuantSpec bound, the staged T3 overlap
+    # schedule bit-exact to serial with compression off, zero recompiles
+    # in the overlapped fused scan, and the committed NORTHSTAR
+    # projection's overlapped zero3 comm exposure cut >= 50% vs the
+    # serial booking. The smoke sizes its own 8-device mesh.
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/quant_comm_smoke.py
+    qc_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$qc_rc
+    fi
 fi
 
 if [ "$dslint_rc" -ne 0 ]; then

@@ -203,6 +203,25 @@ def _run_child():
         # itself always equals the anchor for compute-bound configs)
         mfu_at_045_anchor = compute_s / max(compute_s / 0.45, comm_s)
 
+        # r06 (ROADMAP item 1, docs/communication.md): the compressed +
+        # overlapped projection. Wire volume scales by the ZeRO++ ratios
+        # (int8 qwZ weight gathers, int4 inter-slice qgZ hop); the T3
+        # staged schedule (parallel/zero.py Zero3BlockSchedule) splits
+        # the step's collectives into per-layer stages issued against the
+        # adjacent layer's compute, so only the pipeline fill/drain plus
+        # per-block excess stays exposed. Same analytic model the
+        # MULTICHIP comm lane and the quant-comm gate use.
+        from deepspeed_tpu.comm.compressed import QuantSpec, modeled_exposure
+
+        cc_model = modeled_exposure(
+            param_bytes=param_bytes, grad_bytes=param_bytes,
+            n_blocks=model.config.n_layers, compute_s=compute_eff_s,
+            link_bps=ici_eff, world=n,
+            weight_qspec=QuantSpec(8, 256), grad_qspec=QuantSpec(4, 256),
+            weight_itemsize=2, grad_itemsize=2)
+        exposed = cc_model["overlapped_compressed_s"]
+        mfu_overlapped = compute_s / max(compute_eff_s + exposed, 1e-12)
+
         # the ZeRO-3 collective schedule GSPMD emitted
         hlo = compiled.as_text()
         colls = {c: hlo.count(f" {c}(")
@@ -221,6 +240,14 @@ def _run_child():
             compute_s_at_measured_eff=round(compute_eff_s, 4),
             zero3_comm_s_if_serial=round(comm_s, 4),
             zero3_comm_gb_per_step=round(3 * param_bytes * (n - 1) / n / 1e9, 1),
+            # compressed + overlapped exposure (r06): what the staged
+            # schedule leaves exposed after int8 qwZ / int4 qgZ + per-
+            # block overlap; reduction is gated >= 50% in run_tests.sh
+            zero3_comm_exposed_s_overlapped=round(exposed, 4),
+            comm_compression={
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in cc_model.items()},
+            pred_mfu_overlapped=round(mfu_overlapped, 4),
             roofline_step_s=round(step_ceiling, 4),
             tokens_per_step=tokens,
             pred_tokens_per_sec_per_chip=round(tokens / n / step_ceiling, 1),
@@ -248,7 +275,10 @@ def _run_child():
         f"FITS: ZeRO-3 Llama-2 {'/'.join(models_ok)} compiles and fits "
         "v5p-64 HBM with headroom; pred_mfu_ceiling/floor bracket the "
         "45% target using the MEASURED single-chip MFU as the compute-"
-        "efficiency anchor (overlap fraction is the remaining unknown)"
+        "efficiency anchor, and the compressed+staged comm path "
+        "(comm/compressed.py + Zero3BlockSchedule) cuts the modeled "
+        "zero3 comm exposure vs the serial booking (see "
+        "zero3_comm_exposed_s_overlapped / comm_compression per config)"
         if ok else "DOES NOT FIT")
     sys.path.insert(0, os.path.join(HERE, "scripts"))
     from _artifact import write_artifact

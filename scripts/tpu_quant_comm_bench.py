@@ -1,14 +1,17 @@
-"""On-chip microbench for the quantized-collective (qwZ/qgZ) math.
+"""On-chip microbench for the compressed-collectives facade (qwZ/qgZ).
 
-The ZeRO++ claim is comm-volume savings: int8 weight gathers (qwZ, 4x
-fewer wire bytes than bf16... 2x vs bf16, 4x vs fp32) and two-hop int8
-gradient reduction (qgZ). On a single chip the wire is not measurable,
-but the COST side of the tradeoff is: the quantize/dequantize pack-unpack
-that brackets every collective. This driver times, compiled on the real
-chip at realistic ZeRO shard sizes:
+The ZeRO++ claim is comm-volume savings: int8 weight gathers (qwZ) and
+two-hop int4/int8 gradient reduction (qgZ). On a single chip the wire is
+not measurable, but the COST side of the tradeoff is: the
+quantize/(pack/unpack)/dequantize bracket the facade
+(``deepspeed_tpu.comm.compressed``) wraps around every compressed
+collective. This driver times, compiled on the real chip at realistic
+ZeRO shard sizes:
 
-  * quantize_blockwise int8 + dequantize (qwZ pack/unpack)
-  * int8_pmean's quant+dequant stages run WITHOUT the psum (qgZ pack cost)
+  * the facade's int8 bracket (``_quant_roundtrip`` with QuantSpec(8) —
+    the qwZ pack/unpack), Pallas and XLA-fallback variants
+  * the facade's int4 bracket INCLUDING nibble pack/unpack
+    (``pack_int4``/``unpack_int4`` — what the inter-host qgZ hop pays)
   * the dense bf16 copy baseline (what the unquantized path pays)
 
 and reports the break-even link bandwidth per shape: quantization wins
@@ -17,7 +20,9 @@ link bandwidth is BELOW  bytes_saved / pack_s. v5e ICI (~400 GB/s/chip
 class) vs DCN (~25 GB/s class) then says where qwZ/qgZ belong — the
 reference positions them the same way (hpZ keeps gathers inside the
 node; qwZ/qgZ earn their keep across slower links,
-blogs/zeropp/README.md).
+blogs/zeropp/README.md). Wire-byte accounting comes from
+``QuantSpec.wire_nbytes`` — the same numbers the bytes-on-wire ledger
+books at trace time, so bench and ledger cannot drift apart.
 
 Writes QUANT_COMM_<round>.json (round tag via DST_ROUND, default r05).
 Usage: python scripts/tpu_quant_comm_bench.py
@@ -80,52 +85,80 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from deepspeed_tpu.ops.quantizer import dequantize_blockwise, quantize_blockwise
+    from deepspeed_tpu.comm.compressed import QuantSpec, _quant_roundtrip
+    from deepspeed_tpu.ops.quantizer import pack_int4, unpack_int4
 
     assert jax.devices()[0].platform == "tpu", "requires a real TPU"
+    spec8 = QuantSpec(8, 256)
+    spec4 = QuantSpec(4, 256)
     report = {"metric": "quantized_collective_pack_cost",
               "device": jax.devices()[0].device_kind, "rows": []}
     rng = np.random.default_rng(0)
     for (numel,) in SHAPES:
         x = jnp.asarray(rng.standard_normal(numel), jnp.bfloat16)
 
-        def pack_unpack(v):
-            q, s, _ = quantize_blockwise(v.astype(jnp.float32), bits=8,
-                                         block=256)
-            return dequantize_blockwise(q, s, block=256).astype(jnp.bfloat16)
+        def int8_bracket(v):
+            # the facade's qwZ pack/unpack: quantize + dequantize
+            _, _, deq = _quant_roundtrip(v.astype(jnp.float32).reshape(-1),
+                                         spec8)
+            return deq.astype(jnp.bfloat16)
+
+        def int4_bracket(v):
+            # the qgZ inter-host hop's bracket incl. nibble pack/unpack
+            from deepspeed_tpu.ops.quantizer import (dequantize_blockwise,
+                                                     quantize_blockwise)
+
+            flat = v.astype(jnp.float32).reshape(-1)
+            q, s, _ = quantize_blockwise(flat, bits=4, block=spec4.block,
+                                         manual_sharding=True)
+            packed = pack_int4(q)
+            return dequantize_blockwise(
+                unpack_int4(packed), s, block=spec4.block,
+                manual_sharding=True).astype(jnp.bfloat16)
 
         def dense_copy(v):
             return (v.astype(jnp.float32) * 1.0000001).astype(jnp.bfloat16)
 
-        pack_ms = _chain_ms(pack_unpack, x)          # pallas (default on TPU)
+        pack_ms = _chain_ms(int8_bracket, x)         # pallas (default on TPU)
         os.environ["DST_NO_PALLAS_QUANT"] = "1"
         try:
-            xla_pack_ms = _chain_ms(pack_unpack, x)  # XLA fallback path
+            xla_pack_ms = _chain_ms(int8_bracket, x)  # XLA fallback path
         finally:
             os.environ.pop("DST_NO_PALLAS_QUANT", None)
+        int4_ms = _chain_ms(int4_bracket, x)
         dense_ms = _chain_ms(dense_copy, x)
         bf16_bytes = numel * 2
-        int8_bytes = numel * 1 + (numel // 256) * 4   # payload + scales
-        saved = bf16_bytes - int8_bytes
+        int8_bytes = spec8.wire_nbytes(numel)
+        int4_bytes = spec4.wire_nbytes(numel)
+        saved8 = bf16_bytes - int8_bytes
+        saved4 = bf16_bytes - int4_bytes
         # quantization wins when wire_bytes_saved / link_bw > pack_overhead
-        overhead_s = max(pack_ms - dense_ms, 1e-6) / 1e3
-        breakeven_gbps = saved / overhead_s / 1e9
+        over8_s = max(pack_ms - dense_ms, 1e-6) / 1e3
+        over4_s = max(int4_ms - dense_ms, 1e-6) / 1e3
+        breakeven8 = saved8 / over8_s / 1e9
+        breakeven4 = saved4 / over4_s / 1e9
         report["rows"].append({
             "numel": numel,
-            "pack_unpack_ms": round(pack_ms, 4),
-            "xla_pack_unpack_ms": round(xla_pack_ms, 4),
+            "int8_bracket_ms": round(pack_ms, 4),
+            "xla_int8_bracket_ms": round(xla_pack_ms, 4),
             "pallas_vs_xla": round(xla_pack_ms / pack_ms, 2),
+            "int4_bracket_ms": round(int4_ms, 4),
             "dense_baseline_ms": round(dense_ms, 4),
-            "wire_bytes_saved": saved,
-            "breakeven_link_gbps": round(breakeven_gbps, 1),
-            "wins_on_ici_400gbps": bool(breakeven_gbps > 400),
-            "wins_on_dcn_25gbps": bool(breakeven_gbps > 25),
+            "wire_bytes_saved_int8": saved8,
+            "wire_bytes_saved_int4": saved4,
+            "wire_ratio_int8_vs_bf16": round(bf16_bytes / int8_bytes, 2),
+            "wire_ratio_int4_vs_bf16": round(bf16_bytes / int4_bytes, 2),
+            "breakeven_link_gbps_int8": round(breakeven8, 1),
+            "breakeven_link_gbps_int4": round(breakeven4, 1),
+            "wins_on_ici_400gbps": bool(breakeven8 > 400),
+            "wins_on_dcn_25gbps": bool(breakeven8 > 25),
         })
         print(f"[quant-comm] {report['rows'][-1]}", flush=True)
     report["verdict"] = (
-        "int8 collectives pay off below the break-even link bandwidth; "
+        "facade brackets pay off below the break-even link bandwidth; "
         "rows where wins_on_ici_400gbps is false are DCN/cross-host "
-        "features (the reference's qwZ/qgZ positioning), not v5e-ICI wins")
+        "features (the reference's qwZ/qgZ positioning, and where the "
+        "comm_compression mesh-size threshold points), not v5e-ICI wins")
     sys.path.insert(0, os.path.join(HERE, "scripts"))
     from _artifact import write_artifact
 

@@ -1,0 +1,31 @@
+"""comm-facade rule fixture: raw jax.lax collectives planted in a file
+the path scope treats as a ZeRO-3 hot path (parallel/zero*.py)."""
+
+import jax
+import jax.lax as xlax
+from jax import lax
+from jax.lax import all_gather
+
+
+def dotted_chain(g):
+    return jax.lax.psum(g, "data")  # PLANT: raw jax.lax.psum
+
+
+def module_alias(g):
+    return lax.pmean(g, "data")  # PLANT: raw lax.pmean via from-import
+
+
+def import_as_alias(x):
+    return xlax.psum_scatter(x, "data", tiled=True)  # PLANT: import jax.lax as xlax
+
+
+def from_imported_name(x):
+    return all_gather(x, "data", axis=0, tiled=True)  # PLANT: from jax.lax import all_gather
+
+
+def inside_closure(params):
+    def spmd(p):
+        moved = lax.all_to_all(p, "data", 0, 0)  # PLANT: all_to_all in nested fn
+        return lax.ppermute(moved, "data", [(0, 1)])  # PLANT: ppermute
+
+    return spmd(params)

@@ -1,0 +1,45 @@
+"""comm-facade rule near-miss fixture: collective-looking calls that are
+NOT raw jax.lax collectives — facade routes, non-jax receivers, and
+non-collective lax ops. Zero findings expected."""
+
+import jax
+from jax import lax
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.comm import compressed as ccomm
+
+
+def facade_wrappers(x):
+    # the thin comm wrappers ARE the facade — allowed
+    y = comm.all_gather(x, "data", axis=0)
+    return comm.all_reduce(y, "data")
+
+
+def compressed_facade(x, spec):
+    g = ccomm.quantized_all_gather(x, "data", qspec=ccomm.QuantSpec(8, 256))
+    return ccomm.hierarchical_pmean(g, outer_axis="data", outer_world=4)
+
+
+def non_collective_lax(x):
+    # lax ops that move no wire are fine
+    y = lax.stop_gradient(x)
+    return jax.lax.with_sharding_constraint(y, None)
+
+
+class FakeLax:
+    def psum(self, x, axis):
+        return x
+
+
+def other_receiver(x):
+    # psum on a non-jax object: not jax.lax.psum
+    mylax = FakeLax()
+    return mylax.psum(x, "data")
+
+
+def shadowed_name(x):
+    # locally-defined function named like a collective, not from jax.lax
+    def psum(v, axis):
+        return v
+
+    return psum(x, "data")

@@ -130,13 +130,26 @@ def test_comms_events_flow_into_registry(logger_on):
         logger_on.append("all_reduce", 256, 0.0, 8, "data")
         logger_on.append("all_reduce", 256, 0.0, 8, "data")
         logger_on.append("all_gather", 128, 0.5, 8, "data")
+        # v2 ledger: a compressed op books physical wire bytes separately
+        logger_on.append("qwz_all_gather", 256, 0.0, 8, "data",
+                         wire_bytes=68)
         assert reg.counter("comm/all_reduce/calls").value == 2
         assert reg.counter("comm/all_reduce/bytes").value == 512
         assert reg.counter("comm/all_gather/calls").value == 1
+        # dense ops book wire == logical; compressed ops the quantized
+        # payload, and the trace-time-static ratio lands in a histogram
+        assert reg.counter("comm/all_reduce/wire_bytes").value == 512
+        assert reg.counter("comm/qwz_all_gather/wire_bytes").value == 68
+        assert reg.histogram(
+            "comm/qwz_all_gather/compression_ratio").mean == \
+            pytest.approx(256 / 68)
         totals = logger_on.snapshot_totals()
-        assert totals["all_reduce"] == {"count": 2, "bytes": 512, "time_s": 0.0}
+        assert totals["all_reduce"] == {"count": 2, "bytes": 512,
+                                        "wire_bytes": 512, "time_s": 0.0}
         assert totals["all_gather"] == {"count": 1, "bytes": 128,
+                                        "wire_bytes": 128,
                                         "time_s": pytest.approx(0.5)}
+        assert totals["qwz_all_gather"]["wire_bytes"] == 68
     finally:
         set_registry(old)
 

@@ -44,7 +44,8 @@ def test_rule_catalog():
     rules = all_rules()
     assert set(rules) == {"host-sync", "trace-hygiene",
                           "recompile-hazard", "lock-discipline",
-                          "exception-discipline", "wall-clock"}
+                          "exception-discipline", "wall-clock",
+                          "comm-facade"}
     assert "suppression" in known_rule_ids()
     for cls in rules.values():
         assert cls.summary
@@ -62,6 +63,10 @@ def test_rule_catalog():
     # scoped to the clocked layers by module path
     ("wall-clock", os.path.join("serving", "wall_clock_bad.py"),
      os.path.join("serving", "wall_clock_ok.py")),
+    # comm-facade fixtures sit under a parallel/ subdir named zero_*.py:
+    # the rule is scoped to the ZeRO-3 hot-path modules by file path
+    ("comm-facade", os.path.join("parallel", "zero_bad.py"),
+     os.path.join("parallel", "zero_ok.py")),
 ])
 def test_rule_golden(rule, bad, ok):
     bad_found = live(analyze([fixture(bad)]), rule)
@@ -104,6 +109,36 @@ def test_wall_clock_subchecks_all_fire():
              for f in live(analyze([fixture(os.path.join(
                  "serving", "wall_clock_bad.py"))]), "wall-clock")}
     assert {"direct-time", "raw-event-wait"} == codes
+
+
+def test_comm_facade_subchecks_fire_on_every_import_flavor():
+    found = live(analyze([fixture(os.path.join("parallel", "zero_bad.py"))]),
+                 "comm-facade")
+    assert {f.code for f in found} == {"raw-collective"}
+    # every import flavor resolves: jax.lax.X, lax alias, import-as,
+    # from-imported name, and collectives inside nested closures
+    assert len(found) == 6
+    flagged = {f.message.split("raw jax.lax.")[1].split(" ")[0]
+               for f in found}
+    assert {"psum", "pmean", "psum_scatter", "all_gather", "all_to_all",
+            "ppermute"} == flagged
+
+
+def test_comm_facade_out_of_scope_module_is_ignored():
+    # the same raw collectives OUTSIDE parallel/zero*.py / runtime/
+    # engine*.py are not this rule's business (ring/ulysses/compressed
+    # are the low-level implementation layer the facade wraps)
+    found = live(analyze([fixture("host_sync_bad.py")]), "comm-facade")
+    assert found == []
+
+
+def test_comm_facade_repo_hot_paths_clean():
+    # the shipped ZeRO-3 hot paths route every collective through the
+    # facade — the repo gate invariant this rule exists to keep
+    found = live(analyze([os.path.join(PKG, "parallel", "zero.py"),
+                          os.path.join(PKG, "runtime", "engine.py")]),
+                 "comm-facade")
+    assert found == []
 
 
 def test_wall_clock_out_of_scope_module_is_ignored():
