@@ -1,0 +1,259 @@
+"""Fused compute–collective Pallas kernels (docs/communication.md,
+"Kernel backends").
+
+PR 10 made the ZeRO-3 collectives cheap on the wire, but quantize/pack/
+dequantize still ran as their own XLA computations bracketing each
+collective, and overlap relied on the block schedule's coarse per-layer
+fill/drain windows. Following T3 (arxiv 2401.16677) and the fused
+computation-collective line (arxiv 2305.06942), these kernels move the
+compression bracket INTO the consuming/producing matmul:
+
+* :func:`dequant_matmul` — the all-gather consumer side: one kernel
+  dequantizes a quantized weight shard (nibble-unpack for int4, blockwise
+  scale multiply) and immediately multiplies it, so a ring all-gather can
+  run dequant+matmul on tile *i* while tile *i+1*'s shard is still in
+  flight (per-tile overlap instead of per-layer; the ring driver lives in
+  ``comm/backends.py`` so collectives stay behind the facade).
+* :func:`matmul_quantize` — the reduce-scatter producer side: the
+  grad-producing matmul's epilogue quantizes each output tile blockwise
+  (and nibble-packs int4) in-kernel, emitting the WIRE payload directly —
+  no separate quantize pass over the gradient in HBM.
+* :func:`matmul_pallas` — the dense twin (compression off), so the fused
+  path has a bit-exact dense A/B.
+
+Bit-exactness contract (enforced by tests/test_fused_collectives.py in
+interpret mode): the quantize/dequantize arithmetic is copied verbatim
+from ``ops/quantizer.py`` (same fp32 formula, same int clamps, same
+nibble layout as ``pack_int4``), and every matmul accumulates fp32 over
+the FULL contraction per output tile — output tiles split only
+non-contraction dimensions, which slices bit-exactly (splitting the
+contraction would reorder the fp32 accumulation; callers that need that
+fall back to the unfused facade instead).
+
+Layouts follow ``ops/pallas/quant.py``: quantized payloads travel as
+``[rows, block]`` int8 (``[rows, block//2]`` uint8 nibble-packed for
+int4) — exactly the facade's wire layout — and scales ride
+lane-replicated ``[rows, LANES]`` (the Mosaic tiling trick the flash
+kernel's LSE uses). Off-TPU callers run these kernels in interpret mode,
+like ``ops/pallas/flash_attention.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+
+
+def _m_tile(m: int) -> int:
+    """Largest row tile from {512, 256, 128, 64, 32, 16, 8} dividing
+    ``m``, else ``m`` whole (decode runs m == 1)."""
+    for t in (512, 256, 128, 64, 32, 16, 8):
+        if m % t == 0 and m >= t:
+            return t
+    return m
+
+
+def _unpack_nibbles(packed: jnp.ndarray, rows: int, block: int) -> jnp.ndarray:
+    """[rows, block//2] uint8 -> [rows, block] int32 in [-8, 7]; the
+    in-kernel inverse of ops.quantizer.pack_int4 (element 2k low nibble,
+    2k+1 high)."""
+    p = packed.astype(jnp.int32)
+    lo = p & 0x0F
+    hi = (p >> 4) & 0x0F
+    both = jnp.stack([lo, hi], axis=-1).reshape(rows, block)
+    return jnp.where(both >= 8, both - 16, both)
+
+
+def _pack_nibbles(q: jnp.ndarray, rows: int, block: int) -> jnp.ndarray:
+    """[rows, block] int8 in [-8, 7] -> [rows, block//2] uint8; the
+    in-kernel twin of ops.quantizer.pack_int4 (same pairing of
+    consecutive row-major elements)."""
+    pairs = q.astype(jnp.int32).reshape(rows, block // 2, 2)
+    lo = pairs[..., 0] & 0x0F
+    hi = (pairs[..., 1] & 0x0F) << 4
+    return (lo | hi).astype(jnp.uint8)
+
+
+# ----------------------------------------------------------------------
+# consumer side: dequantize + matmul in one kernel
+
+
+def _dequant_matmul_kernel(h_ref, q_ref, s_ref, o_ref, *, bits: int,
+                           block: int, k: int, b: int, w_dtype):
+    rows = k * b // block
+    q = q_ref[...]
+    if bits == 4:
+        q = _unpack_nibbles(q, rows, block)
+    # blockwise dequant — same fp32 arithmetic as dequantize_blockwise:
+    # int -> f32 is exact, then one multiply by the block scale
+    w = q.astype(jnp.float32) * s_ref[...][:, :1]
+    w = w.reshape(k, b).astype(w_dtype)
+    h = h_ref[...]
+    o_ref[...] = jax.lax.dot_general(
+        h, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def dequant_matmul(h: jnp.ndarray, payload: jnp.ndarray, scales: jnp.ndarray,
+                   *, bits: int, block: int, b: int,
+                   out_dtype=jnp.float32, w_dtype=jnp.float32,
+                   interpret: bool = False) -> jnp.ndarray:
+    """``h [m, k] @ dequant(payload, scales) [k, b] -> [m, b]`` with the
+    dequantize (nibble-unpack + blockwise scale) fused into the matmul
+    prologue. ``payload`` is the facade wire format: flat int8 values
+    (uint8 nibble-packed for bits=4) whose row-major reshape is the
+    weight tile; ``scales`` is the flat ``[k*b/block]`` fp32 vector."""
+    m, k = h.shape
+    rows = k * b // block
+    assert rows * block == k * b, (k, b, block)
+    q2 = payload.reshape(rows, block // 2 if bits == 4 else block)
+    s2 = jnp.broadcast_to(scales.reshape(rows, 1), (rows, LANES))
+    tile_m = _m_tile(m)
+    kernel = functools.partial(_dequant_matmul_kernel, bits=bits, block=block,
+                               k=k, b=b, w_dtype=w_dtype)
+    return pl.pallas_call(
+        kernel,
+        grid=(m // tile_m,),
+        in_specs=[
+            pl.BlockSpec((tile_m, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(q2.shape, lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile_m, b), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, b), out_dtype),
+        interpret=interpret,
+    )(h, q2, s2)
+
+
+# ----------------------------------------------------------------------
+# dense twin (compression off): plain tiled matmul
+
+
+def _matmul_kernel(a_ref, b_ref, o_ref):
+    o_ref[...] = jax.lax.dot_general(
+        a_ref[...], b_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def matmul_pallas(a: jnp.ndarray, b: jnp.ndarray, *, out_dtype=jnp.float32,
+                  interpret: bool = False) -> jnp.ndarray:
+    """``a [m, k] @ b [k, n] -> [m, n]`` (fp32 accumulation), tiled over
+    the m rows — the dense per-tile step of the fused ring all-gather."""
+    m, k = a.shape
+    n = b.shape[1]
+    tile_m = _m_tile(m)
+    return pl.pallas_call(
+        _matmul_kernel,
+        grid=(m // tile_m,),
+        in_specs=[
+            pl.BlockSpec((tile_m, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile_m, n), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        interpret=interpret,
+    )(a, b)
+
+
+# ----------------------------------------------------------------------
+# producer side: matmul with blockwise-quantize epilogue
+
+
+def _matmul_quantize_kernel(a_ref, b_ref, q_ref, s_ref, *, trans_a: bool,
+                            qmax: float, block: int, pack: bool,
+                            out_rows: int, n: int):
+    a = a_ref[...]
+    bb = b_ref[...]
+    dims = (((0,), (0,)), ((), ())) if trans_a else (((1,), (0,)), ((), ()))
+    t = jax.lax.dot_general(a, bb, dims, preferred_element_type=jnp.float32)
+    # epilogue: symmetric blockwise quantization of the tile, verbatim
+    # the quantize_blockwise formula (scale = absmax/qmax, 0 -> 1, clip
+    # round) so the emitted payload is bit-identical to the facade's
+    rows = out_rows * n // block
+    blocks = t.reshape(rows, block)
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(blocks / scale), -qmax - 1, qmax).astype(jnp.int8)
+    if pack:
+        q_ref[...] = _pack_nibbles(q, rows, block)
+    else:
+        q_ref[...] = q
+    s_ref[...] = jnp.broadcast_to(scale, (rows, LANES))
+
+
+def matmul_quantize(a: jnp.ndarray, b: jnp.ndarray, *, bits: int, block: int,
+                    trans_a: bool = False,
+                    interpret: bool = False
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The grad-producing matmul with its reduce-scatter quantization
+    fused into the epilogue: computes ``a.T @ b`` (``trans_a``, the
+    weight-gradient shape ``[k, m].T? -> [K, N]``) or ``a @ b``, then
+    blockwise-quantizes each output tile in-kernel and emits the WIRE
+    payload — ``(payload, scales)`` ready for
+    ``comm.compressed.quantized_chunk_exchange``. Payload is ``[rows,
+    block]`` int8, nibble-packed to ``[rows, block//2]`` uint8 for
+    bits=4; scales come back as the flat ``[rows]`` fp32 vector.
+
+    Output tiles split the non-contraction row dimension only (each tile
+    runs the full contraction in fp32), and a tile boundary never splits
+    a quantization block — both conditions the backend's fusability
+    predicate checks."""
+    assert bits in (4, 8)
+    qmax = 2.0 ** (bits - 1) - 1
+    if trans_a:
+        m, out_rows = a.shape  # a [m, K] contracted over m
+        n = b.shape[1]
+    else:
+        out_rows, m = a.shape  # a [M, k] contracted over k
+        n = b.shape[1]
+    numel = out_rows * n
+    assert numel % block == 0, (out_rows, n, block)
+    # tile the output rows only where row boundaries align with quant
+    # blocks (n a block multiple); otherwise run the tile whole
+    tile_r = _m_tile(out_rows) if n % block == 0 else out_rows
+    rows_tile = tile_r * n // block
+    rows = numel // block
+    pack = bits == 4
+    kernel = functools.partial(_matmul_quantize_kernel, trans_a=trans_a,
+                               qmax=qmax, block=block, pack=pack,
+                               out_rows=tile_r, n=n)
+    if trans_a:
+        a_spec = pl.BlockSpec((m, tile_r), lambda i: (0, i),
+                              memory_space=pltpu.VMEM)
+    else:
+        a_spec = pl.BlockSpec((tile_r, m), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    payload, s = pl.pallas_call(
+        kernel,
+        grid=(out_rows // tile_r,),
+        in_specs=[
+            a_spec,
+            pl.BlockSpec(b.shape, lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((rows_tile, block // 2 if pack else block),
+                         lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows_tile, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, block // 2 if pack else block),
+                                 jnp.uint8 if pack else jnp.int8),
+            jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(a, b)
+    return payload.reshape(-1), s[:, 0]

@@ -96,9 +96,14 @@ def fake_quantize(x: jnp.ndarray, bits: int = 8, block: int = 256,
 
 def quantized_nbytes(numel: int, bits: int, block: int) -> int:
     """Wire size of a quantized tensor (payload + scales) — the comm-volume
-    accounting behind ZeRO++'s 4x claim."""
-    payload = numel * bits // 8
-    scales = (numel // block) * 4
+    accounting behind ZeRO++'s 4x claim. Partial bytes round UP: an odd
+    numel at int4 still occupies the trailing half-filled byte on the
+    wire, and a ragged final block still carries a full fp32 scale —
+    flooring both under-reported the wire by up to 4 bytes + a nibble
+    (visible on the ste_quant_gather path, whose leaves need not
+    block-divide)."""
+    payload = (numel * bits + 7) // 8
+    scales = -(-numel // block) * 4
     return payload + scales
 
 
@@ -106,7 +111,18 @@ def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
     """Pack int4 values (int8 storage in [-8, 7], even length) two nibbles
     per byte, so an inter-host int4 collective really moves half the
     elements — the wire-volume claim is carried by the program, not just
-    the ledger. Layout: element 2k in the low nibble, 2k+1 in the high."""
+    the ledger. Layout: element 2k in the low nibble, 2k+1 in the high.
+
+    Requires an even total numel (nibbles pair) — checked explicitly,
+    because a silent floor-divide here would DROP the last element.
+    Non-contiguous inputs (transposes, strided views) are fine: the
+    flatten below copies into row-major order, and unpack_int4 restores
+    exactly that order."""
+    if q.size % 2:
+        raise ValueError(
+            f"pack_int4 needs an even number of elements (nibbles pair "
+            f"two-per-byte), got {q.size}; pad the tensor or use an even "
+            f"quantization block")
     flat = q.reshape(-1).astype(jnp.int32)
     lo = flat[0::2] & 0x0F
     hi = (flat[1::2] & 0x0F) << 4

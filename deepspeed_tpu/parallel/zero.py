@@ -259,6 +259,39 @@ def compute_param_bytes(param_shapes: Any) -> int:
 # collective immediately at its consumer for the A/B.
 
 @dataclass
+class MatmulBlockSpec:
+    """Optional per-block fusion hint for the kernel-backend seam
+    (comm/backends.py): declares that block i's forward is
+
+        h' = epilogue(h @ p[weight], rest_of_p_gathered, h)
+
+    with ``weight`` the key of the 2-D matmul weight inside the block's
+    (dict) param tree. A fused backend can then run the weight's
+    all-gather inside the consuming matmul (per-tile dequant+multiply)
+    and the weight gradient's reduce-scatter inside the grad matmul's
+    epilogue. The epilogue must be a pure function of its three
+    arguments — the engine differentiates through it with jax.vjp."""
+
+    weight: str
+    epilogue: Callable[[Any, Any, Any], Any]
+
+
+@dataclass
+class FusedBlockOps:
+    """Backend-fused forward/backward for one block of the staged
+    schedule (built by the engine from a :class:`MatmulBlockSpec` and a
+    CollectiveBackend). ``forward(block_shard, h) -> h'`` consumes the
+    SHARDED block params (the gather happens inside, fused);
+    ``backward(block_shard, h_in, g_out) -> (reduced_grad_tree, g_h)``
+    re-gathers what it needs and returns grads ALREADY reduced across
+    the ZeRO group (the reduce-scatter is fused into the grad matmul),
+    so the schedule skips its own gather/reduce for this block."""
+
+    forward: Callable[[Any, Any], Any]
+    backward: Callable[[Any, Any, Any], Tuple[Any, Any]]
+
+
+@dataclass
 class BlockProgram:
     """A model decomposed into sequential blocks for the staged ZeRO-3
     schedule. ``block_fns[i](p_i, h) -> h'`` consumes the FULL (gathered)
@@ -269,13 +302,19 @@ class BlockProgram:
     opts into the staged engine path by exposing
     ``zero3_blocks(params, batch, rng) -> BlockProgram``; the params
     argument must be handled structurally (the engine also calls it on a
-    PartitionSpec tree to learn per-block shardings)."""
+    PartitionSpec tree to learn per-block shardings).
+
+    ``matmul_blocks`` (optional, parallel to ``block_fns``) carries
+    :class:`MatmulBlockSpec` fusion hints; entries may be None and the
+    whole field may be None — blocks without a hint always run the
+    generic gather + ``block_fn`` path."""
 
     block_fns: List[Callable[[Any, Any], Any]]
     blocks: List[Any]
     h0: Any
     loss_tail: Callable[[Any], Any]
     merge: Callable[[List[Any]], Any]
+    matmul_blocks: Optional[List[Optional[MatmulBlockSpec]]] = None
 
 
 class Zero3BlockSchedule:
@@ -300,33 +339,50 @@ class Zero3BlockSchedule:
 
     def __init__(self, gather: Callable[[int, Any], Any],
                  reduce: Callable[[int, Any], Any],
-                 overlapped: bool = True):
+                 overlapped: bool = True,
+                 fused: Optional[dict] = None):
         self.gather = gather
         self.reduce = reduce
         self.overlapped = overlapped
+        # kernel-backend seam (comm/backends.py): {block index ->
+        # FusedBlockOps}. Fused blocks run their gather INSIDE the
+        # consuming matmul (per-tile ring) and return already-reduced
+        # grads (reduce-scatter in the grad matmul's epilogue), so the
+        # schedule issues no separate collectives for them; unfused
+        # blocks keep the per-block prefetch/defer issue order.
+        self.fused = fused or {}
 
     def loss_and_grads(self, prog: BlockProgram, scale) -> Tuple[Any, List[Any]]:
         """(loss, per-block grad trees). Grads are wrt the FULL block
         params (each rank's local-batch contribution, reduced across the
-        ZeRO group by ``reduce``); the loss comes back unreduced — the
-        caller averages it over the data axes."""
+        ZeRO group by ``reduce`` — or inside a fused block's backward);
+        the loss comes back unreduced — the caller averages it over the
+        data axes."""
         L = len(prog.block_fns)
         assert L == len(prog.blocks) and L > 0
+        fused = self.fused
+
+        def _gather(i):
+            # fused blocks gather inside their own kernels
+            return None if i in fused else self.gather(i, prog.blocks[i])
+
         # -- forward: prefetch next gather, save activations only
         hs: List[Any] = [prog.h0]
         h = prog.h0
-        full = self.gather(0, prog.blocks[0])
+        full = _gather(0)
         for i in range(L):
             nxt = None
             if self.overlapped and i + 1 < L:
                 # prefetch: next block's gather issued BEFORE this
                 # block's compute consumes anything
-                nxt = self.gather(i + 1, prog.blocks[i + 1])
-            h = prog.block_fns[i](full, h)
+                nxt = _gather(i + 1)
+            if i in fused:
+                h = fused[i].forward(prog.blocks[i], h)
+            else:
+                h = prog.block_fns[i](full, h)
             hs.append(h)
             if i + 1 < L:
-                full = nxt if self.overlapped else self.gather(
-                    i + 1, prog.blocks[i + 1])
+                full = nxt if self.overlapped else _gather(i + 1)
         loss, tail_vjp = jax.vjp(prog.loss_tail, h)
         (g_h,) = tail_vjp(jnp.ones_like(loss) * scale)
         # -- backward: re-gather + recompute each block's vjp; defer the
@@ -334,22 +390,24 @@ class Zero3BlockSchedule:
         grads: List[Any] = [None] * L
         pending = None
         pending_i = -1
-        full = self.gather(L - 1, prog.blocks[L - 1])
+        full = _gather(L - 1)
         for i in reversed(range(L)):
             nxt = None
             if self.overlapped and i > 0:
-                nxt = self.gather(i - 1, prog.blocks[i - 1])
-            _, vjp = jax.vjp(prog.block_fns[i], full, hs[i])
-            g_full, g_h = vjp(g_h)
-            if self.overlapped:
-                if pending is not None:
-                    grads[pending_i] = self.reduce(pending_i, pending)
-                pending, pending_i = g_full, i
+                nxt = _gather(i - 1)
+            if i in fused:
+                grads[i], g_h = fused[i].backward(prog.blocks[i], hs[i], g_h)
             else:
-                grads[i] = self.reduce(i, g_full)
+                _, vjp = jax.vjp(prog.block_fns[i], full, hs[i])
+                g_full, g_h = vjp(g_h)
+                if self.overlapped:
+                    if pending is not None:
+                        grads[pending_i] = self.reduce(pending_i, pending)
+                    pending, pending_i = g_full, i
+                else:
+                    grads[i] = self.reduce(i, g_full)
             if i > 0:
-                full = nxt if self.overlapped else self.gather(
-                    i - 1, prog.blocks[i - 1])
+                full = nxt if self.overlapped else _gather(i - 1)
         if pending is not None:
             grads[pending_i] = self.reduce(pending_i, pending)
         return loss, grads
@@ -412,7 +470,17 @@ class SequentialBlockModel:
         def merge(trees: List[Any]) -> Any:
             return {f"block_{i}": t for i, t in enumerate(trees)}
 
+        def epilogue(i):
+            # must mirror _apply_block exactly with y = h @ p["w"]
+            # precomputed — the fused path's bit-exactness against the
+            # generic path rides on this
+            last = i == L - 1
+            return lambda y, rest, h: (y + rest["b"] if last
+                                       else jnp.tanh(y + rest["b"]))
+
         h0 = batch["x"] if isinstance(batch, dict) else batch
         return BlockProgram(block_fns=[block_fn(i) for i in range(L)],
                             blocks=blocks, h0=h0, loss_tail=loss_tail,
-                            merge=merge)
+                            merge=merge,
+                            matmul_blocks=[MatmulBlockSpec("w", epilogue(i))
+                                           for i in range(L)])

@@ -111,6 +111,20 @@ if [ "$#" -eq 0 ]; then
     if [ "$smoke_rc" -eq 0 ]; then
         smoke_rc=$qc_rc
     fi
+
+    # fused-kernel gate (CPU evidence lane, docs/communication.md
+    # "Kernel backends"): the staged engine on the fused Pallas backend
+    # (interpret mode) must be BIT-exact to the XLA backend — losses
+    # and parameters, compressed and dense — with fusion engaging and
+    # structural fallbacks metered, zero recompiles across fused-scan
+    # steps, and the modeled per-tile exposure strictly below the PR-10
+    # per-layer block-schedule number
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/_comm_lane.py --fused
+    fused_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$fused_rc
+    fi
 fi
 
 if [ "$dslint_rc" -ne 0 ]; then

@@ -59,8 +59,34 @@ def _check_northstar() -> dict:
             _fail(f"{os.path.basename(path)}: overlapped zero3 comm "
                   f"exposure reduced only {worst:.0%} (< 50%) vs the "
                   f"serial booking")
+        # r07+: the fused kernel-backend projection must sit STRICTLY
+        # below the per-layer block-schedule number per config, and the
+        # committed decode MLP A/B must show fused < unfused
+        fused_rows = [r for r in rows
+                      if isinstance(r.get("comm_compression_fused"), dict)]
+        for r in fused_rows:
+            per_layer = r["comm_compression"]["overlapped_compressed_s"]
+            per_tile = r["comm_compression_fused"]["overlapped_compressed_s"]
+            if not per_tile < per_layer:
+                _fail(f"{os.path.basename(path)} [{r['name']}]: fused "
+                      f"per-tile exposure {per_tile} not strictly below "
+                      f"the per-layer number {per_layer}")
+        ab = report.get("decode_mlp_ab")
+        if fused_rows and not ab:
+            _fail(f"{os.path.basename(path)}: fused projection present "
+                  f"but no decode_mlp_ab committed")
+        if ab:
+            for leg in ("dense", "int8"):
+                row = ab.get(leg, {})
+                if not (row.get("decode_mlp_fused_s", 1e9)
+                        < row.get("decode_mlp_unfused_s", 0.0)):
+                    _fail(f"{os.path.basename(path)}: decode MLP A/B "
+                          f"({leg}) shows no fused win: {row}")
         print(f"[quant-comm] {os.path.basename(path)}: exposure reduction "
-              f">= {worst:.0%} across {len(rows)} configs", flush=True)
+              f">= {worst:.0%} across {len(rows)} configs"
+              + (f"; fused per-tile < per-layer on {len(fused_rows)} "
+                 f"configs + decode A/B" if fused_rows else ""),
+              flush=True)
         return {"artifact": os.path.basename(path),
                 "min_exposure_reduction": worst}
     _fail("no NORTHSTAR_r*.json with a comm_compression projection found")

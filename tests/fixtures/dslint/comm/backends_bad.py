@@ -1,0 +1,27 @@
+"""comm-facade rule fixture: raw jax.lax collectives planted in a file
+the path scope treats as a kernel-backend module (comm/backends*.py) —
+backends must route every wire hop through the facade, never call
+jax.lax collectives directly."""
+
+import jax
+from jax import lax
+from jax.lax import ppermute
+
+
+class LeakyBackend:
+    def all_gather_matmul(self, h, w_shard, axis_name):
+        # a backend doing its own ring hop instead of cc.ring_permute
+        nxt = ppermute(w_shard, axis_name, [(0, 1)])  # PLANT: from-imported ppermute
+        return h @ nxt
+
+    def matmul_reduce_scatter(self, h, g, axis_name):
+        dw = h.T @ g
+        return jax.lax.psum_scatter(dw, axis_name, tiled=True)  # PLANT: raw psum_scatter
+
+    def matmul_all_reduce(self, x, w, axis_name):
+        y = x @ w
+        return lax.psum(y, axis_name)  # PLANT: raw psum via from-import alias
+
+
+def helper_exchange(payload, axis_name):
+    return jax.lax.all_to_all(payload, axis_name, 0, 0)  # PLANT: raw all_to_all

@@ -1,0 +1,34 @@
+"""comm-facade rule near-miss fixture for kernel-backend modules: a
+backend whose wire hops all route through the facade, plus
+collective-looking non-collectives. Zero findings expected."""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm import compressed as cc
+
+
+class CleanBackend:
+    def all_gather_matmul(self, h, w_shard, axis_name, world):
+        # ring hop through the metered facade helper
+        nxt = cc.ring_permute(w_shard, axis_name, world=world,
+                              op="qwz_all_gather_ring")
+        # dot_general moves no wire — not a collective
+        return jax.lax.dot_general(h, nxt, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    def matmul_all_reduce(self, x, w, axis_name):
+        y = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        return cc.chunked_all_reduce(y, axis_name, reduce="sum")
+
+    def exchange(self, payload, scales, n, axis_name, world, qspec):
+        return cc.quantized_chunk_exchange(
+            payload, scales, n=n, axis_name=axis_name, world=world,
+            qspec=qspec, op_prefix="qgz_inter")
+
+
+def index_math(x, axis_name):
+    # axis_index moves no wire
+    me = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_update_slice(x, x[:1], (me,))
