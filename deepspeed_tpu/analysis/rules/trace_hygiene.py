@@ -27,6 +27,16 @@ _TELEMETRY_CALLS = {"record_step", "record_request",
                     "record_request_span", "log_dist", "get_telemetry"}
 _REGISTRY_FACTORIES = {"counter", "histogram", "gauge"}
 _REGISTRY_OPS = {"inc", "observe"}
+# request-tracer entry points (telemetry/tracing.py): spans/events and
+# flight-recorder appends observe the HOST side of a step — inside a
+# jitted body they would fire once at trace time and (worse) read the
+# clock seam into a compiled constant. Distinctive names match any call
+# shape; the generic ones (span/event/note) only as METHOD calls
+# (tracer.span(...), flight.note(...)) so an unrelated local helper
+# named `note` inside traced code is not hijacked.
+_TRACER_CALLS = {"new_trace", "begin_span", "finish_span",
+                 "span_complete", "get_tracer", "note_span"}
+_TRACER_METHOD_CALLS = {"span", "event", "note"}
 
 
 def _module_of(mod: ModuleInfo, func: ast.AST) -> Optional[str]:
@@ -133,6 +143,16 @@ class TraceHygieneRule(Rule):
                 message=f"{name}() inside traced code breaks the "
                         f"zero-sync-when-off contract — record on the "
                         f"host after the step returns{why}")
+        elif name in _TRACER_CALLS or (
+                isinstance(node.func, ast.Attribute)
+                and name in _TRACER_METHOD_CALLS):
+            yield Finding(
+                rule=self.id, code="tracer-call", path=mod.key,
+                line=node.lineno, col=node.col_offset, symbol=f.qualname,
+                message=f"{name}() (request tracer / flight recorder) "
+                        f"inside traced code would fire once at trace "
+                        f"time with a trace-time clock stamp — span on "
+                        f"the host, around the step call{why}")
         elif isinstance(node.func, ast.Attribute) \
                 and name in _REGISTRY_OPS:
             # x.inc(...) / x.observe(...): registry series mutation

@@ -418,6 +418,13 @@ class TelemetryConfig:
     # serving-request span records (docs/serving.md); None defaults to
     # <output_dir>/requests.jsonl, "" disables the sink
     requests_jsonl_path: Optional[str] = None
+    # request-scoped distributed tracing + flight recorder
+    # (telemetry/tracing.py, docs/observability.md). Off by default:
+    # zero extra host syncs / clock reads on every hot path.
+    tracing: bool = False
+    trace_ring: int = 4096          # finished-span ring buffer size
+    flight_capacity: int = 512      # flight-recorder ring size
+    flight_dump_dir: Optional[str] = None  # auto-dump dir; None = in-memory
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryConfig":
@@ -437,7 +444,15 @@ class TelemetryConfig:
             stall_warmup_steps=int(_take(d, "stall_warmup_steps", 2)),
             heartbeat_path=_take(d, "heartbeat_path", None),
             requests_jsonl_path=_take(d, "requests_jsonl_path", None),
+            tracing=bool(_take(d, "tracing", False)),
+            trace_ring=int(_take(d, "trace_ring", 4096)),
+            flight_capacity=int(_take(d, "flight_capacity", 512)),
+            flight_dump_dir=_take(d, "flight_dump_dir", None),
         )
+        if out.trace_ring < 1 or out.flight_capacity < 1:
+            raise ConfigError(
+                "telemetry.trace_ring and telemetry.flight_capacity must "
+                f"be >= 1, got {out.trace_ring}/{out.flight_capacity}")
         if out.stall_factor <= 1.0:
             raise ConfigError(
                 f"telemetry.stall_factor must exceed 1.0, got {out.stall_factor}")

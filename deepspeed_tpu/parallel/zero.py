@@ -317,6 +317,18 @@ class BlockProgram:
     matmul_blocks: Optional[List[Optional[MatmulBlockSpec]]] = None
 
 
+def _probed(probe, phase: str, i: int, fn):
+    """Measurement seam for the schedule's per-block phases. ``probe``
+    is a plain callable ``(phase, block_index, thunk) -> thunk()``
+    installed ONLY by the host-side overlap profiler
+    (profiling/overlap.py), which times each phase around the thunk; in
+    every jitted use probe is None and this is a plain call — identical
+    dataflow, no trace-time side effects."""
+    if probe is None:
+        return fn()
+    return probe(phase, i, fn)
+
+
 class Zero3BlockSchedule:
     """Explicit per-block forward/backward with pluggable (compressed)
     collectives. ``gather(i, block_shard) -> block_full`` and
@@ -340,7 +352,8 @@ class Zero3BlockSchedule:
     def __init__(self, gather: Callable[[int, Any], Any],
                  reduce: Callable[[int, Any], Any],
                  overlapped: bool = True,
-                 fused: Optional[dict] = None):
+                 fused: Optional[dict] = None,
+                 probe: Optional[Callable] = None):
         self.gather = gather
         self.reduce = reduce
         self.overlapped = overlapped
@@ -351,6 +364,10 @@ class Zero3BlockSchedule:
         # schedule issues no separate collectives for them; unfused
         # blocks keep the per-block prefetch/defer issue order.
         self.fused = fused or {}
+        # per-block phase-timing seam (see :func:`_probed`): None on
+        # every jitted path; the overlap profiler installs one to time
+        # gather/fwd/regather/bwd/reduce per block on the host
+        self.probe = probe
 
     def loss_and_grads(self, prog: BlockProgram, scale) -> Tuple[Any, List[Any]]:
         """(loss, per-block grad trees). Grads are wrt the FULL block
@@ -361,10 +378,17 @@ class Zero3BlockSchedule:
         L = len(prog.block_fns)
         assert L == len(prog.blocks) and L > 0
         fused = self.fused
+        probe = self.probe
 
-        def _gather(i):
+        def _gather(i, phase="gather"):
             # fused blocks gather inside their own kernels
-            return None if i in fused else self.gather(i, prog.blocks[i])
+            if i in fused:
+                return None
+            return _probed(probe, phase, i,
+                           lambda: self.gather(i, prog.blocks[i]))
+
+        def _reduce(i, g):
+            return _probed(probe, "reduce", i, lambda: self.reduce(i, g))
 
         # -- forward: prefetch next gather, save activations only
         hs: List[Any] = [prog.h0]
@@ -377,9 +401,11 @@ class Zero3BlockSchedule:
                 # block's compute consumes anything
                 nxt = _gather(i + 1)
             if i in fused:
-                h = fused[i].forward(prog.blocks[i], h)
+                h = _probed(probe, "fwd", i,
+                            lambda: fused[i].forward(prog.blocks[i], h))
             else:
-                h = prog.block_fns[i](full, h)
+                h = _probed(probe, "fwd", i,
+                            lambda: prog.block_fns[i](full, h))
             hs.append(h)
             if i + 1 < L:
                 full = nxt if self.overlapped else _gather(i + 1)
@@ -390,26 +416,32 @@ class Zero3BlockSchedule:
         grads: List[Any] = [None] * L
         pending = None
         pending_i = -1
-        full = _gather(L - 1)
+        full = _gather(L - 1, phase="regather")
         for i in reversed(range(L)):
             nxt = None
             if self.overlapped and i > 0:
-                nxt = _gather(i - 1)
+                nxt = _gather(i - 1, phase="regather")
             if i in fused:
-                grads[i], g_h = fused[i].backward(prog.blocks[i], hs[i], g_h)
+                grads[i], g_h = _probed(
+                    probe, "bwd", i,
+                    lambda: fused[i].backward(prog.blocks[i], hs[i], g_h))
             else:
-                _, vjp = jax.vjp(prog.block_fns[i], full, hs[i])
-                g_full, g_h = vjp(g_h)
+                def _bwd(i=i, full=full, g=g_h):
+                    _, vjp = jax.vjp(prog.block_fns[i], full, hs[i])
+                    return vjp(g)
+
+                g_full, g_h = _probed(probe, "bwd", i, _bwd)
                 if self.overlapped:
                     if pending is not None:
-                        grads[pending_i] = self.reduce(pending_i, pending)
+                        grads[pending_i] = _reduce(pending_i, pending)
                     pending, pending_i = g_full, i
                 else:
-                    grads[i] = self.reduce(i, g_full)
+                    grads[i] = _reduce(i, g_full)
             if i > 0:
-                full = nxt if self.overlapped else _gather(i - 1)
+                full = nxt if self.overlapped else _gather(i - 1,
+                                                           phase="regather")
         if pending is not None:
-            grads[pending_i] = self.reduce(pending_i, pending)
+            grads[pending_i] = _reduce(pending_i, pending)
         return loss, grads
 
 

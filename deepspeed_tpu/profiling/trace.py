@@ -8,6 +8,14 @@ XLA profiler — ``trace()`` captures a TensorBoard-loadable trace
 ``instrument`` put named ranges on the host track exactly where the
 reference put NVTX ranges, and ``step`` marks step boundaries so the
 profiler's step view groups ops per training step.
+
+The request tracer (``telemetry/tracing.py``) bridges onto the same
+host track: while :func:`trace` is active (:func:`trace_active`), every
+scoped tracer span also opens a profiler annotation with the same name,
+so tracer timelines line up with the device timeline in
+TensorBoard/Perfetto. This module must stay import-safe with profiling
+off — jax is imported lazily and every entry point degrades to a no-op
+when it is unavailable.
 """
 
 from __future__ import annotations
@@ -16,30 +24,77 @@ import contextlib
 import functools
 from typing import Iterator, Optional
 
-import jax
+
+_warned_no_jax = False
+
+
+def _jax():
+    """Lazy jax handle; None when jax is not installed (profiling off /
+    stripped environments — annotations degrade to no-ops, with one
+    warning so a requested capture never fails silently). A jax that is
+    installed but BROKEN still raises loudly — only a clean ImportError
+    is the degrade path."""
+    global _warned_no_jax
+    try:
+        import jax
+
+        return jax
+    except ImportError:
+        if not _warned_no_jax:
+            _warned_no_jax = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "jax unavailable: profiler traces/annotations are no-ops")
+        return None
+
+
+# nesting depth of active profiler captures (trace() is re-entrant in
+# principle; the tracer bridge only needs "is anything capturing")
+_ACTIVE = 0
+
+
+def trace_active() -> bool:
+    """True while a :func:`trace` capture is running — the signal the
+    request tracer uses to bridge spans onto the profiler host track."""
+    return _ACTIVE > 0
 
 
 @contextlib.contextmanager
 def trace(logdir: str, create_perfetto_link: bool = False) -> Iterator[None]:
     """Capture an XLA profiler trace into ``logdir`` (view with
     TensorBoard's profile plugin)."""
+    global _ACTIVE
+    jax = _jax()
+    if jax is None:
+        yield
+        return
     jax.profiler.start_trace(logdir,
                              create_perfetto_link=create_perfetto_link)
+    _ACTIVE += 1
     try:
         yield
     finally:
+        _ACTIVE -= 1
         jax.profiler.stop_trace()
 
 
 def annotate(name: str):
     """Named range on the profiler's host track (the range_push/range_pop
-    analog). Usable as a context manager."""
+    analog). Usable as a context manager; a no-op context when jax is
+    unavailable."""
+    jax = _jax()
+    if jax is None:
+        return contextlib.nullcontext()
     return jax.profiler.TraceAnnotation(name)
 
 
 def step(step_num: int):
     """Step-boundary annotation: groups device ops under one training step
     in the profiler's step view."""
+    jax = _jax()
+    if jax is None:
+        return contextlib.nullcontext()
     return jax.profiler.StepTraceAnnotation("train", step_num=step_num)
 
 
@@ -51,7 +106,7 @@ def instrument(fn=None, *, name: Optional[str] = None):
 
         @functools.wraps(f)
         def inner(*args, **kwargs):
-            with jax.profiler.TraceAnnotation(label):
+            with annotate(label):
                 return f(*args, **kwargs)
 
         return inner

@@ -165,6 +165,13 @@ class FaultInjector:
         from ..telemetry.registry import get_registry
 
         get_registry().counter(f"resilience/chaos/{kind}").inc()
+        # injected faults land in the flight recorder's black box too,
+        # so a post-mortem dump shows the injection next to its fallout
+        from ..telemetry.tracing import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.flight.note("injected_fault", fault=kind)
 
     def _crash(self, kind: str) -> None:
         self._count(kind)

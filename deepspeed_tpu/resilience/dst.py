@@ -46,6 +46,7 @@ from ..inference.ragged import (BlockedAllocator, PoolExhausted, PrefixCache,
                                 SequenceDescriptor, block_balance_report)
 from ..telemetry.registry import MetricsRegistry
 from ..telemetry.telemetry import Telemetry, set_telemetry
+from ..telemetry.tracing import Tracer, trace_tree_problems, use_tracer
 from ..utils.logging import logger
 from .chaos import FaultInjector, TickFault, install_fault_injector
 from .clock import SimClock, use_clock
@@ -573,10 +574,17 @@ class InvariantAuditor:
     an empty list after every event of every schedule is the soak's
     pass condition."""
 
-    def __init__(self, fleet, clock, capture: _CaptureTelemetry) -> None:
+    def __init__(self, fleet, clock, capture: _CaptureTelemetry,
+                 tracer: Optional[Tracer] = None) -> None:
         self.fleet = fleet
         self.clock = clock
         self.capture = capture
+        self.tracer = tracer
+        # trace_ids whose tree was already audited: each request's tree
+        # is checked ONCE, when it first turns terminal — re-scanning
+        # the whole span ring per terminal request per tick would make
+        # the soak quadratic in run length
+        self._trees_checked: set = set()
         self._last_now = clock.now()
 
     def audit(self, tracked: List[_Tracked]) -> List[str]:
@@ -652,6 +660,20 @@ class InvariantAuditor:
             if t.delivered != list(t.req.tokens):
                 v.append(f"[delivery] r{t.ix}: delivered {t.delivered} != "
                          f"emitted {list(t.req.tokens)}")
+        # 7. trace-tree connectivity: a terminal request's spans — across
+        # however many replicas served it (failover, disagg hand-off) —
+        # must form ONE closed connected tree: exactly one root, no
+        # orphan parents, nothing left open
+        if self.tracer is not None and self.tracer.enabled:
+            for t in tracked:
+                root = getattr(t.req, "_trace_root", None)
+                if not t.req.is_terminal or root is None or root.is_noop \
+                        or root.trace_id in self._trees_checked:
+                    continue
+                self._trees_checked.add(root.trace_id)
+                for p in trace_tree_problems(
+                        self.tracer.spans_for_trace(root.trace_id)):
+                    v.append(f"[trace-tree] r{t.ix}: {p}")
         return v
 
     def final(self, tracked: List[_Tracked], engines: List[SimEngine]
@@ -693,6 +715,13 @@ class SimReport:
     cancelled: int
     rejected: int
     tokens: Dict[int, List[int]]          # logical ix -> emitted stream
+    # canonical hash of the run's span tree (telemetry/tracing.py): the
+    # second determinism witness — same seed, same request timelines
+    span_hash: str = ""
+    n_spans: int = 0
+    # the span timeline (span dicts), kept only for failing runs so
+    # dump_repro can ship the event timeline with the repro
+    spans: Optional[List[Dict[str, Any]]] = None
 
     @property
     def ok(self) -> bool:
@@ -700,6 +729,7 @@ class SimReport:
 
     def summary(self) -> Dict[str, Any]:
         return {"seed": self.seed, "trace_hash": self.trace_hash,
+                "span_hash": self.span_hash, "n_spans": self.n_spans,
                 "violations": self.violations, "ticks": self.n_ticks,
                 "events": self.n_events, "submitted": self.submitted,
                 "finished": self.finished, "cancelled": self.cancelled,
@@ -725,6 +755,12 @@ def run_schedule(schedule: Schedule,
     clock = SimClock()
     capture = _CaptureTelemetry()
     injector = _ScheduledFaultInjector()
+    # a FRESH tracer per run: span/trace ids restart from 1, so two runs
+    # of the same schedule in one process produce identical canonical
+    # hashes (the bit-determinism witness trace_smoke gates); the flight
+    # recorder stays in-memory (no dump dir) and auto-dumps on the first
+    # invariant violation so a repro carries the black box too
+    tracer = Tracer(enabled=True, ring_size=16384, flight_capacity=2048)
     prev_telemetry = get_telemetry()
     # set_telemetry(capture) below also swaps the process-default
     # registry; restoring telemetry alone would leave the default
@@ -744,7 +780,7 @@ def run_schedule(schedule: Schedule,
     tracked: List[_Tracked] = []
     violations: List[str] = []
     n_ticks = 0
-    with use_clock(clock):
+    with use_clock(clock), use_tracer(tracer):
         set_telemetry(capture)
         install_fault_injector(injector)
         try:
@@ -752,7 +788,8 @@ def run_schedule(schedule: Schedule,
             fleet = ServingFleet(factory, dict(schedule.fleet_cfg),
                                  dict(schedule.serving_cfg),
                                  preemption_guard=guard, start=False)
-            auditor = InvariantAuditor(fleet, clock, capture)
+            auditor = InvariantAuditor(fleet, clock, capture,
+                                       tracer=tracer)
             events = sorted(schedule.events, key=_event_order)
             i = 0
             while True:
@@ -795,6 +832,12 @@ def run_schedule(schedule: Schedule,
             violations.extend(auditor.audit(tracked))
             violations.extend(auditor.final(tracked, engines))
             trace.finish(tracked)
+            if violations:
+                # invariant-audit failure: snapshot the black box (in
+                # memory — dump_repro ships it with the repro artifact)
+                tracer.flight.note("invariant_audit_failed",
+                                   n_violations=len(violations))
+                tracer.flight.dump("invariant-audit")
         finally:
             install_fault_injector(None)
             set_telemetry(prev_telemetry
@@ -809,7 +852,10 @@ def run_schedule(schedule: Schedule,
         finished=sum(s is RequestState.FINISHED for s in states),
         cancelled=sum(s is RequestState.CANCELLED for s in states),
         rejected=sum(s is RequestState.REJECTED for s in states),
-        tokens={t.ix: list(t.req.tokens) for t in tracked})
+        tokens={t.ix: list(t.req.tokens) for t in tracked},
+        span_hash=tracer.canonical_hash(), n_spans=len(tracer.spans()),
+        spans=([s.to_dict() for s in tracer.spans()]
+               if violations else None))
 
 
 def _apply_event(fleet, ev: SimEvent, tracked: List[_Tracked], guard,
@@ -903,13 +949,19 @@ def shrink_schedule(schedule: Schedule,
 
 
 def dump_repro(schedule: Schedule, violations: List[str],
-               path: str) -> str:
+               path: str,
+               timeline: Optional[List[Dict[str, Any]]] = None) -> str:
     """Write a failing (ideally shrunk) schedule as a JSON regression
-    artifact; ``load_repro`` + ``run_schedule`` replays it exactly."""
+    artifact; ``load_repro`` + ``run_schedule`` replays it exactly.
+    ``timeline`` (``SimReport.spans``) attaches the failing run's span
+    timeline, so the repro says not just *what* broke but *when/where*
+    along each request's life."""
+    payload: Dict[str, Any] = {"version": 1, "violations": violations,
+                               "schedule": schedule.to_dict()}
+    if timeline is not None:
+        payload["timeline"] = timeline
     with open(path, "w", encoding="utf-8") as fh:
-        json.dump({"version": 1, "violations": violations,
-                   "schedule": schedule.to_dict()}, fh, indent=1,
-                  sort_keys=True)
+        json.dump(payload, fh, indent=1, sort_keys=True)
         fh.write("\n")
     return path
 

@@ -1375,6 +1375,25 @@ class TrainEngine:
     def eval_step_cache_size(self) -> int:
         return _jit_cache_size(self._eval_step_fn)
 
+    def overlap_report(self, batch: Any, repeats: int = 3,
+                       **kwargs) -> Dict[str, Any]:
+        """Measured (not modeled) comm-overlap accounting for the staged
+        ZeRO-3 schedule (profiling/overlap.py): drives this engine's
+        block program eagerly with per-phase fenced timing, joins wire
+        bytes from the CommsLogger ledger, and compares measured comm
+        exposure against ``modeled_exposure`` under a calibrated
+        bandwidth. Requires the staged path (model exposes
+        ``zero3_blocks`` and the mesh factors a data-parallel axis);
+        never touches the jitted step programs."""
+        if self._staged_mode is None:
+            raise ValueError(
+                "overlap_report needs the staged ZeRO-3 path (stage 3, a "
+                "zero3_blocks model, comm_compression.overlap != 'off' "
+                "and a >1 data-parallel mesh axis)")
+        from ..profiling.overlap import overlap_report
+
+        return overlap_report(self, batch, repeats=repeats, **kwargs)
+
     def _note_batch_sig(self, batch: Any, program: str = "train_step") -> None:
         """Recompile guard: a batch signature (leaf shapes/dtypes) this
         program has not seen misses its jit cache and compiles a whole new
@@ -1762,6 +1781,21 @@ class TrainEngine:
         if dt > 0 and self._step_flops and self._get_peak_flops():
             mfu = self._step_flops * n_steps / dt / self._get_peak_flops()
         host = host or {}
+        # distributed-tracing join: when a tracer is installed, the step
+        # lands as one "train/step" span and the record carries its ids
+        # (telemetry/tracing.py). Off by default: one attribute check.
+        trace_id = span_id = None
+        from ..telemetry.tracing import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled:
+            from ..resilience.clock import get_clock
+
+            t_end = get_clock().time()
+            sp = tracer.span_complete(
+                "train/step", t_end - dt, t_end, track="train",
+                step=self.global_steps, n_steps=n_steps)
+            trace_id, span_id = sp.trace_id, sp.span_id
         quant_err = None
         if metrics.get("quant_rel_err") is not None:
             # one extra host fetch, paid only when comm_compression.
@@ -1793,6 +1827,8 @@ class TrainEngine:
             comm=comm,
             quant_rel_err=quant_err,
             memory=memory,
+            trace_id=trace_id,
+            span_id=span_id,
         )
 
     def _count_batch_tokens(self) -> int:

@@ -53,6 +53,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..resilience.clock import Clock, get_clock
+from ..telemetry.tracing import get_tracer, request_event
 from ..utils.logging import log_dist, logger
 from .request import Request, RequestState
 from .router import (NoHealthyReplica, PrefixAffinityRouter, RouterPolicy,
@@ -286,6 +287,13 @@ class ServingFleet:
         # must not split a request's lifecycle across two timebases)
         req._clock = self._clock
         req.t_submit = self._clock.now()
+        # tracing: the root opens HERE, before routing, so the router
+        # decision is the tree's first child even for fleet-level sheds
+        tracer = get_tracer()
+        if tracer.enabled:
+            req._trace_root = tracer.new_trace(
+                "request", prompt_tokens=len(req.prompt),
+                priority=req.priority)
         self._route(req)
         self._flush_shed()
         return req
@@ -298,10 +306,20 @@ class ServingFleet:
         DEAD) replicas. A pick whose driver stopped between the view
         snapshot and the enqueue refuses non-terminally; the loop places
         the request elsewhere."""
+        tracer = get_tracer()
+        if requeue:
+            request_event(req, "reroute")
         refused: set = set()
         while True:
+            # the router decision is a span of its own on the request's
+            # tree: replica pick + (for the affinity ring) hit/miss/spill
+            # verdict, one span per routing attempt
+            route_span = tracer.begin_span(
+                "route", getattr(req, "_trace_root", None),
+                requeue=bool(requeue), attempt=len(refused))
             with self._lock:
                 if not self._accepting and not requeue:
+                    tracer.finish_span(route_span, error="fleet closed")
                     self._reject(req, "fleet closed to new requests")
                     return
                 if self.config.disaggregated:
@@ -321,21 +339,31 @@ class ServingFleet:
                 else:
                     view = self._view(live=requeue, refused=refused)
                 if not view:
+                    tracer.finish_span(route_span, error="no replica")
                     self._reject(req, "no healthy replica")
                     return
                 try:
                     name = self.router.route(view, req.prompt)
                 except NoHealthyReplica:
+                    tracer.finish_span(route_span, error="no replica")
                     self._reject(req, "no healthy replica")
                     return
                 if isinstance(self.router, PrefixAffinityRouter):
                     self._count("affinity_hits"
                                 if self.router.last_was_primary
                                 else "affinity_misses")
+                # router verdict captured under the lock (router state
+                # mutates per route()); the span finishes only after the
+                # enqueue, so a refused pick is marked as such and the
+                # trace shows which replica actually ACCEPTED
+                route_info = self.router.route_info()
                 self._requests[req.uid] = (req, name)
                 replica = self._replicas[name]
-            if replica.serving.submit_request(req, requeue=requeue) \
-                    is not None:
+            accepted = replica.serving.submit_request(
+                req, requeue=requeue) is not None
+            tracer.finish_span(route_span, replica=name,
+                               accepted=accepted, **route_info)
+            if accepted:
                 self._count("routed")
                 return
             refused.add(name)      # stopped mid-race: try the next one
@@ -565,6 +593,7 @@ class ServingFleet:
             if orphans:
                 self._count("failovers", len(orphans))
             for req in orphans:
+                request_event(req, "failover", source=source)
                 if req._cancel_requested:
                     # honor the pending cancel here (its replica is gone)
                     # with the full terminal contract: span + counter,

@@ -130,6 +130,13 @@ class Request:
         # fleet-internal: hand this request from its prefill replica to a
         # decode replica once its first token resolves (disaggregated mode)
         self._handoff_requested = False
+        # distributed tracing (telemetry/tracing.py): the request's open
+        # root span and its current lifecycle segment. Both stay None
+        # with tracing off; the tree travels WITH the request across
+        # replicas (failover, disaggregated hand-off) so its whole life
+        # is one connected trace.
+        self._trace_root = None
+        self._trace_seg = None
 
     # -- state machine --------------------------------------------------
     def transition(self, new: RequestState) -> None:

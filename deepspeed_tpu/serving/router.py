@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import bisect
 import hashlib
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 
 class NoHealthyReplica(RuntimeError):
@@ -79,6 +79,13 @@ class RouterPolicy:
               prompt: Sequence[int]) -> str:
         raise NotImplementedError
 
+    def route_info(self) -> Dict[str, Any]:
+        """Attrs describing the LAST ``route()`` verdict — consumed by
+        the fleet's per-request "route" tracer span (telemetry/
+        tracing.py) so the affinity hit/miss decision is visible on the
+        request's timeline. Stateless routers report nothing."""
+        return {}
+
     # membership hooks (stateful routers maintain a ring)
     def on_join(self, replica: str) -> None:
         pass
@@ -125,6 +132,8 @@ class PrefixAffinityRouter(RouterPolicy):
         # set by route(): True when the last pick was the ring's primary
         # owner (an affinity hit), False on ring-walk fallback or spill
         self.last_was_primary: Optional[bool] = None
+        # set by route(): True when the spill valve redirected the pick
+        self.last_spilled: bool = False
 
     # -- membership ------------------------------------------------------
     def on_join(self, replica: str) -> None:
@@ -193,12 +202,18 @@ class PrefixAffinityRouter(RouterPolicy):
             # membership drifted (replica joined the fleet but not the
             # ring yet, or vice versa): degrade to least-loaded
             chosen = least_loaded_pick(replicas)
+        self.last_spilled = False
         if self.spill_load > 0 and replicas[chosen] >= self.spill_load:
             alt = least_loaded_pick(replicas)
             if replicas[alt] < replicas[chosen]:
                 chosen = alt
+                self.last_spilled = True
         self.last_was_primary = (chosen == primary)
         return chosen
+
+    def route_info(self) -> Dict[str, Any]:
+        return {"affinity_hit": self.last_was_primary,
+                "spilled": self.last_spilled}
 
 
 def make_router(name: str, *, block_size: int = 16, vnodes: int = 64,

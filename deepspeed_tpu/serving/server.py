@@ -48,6 +48,9 @@ import numpy as np
 
 from ..inference.ragged import PoolExhausted
 from ..resilience.clock import Clock, get_clock
+from ..telemetry.tracing import (begin_request_segment, end_request_segment,
+                                 ensure_request_root, finish_request_trace,
+                                 get_tracer, request_event)
 from ..utils.logging import log_dist, logger
 from .request import Request, RequestState
 from .scheduler import CapacityView, SchedulerPolicy, make_policy
@@ -61,6 +64,15 @@ def emit_request_span(telemetry, req: Request) -> None:
     died)."""
     from ..telemetry.spans import RequestStats
 
+    # terminal trace closure lives HERE because every terminal request
+    # passes through exactly once (replica retire backlog, fleet shed,
+    # failover-cancel) — the root span ends with the request, whatever
+    # killed it, and the span/ledger join keys ride the record below
+    finish_request_trace(req, state=req.state.value,
+                         new_tokens=len(req.tokens),
+                         preemptions=req.preemptions, retries=req.retries,
+                         error=req.error)
+    root = getattr(req, "_trace_root", None)
     if not telemetry.enabled:
         return
     n = len(req.tokens)
@@ -95,7 +107,11 @@ def emit_request_span(telemetry, req: Request) -> None:
         # for single-token requests
         tokens_per_s=((n - 1) / decode_s if decode_s and n > 1 else None),
         preemptions=req.preemptions, retries=req.retries,
-        in_slo=in_slo, error=req.error))
+        in_slo=in_slo, error=req.error,
+        trace_id=(root.trace_id if root is not None and not root.is_noop
+                  else None),
+        span_id=(root.span_id if root is not None and not root.is_noop
+                 else None)))
 
 
 def stream_tokens(server, prompt: Sequence[int], **kwargs):
@@ -268,6 +284,11 @@ class ServingEngine:
         req._clock = self._clock
         if req.t_submit is None:
             req.t_submit = self._clock.now()
+        # tracing: single-engine submissions open the root here (the
+        # fleet opens it earlier, around routing); every (re)queue is a
+        # fresh "queue" segment on the owning replica's track
+        ensure_request_root(req, prompt_tokens=len(req.prompt),
+                            priority=req.priority)
         with self._lock:
             if requeue and self._stop_evt.is_set():
                 return None
@@ -294,9 +315,20 @@ class ServingEngine:
                 self._reject(req, "admission queue full")
             else:
                 self._requests[req.uid] = req
-                self._queue.append(req)
+                self._enqueue_locked(req, requeue=bool(requeue))
         self._flush_spans()
         return req
+
+    def _enqueue_locked(self, req: Request, *, requeue: bool = False,
+                        **attrs) -> None:
+        """Append to the admission queue (serving lock held) and open
+        the request's "queue" trace segment — the append + segment pair
+        lives HERE only, so every (re-)queue edge — fresh submit,
+        preemption, tick-fault retry, adopt fallback, handoff-callback
+        recovery — lands on the request's tree."""
+        self._queue.append(req)
+        begin_request_segment(req, "queue", track=self.replica_id,
+                              requeue=requeue, **attrs)
 
     def adopt(self, req: Request, kv_export) -> bool:
         """Hand-off arrival (disaggregated decode replica): take over a
@@ -360,6 +392,9 @@ class ServingEngine:
                 orphans.append(req)
             for req, _ in self._handoff_backlog:  # exported + released
                 orphans.append(req)
+            for req in orphans:
+                request_event(req, "evacuate", replica=self.replica_id)
+                end_request_segment(req, outcome="evacuated")
             self._queue.clear()
             self._live.clear()
             self._adoptions.clear()
@@ -528,6 +563,14 @@ class ServingEngine:
                 logger.warning(
                     f"ServingEngine: tick {self._tick_count} stuck for "
                     f"> {timeout:.0f}s (device call wedged?)")
+                tracer = get_tracer()
+                if tracer.enabled:
+                    # black box of the ticks leading into the wedge
+                    # (watchdog thread; no serving lock held here)
+                    tracer.flight.note("stuck_tick",
+                                       replica=self.replica_id,
+                                       tick=self._tick_count)
+                    tracer.flight.dump("watchdog-stuck-tick")
 
     def _check_latch(self) -> None:
         """Preemption-latch poll, at the top of every tick (driver thread
@@ -539,12 +582,19 @@ class ServingEngine:
             return
         logger.warning("ServingEngine: preemption latched — draining "
                        "(finishing live requests, rejecting the queue)")
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.flight.note("preemption_latch", replica=self.replica_id)
         with self._lock:
             self._accepting = False
             for req in list(self._queue):
                 self._queue.remove(req)
                 self._reject(req, "preemption drain")
         self._flush_spans()
+        if tracer.enabled:
+            # auto-dump the black box at the latch (outside the lock:
+            # the dump is file I/O when a dump dir is configured)
+            tracer.flight.dump("preemption-latch")
 
     def _tick(self) -> bool:
         """One driver iteration: latch poll, adoptions, cancellations,
@@ -622,7 +672,7 @@ class ServingEngine:
                 # no emitted token to continue from — nothing a KV import
                 # can resume; take the ordinary prefill path instead
                 with self._lock:
-                    self._queue.append(req)
+                    self._enqueue_locked(req, requeue=True)
                 continue
             if not self._engine._free_slots:
                 # slot exhaustion is TRANSIENT (a live decode finishing
@@ -639,8 +689,11 @@ class ServingEngine:
                     f"failed ({type(e).__name__}: {e}); falling back to "
                     f"re-prefill")
                 self._count("adopt_fallbacks")
+                request_event(req, "adopt_fallback",
+                              replica=self.replica_id,
+                              reason=type(e).__name__)
                 with self._lock:
-                    self._queue.append(req)
+                    self._enqueue_locked(req, requeue=True)
                 continue
             with self._lock:
                 req.transition(RequestState.PREFILL)
@@ -652,6 +705,9 @@ class ServingEngine:
                 # the last one continues the greedy stream bit-exactly
                 req._pending_token = req.tokens[-1]
                 self._live[req.uid] = req
+                begin_request_segment(req, "decode",
+                                      track=self.replica_id,
+                                      imported_pages=export.n_pages)
             self._count("adopted")
         if deferred:
             with self._lock:
@@ -670,6 +726,8 @@ class ServingEngine:
             self._engine.clear_resume(req.uid)   # leaves this engine for good
             req.transition(RequestState.QUEUED)
             req._pending_token = None
+            begin_request_segment(req, "handoff", track=self.replica_id,
+                                  pages=export.n_pages)
             with self._lock:
                 self._handoff_backlog.append((req, export))
                 self._handoffs_in_flight -= 1
@@ -717,6 +775,9 @@ class ServingEngine:
             req._pending_token = None
             self._live[req.uid] = req
             capacity.charge(req)
+            begin_request_segment(req, "prefill", track=self.replica_id,
+                                  policy=self.policy.name,
+                                  resume_tokens=len(req.tokens))
             self._count("admitted")
 
     def _preempt(self, victim: Request) -> None:
@@ -725,7 +786,9 @@ class ServingEngine:
         victim.transition(RequestState.QUEUED)
         victim.preemptions += 1
         victim._pending_token = None
-        self._queue.append(victim)
+        request_event(victim, "preempt", replica=self.replica_id,
+                      tokens_in=len(victim.tokens))
+        self._enqueue_locked(victim, requeue=True)
         self._count("preempted")
         logger.info(f"ServingEngine: preempted request {victim.uid} "
                     f"(priority {victim.priority}, "
@@ -807,6 +870,7 @@ class ServingEngine:
         self._count("tick_faults")
         logger.warning(f"ServingEngine: tick {self._tick_count} fault: "
                        f"{type(exc).__name__}: {exc}")
+        budget_spent = False
         with self._lock:
             for uid in uids:
                 self._release_engine_state(uid, publish=False)
@@ -814,6 +878,8 @@ class ServingEngine:
                 if req is None:
                     continue
                 req._pending_token = None
+                request_event(req, "tick_fault", replica=self.replica_id,
+                              error=type(exc).__name__, retry=req.retries)
                 if req._cancel_requested:
                     # no point retrying a request the caller already
                     # abandoned (cancel landed while put() was in flight)
@@ -822,11 +888,22 @@ class ServingEngine:
                 req.retries += 1
                 if req.retries <= self.config.tick_retry_limit:
                     req.transition(RequestState.QUEUED)
-                    self._queue.append(req)
+                    self._enqueue_locked(req, requeue=True,
+                                         retry=req.retries)
                 else:
                     req.error = (f"tick fault after {req.retries - 1} "
                                  f"retries: {exc}")
+                    budget_spent = True
                     self._retire(req, RequestState.CANCELLED)
+        if budget_spent:
+            tracer = get_tracer()
+            if tracer.enabled:
+                # retry budget exhausted: dump the black box (outside
+                # the serving lock — the dump may write a file)
+                tracer.flight.note("tick_fault_retry_exhausted",
+                                   replica=self.replica_id,
+                                   tick=self._tick_count)
+                tracer.flight.dump("tick-fault-exhausted")
 
     def _dispatch(self, uids, logits: np.ndarray
                   ) -> Tuple[List[Request], List[Tuple[Request, int]],
@@ -852,6 +929,8 @@ class ServingEngine:
                 req.transition(RequestState.DECODE)
                 if req.t_first_token is None:
                     req.t_first_token = now
+                begin_request_segment(req, "decode",
+                                      track=self.replica_id)
             req.tokens.append(tok)
             req._pending_token = tok
             if req.on_token is not None:
@@ -936,7 +1015,7 @@ class ServingEngine:
                     f"{req.uid}; re-queueing locally")
                 with self._lock:
                     self._requests[req.uid] = req
-                    self._queue.append(req)
+                    self._enqueue_locked(req, requeue=True)
 
     def _flush_spans(self) -> None:
         """Emit deferred request spans OUTSIDE the serving lock (the

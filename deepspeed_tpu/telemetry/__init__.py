@@ -38,3 +38,14 @@ from .telemetry import (  # noqa: F401
     get_telemetry,
     set_telemetry,
 )
+from .tracing import (  # noqa: F401
+    FlightRecorder,
+    Span,
+    Tracer,
+    configure_tracing,
+    get_tracer,
+    set_tracer,
+    trace_tree_problems,
+    use_tracer,
+    validate_chrome_trace,
+)

@@ -86,7 +86,18 @@ class Heartbeat:
     def beat(self, step: int) -> None:
         from ..resilience.clock import get_clock  # lazy: import-order cycle
         from ..utils.fileio import write_json_atomic
+        from .tracing import get_tracer
 
+        # flight-recorder health rides the heartbeat so an external
+        # watcher sees recorder depth / drops / the last auto-dump path
+        # without attaching to the process (docs/observability.md).
+        # With tracing off these are static zeros — same file shape.
+        flight = get_tracer().flight
         write_json_atomic(self.path, {"step": int(step),
                                       "time": get_clock().time(),
-                                      "state": "running"})
+                                      "state": "running",
+                                      "flight_depth": flight.depth,
+                                      "flight_dropped": flight.dropped,
+                                      "flight_dumps": flight.dumps,
+                                      "flight_last_dump":
+                                          flight.last_dump_path})

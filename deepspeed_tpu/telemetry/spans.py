@@ -74,6 +74,12 @@ STEP_RECORD_SCHEMA: Dict[str, tuple] = {
     "host_ms": ((float, int), False),
     "data_wait_ms": ((float, int), False),
     "dispatch_gap_ms": ((float, int), False),
+    # distributed-tracing join keys (telemetry/tracing.py): present only
+    # when a tracer is installed. Optional — NOT a schema-version bump —
+    # with the same discipline as client_request_id/wire_bytes: archived
+    # v1/v2 JSONL streams predate them and must keep validating.
+    "trace_id": ((str,), False),
+    "span_id": ((str,), False),
 }
 
 
@@ -102,6 +108,9 @@ class StepStats:
     host_ms: Optional[float] = None
     data_wait_ms: Optional[float] = None
     dispatch_gap_ms: Optional[float] = None
+    # tracing join keys: the tracer's "train/step" span for this record
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
     # per-op comm breakdown: {op: {"count": int, "bytes": int, "time_s": float}}
     comm: Dict[str, Dict[str, float]] = field(default_factory=dict)
     # device-memory watermarks from utils/memory.py (hbm_peak_gb, ...)
@@ -144,6 +153,11 @@ REQUEST_RECORD_SCHEMA: Dict[str, tuple] = {
     "retries": ((int,), True),
     "in_slo": ((bool,), False),
     "error": ((str,), False),
+    # distributed-tracing join keys (telemetry/tracing.py): the request's
+    # trace and its root span. Optional — archived v1/v2 streams predate
+    # tracing and keep validating (same discipline as client_request_id).
+    "trace_id": ((str,), False),
+    "span_id": ((str,), False),
 }
 
 _REQUEST_STATES = ("finished", "cancelled", "rejected",
@@ -169,6 +183,9 @@ class RequestStats:
     retries: int = 0
     in_slo: Optional[bool] = None      # None = request carried no SLO
     error: Optional[str] = None
+    # tracing join keys: the request's trace and root span (tracer on)
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
     timestamp: float = field(default_factory=_clock_timestamp)
 
     def to_record(self) -> Dict[str, Any]:

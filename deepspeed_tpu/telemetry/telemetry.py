@@ -86,6 +86,16 @@ class Telemetry:
             if req_path is None:
                 req_path = os.path.join(out_dir, "requests.jsonl")
             self._requests_path = req_path if writer_rank else None
+            # request-scoped tracing + flight recorder (tracing.py):
+            # installing the pipeline installs (or, with tracing off,
+            # CLEARS) its tracer — same process-global discipline as
+            # set_telemetry/set_registry, and re-initializing with
+            # tracing=false must actually turn a previous tracer off.
+            # Disabled Telemetry stubs (enabled=false) never touch the
+            # tracer: a directly-installed one must survive them.
+            from .tracing import configure_tracing
+
+            configure_tracing(config)
         if monitor is not None:
             self.sinks.append(MonitorSink(monitor))
         self.enabled = enabled

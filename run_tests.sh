@@ -125,6 +125,21 @@ if [ "$#" -eq 0 ]; then
     if [ "$smoke_rc" -eq 0 ]; then
         smoke_rc=$fused_rc
     fi
+
+    # trace lane (CPU evidence lane, docs/observability.md "Tracing &
+    # flight recorder"): a seeded DST schedule run twice must produce
+    # bit-identical canonical span-tree hashes; the Chrome-trace export
+    # must pass the schema check; a planted tick-fault with a spent
+    # retry budget must auto-dump the flight recorder to disk; and
+    # engine.overlap_report()'s MEASURED comm exposure must agree with
+    # modeled_exposure within the documented band (TIMELINE_r01.json)
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python scripts/trace_smoke.py
+    trace_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$trace_rc
+    fi
 fi
 
 if [ "$dslint_rc" -ne 0 ]; then

@@ -73,7 +73,9 @@ def main() -> int:
         sched = generate_schedule(seed)
         kinds_seen |= {e.kind for e in sched.events}
         report = run_schedule(sched)
-        hashes[seed] = report.trace_hash
+        # both determinism witnesses: the event trace AND the request
+        # span tree (telemetry/tracing.py canonical hash)
+        hashes[seed] = (report.trace_hash, report.span_hash)
         for k in ("submitted", "finished", "cancelled", "rejected"):
             totals[k] += getattr(report, k)
         totals["ticks"] += report.n_ticks
@@ -89,7 +91,8 @@ def main() -> int:
     for seed in range(args.seed_base, args.seed_base + args.schedules,
                       REPLAY_STRIDE):
         replayed += 1
-        if run_schedule(generate_schedule(seed)).trace_hash != hashes[seed]:
+        rep = run_schedule(generate_schedule(seed))
+        if (rep.trace_hash, rep.span_hash) != hashes[seed]:
             mismatches.append(seed)
     wall = time.monotonic() - t0
 
@@ -132,7 +135,14 @@ def main() -> int:
         except ValueError:
             shrunk = generate_schedule(seed)   # flaked? dump it unshrunk
         repro = os.path.join(HERE, f"DST_REPRO_{seed}.json")
-        dump_repro(shrunk, violations, repro)
+        # re-run the shrunk schedule so the dumped violations AND span
+        # timeline come from the SAME run (run_schedule keeps spans only
+        # on failing runs); if the shrink flaked into passing, fall back
+        # to the original seed's violations with no timeline
+        shrunk_report = run_schedule(shrunk)
+        dump_repro(shrunk,
+                   shrunk_report.violations or violations, repro,
+                   timeline=shrunk_report.spans)
         print(f"[dst-soak] seed {seed}: minimal repro "
               f"({len(shrunk.events)} events) -> {repro}")
 

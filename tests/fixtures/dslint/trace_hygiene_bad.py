@@ -18,3 +18,13 @@ class Layer:
             return carry + x + t + n, x
 
         return jax.lax.scan(body, 0.0, xs)
+
+    def traced_step(self, tracer, flight, xs):
+        def body(carry, x):
+            tracer.event(None, "tick")       # PLANT: tracer-call (event)
+            flight.note("step", x=1)         # PLANT: tracer-call (note)
+            with tracer.span("block"):       # PLANT: tracer-call (span)
+                carry = carry + x
+            return carry, x
+
+        return jax.lax.scan(body, 0.0, xs)

@@ -144,6 +144,11 @@ def test_quantized_all_gather_fallback_is_dense_bitexact():
     log = get_comms_logger()
     old_enabled = log.enabled
     configure_comms_logger(True)
+    # the ledger is process-global and cumulative: start from a clean
+    # slate or any earlier test that recorded a COMPRESSED qwz row
+    # (e.g. the overlap profiler's measurement drives) breaks the
+    # wire == logical assertion below
+    log.reset()
     old_reg = get_registry()
     reg = set_registry(MetricsRegistry())
     try:

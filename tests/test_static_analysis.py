@@ -94,6 +94,14 @@ def test_host_sync_subchecks_all_fire():
             "block_until_ready-call"} <= codes
 
 
+def test_trace_hygiene_subchecks_all_fire():
+    codes = {f.code
+             for f in live(analyze([fixture("trace_hygiene_bad.py")]),
+                           "trace-hygiene")}
+    assert {"global-stmt", "wall-clock", "np-random", "attr-mutation",
+            "telemetry-call", "tracer-call"} <= codes
+
+
 def test_recompile_subchecks_all_fire():
     codes = {f.code
              for f in live(analyze([fixture("recompile_bad.py")]),
