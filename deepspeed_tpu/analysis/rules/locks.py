@@ -2,9 +2,12 @@
 
 The serving layer's documented discipline (docs/serving.md):
 
-* lock ORDER is fleet -> replica (``ServingFleet._lock`` before
-  ``ServingEngine._lock``); any path acquiring them in reverse can
-  deadlock against the monitor/driver threads;
+* lock ORDER is region -> cell -> fleet -> replica (``Region._lock``
+  before ``ServingCell._lock`` before ``ServingFleet._lock`` before
+  ``ServingEngine._lock``); any path acquiring a pair in reverse can
+  deadlock against the monitor/driver threads — which is why every
+  upward callback (fleet->region retire hooks, route/hand-off
+  escalation) is invoked OUTSIDE the caller's own lock;
 * spans, KV export/import and handoff callbacks run OUTSIDE the serving
   lock — sink I/O or a multi-MB page copy under it stalls every
   ``submit()``/``cancel()``/tick on the replica;
@@ -42,8 +45,10 @@ from ..registry import Rule, register
 
 # Documented lock order, outermost first, matched by "Class.attr"
 # suffix so the rule also drives the fixture corpus. Source of truth:
-# docs/serving.md ("fleet -> replica").
+# docs/serving.md ("region -> cell -> fleet -> replica").
 DOCUMENTED_LOCK_ORDER: Sequence[str] = (
+    "Region._lock",
+    "ServingCell._lock",
     "ServingFleet._lock",
     "ServingEngine._lock",
 )
@@ -80,9 +85,9 @@ class _Summary:
 @register
 class LockDisciplineRule(Rule):
     id = "lock-discipline"
-    summary = ("lock-order cycles vs the documented fleet->replica "
-               "order; blocking calls and user callbacks under a held "
-               "lock")
+    summary = ("lock-order cycles vs the documented region->cell->"
+               "fleet->replica order; blocking calls and user callbacks "
+               "under a held lock")
 
     def run(self, pkg: PackageModel) -> Iterator[Finding]:
         self.pkg = pkg

@@ -822,6 +822,13 @@ class ChaosConfig:
     # replica_die_at_tick ticks (-1 disables; one-shot)
     replica_die_at_tick: int = -1
     replica_die_index: int = 0
+    # kill serving cell #cell_die_index (whole failure domain) once any
+    # of its replicas has run cell_die_at_tick ticks (-1 disables)
+    cell_die_at_tick: int = -1
+    cell_die_index: int = 0
+    # delay every fleet autoscaler decision by this many (virtual)
+    # seconds — models real controller observe/decide/boot lag
+    autoscaler_lag_s: float = 0.0
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ChaosConfig":
@@ -846,7 +853,14 @@ class ChaosConfig:
             serving_tick_fail_every=int(_take(d, "serving_tick_fail_every", 0)),
             replica_die_at_tick=int(_take(d, "replica_die_at_tick", -1)),
             replica_die_index=int(_take(d, "replica_die_index", 0)),
+            cell_die_at_tick=int(_take(d, "cell_die_at_tick", -1)),
+            cell_die_index=int(_take(d, "cell_die_index", 0)),
+            autoscaler_lag_s=float(_take(d, "autoscaler_lag_s", 0.0)),
         )
+        if out.autoscaler_lag_s < 0:
+            raise ConfigError(
+                f"resilience.chaos.autoscaler_lag_s must be >= 0, got "
+                f"{out.autoscaler_lag_s}")
         _warn_unknown(d, "resilience.chaos")
         return out
 
@@ -910,6 +924,16 @@ class FleetConfig:
     kv_high: float = 0.85
     sla_low: float = 0.90
     sla_window: int = 64
+    # route-retry discipline (resilience/retry.py RetryBudget): each
+    # refused replica pick past the first consumes one unit from a
+    # budget shared fleet-wide (and region-wide when the fleet belongs
+    # to a ServingCell), with jittered exponential backoff between
+    # attempts — a replica/cell that refuses forever is given up on
+    # explicitly (REJECTED span) instead of being hammered in a tight
+    # loop. 0 budget = first refusal already rejects.
+    route_retry_budget: int = 256
+    route_backoff_s: float = 0.02
+    route_backoff_jitter: float = 0.5
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "FleetConfig":
@@ -937,7 +961,19 @@ class FleetConfig:
             kv_high=float(_take(d, "kv_high", 0.85)),
             sla_low=float(_take(d, "sla_low", 0.90)),
             sla_window=int(_take(d, "sla_window", 64)),
+            route_retry_budget=int(_take(d, "route_retry_budget", 256)),
+            route_backoff_s=float(_take(d, "route_backoff_s", 0.02)),
+            route_backoff_jitter=float(
+                _take(d, "route_backoff_jitter", 0.5)),
         )
+        if out.route_retry_budget < 0:
+            raise ConfigError(
+                f"serving.fleet.route_retry_budget must be >= 0, got "
+                f"{out.route_retry_budget}")
+        if out.route_backoff_s < 0 or out.route_backoff_jitter < 0:
+            raise ConfigError(
+                "serving.fleet route_backoff_s and route_backoff_jitter "
+                "must be >= 0")
         if out.router not in ("least_loaded", "prefix_affinity"):
             raise ConfigError(
                 f"serving.fleet.router must be 'least_loaded' or "
@@ -970,6 +1006,84 @@ class FleetConfig:
 
 
 @dataclass
+class RegionConfig:
+    """The ``serving.region`` block: the cell-based fleet-of-fleets
+    front-end (docs/serving.md "Region & cells").
+
+    ``cells`` fleets (each a :class:`FleetConfig`-shaped failure domain)
+    sit behind one :class:`~deepspeed_tpu.serving.Region` that routes by
+    a two-tier consistent hash: a ``cell_ring_vnodes``-point cell ring
+    picks the failure domain from each cell's PUBLISHED load/health
+    digest (queue depth, KV demand, in-SLA window — refreshed on the
+    monitor cadence, never scanned per route), then the cell's own
+    router picks the replica. ``cell_spill_load`` (0 = off) spills a
+    request off an overloaded primary cell to the least-loaded
+    reachable one (digest queue depth per healthy replica >= the
+    threshold), mirroring the replica ring's spill valve one tier up.
+
+    Brownout: when reachable demand exceeds ``brownout_queue_per_replica``
+    queued requests per healthy reachable replica, the region sheds NEW
+    work below a priority floor that climbs one tier per additional
+    multiple of the threshold (the brownout ladder), always with a
+    REJECTED span — explicit degradation, never silent drops.
+    ``brownout_exit_ratio`` is the hysteresis: a floor level is left
+    only once pressure falls below ``ratio`` x its entry threshold.
+
+    ``rebalance_threshold`` (queued requests per replica above the
+    reachable mean, 0 = off) lets a heal re-spread QUEUED work from
+    cells that bore the partition onto the rejoined capacity."""
+
+    cells: int = 2
+    cell_ring_vnodes: int = 32
+    cell_spill_load: int = 0
+    brownout_queue_per_replica: float = 8.0
+    brownout_exit_ratio: float = 0.5
+    rebalance_threshold: float = 4.0
+    health_interval_s: float = 0.05
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "RegionConfig":
+        if not d:
+            return cls()
+        d = dict(d)
+        out = cls(
+            cells=int(_take(d, "cells", 2)),
+            cell_ring_vnodes=int(_take(d, "cell_ring_vnodes", 32)),
+            cell_spill_load=int(_take(d, "cell_spill_load", 0)),
+            brownout_queue_per_replica=float(
+                _take(d, "brownout_queue_per_replica", 8.0)),
+            brownout_exit_ratio=float(
+                _take(d, "brownout_exit_ratio", 0.5)),
+            rebalance_threshold=float(
+                _take(d, "rebalance_threshold", 4.0)),
+            health_interval_s=float(_take(d, "health_interval_s", 0.05)),
+        )
+        if out.cells < 1:
+            raise ConfigError(
+                f"serving.region.cells must be >= 1, got {out.cells}")
+        if out.cell_ring_vnodes < 1:
+            raise ConfigError(
+                f"serving.region.cell_ring_vnodes must be >= 1, got "
+                f"{out.cell_ring_vnodes}")
+        if out.brownout_queue_per_replica <= 0:
+            raise ConfigError(
+                f"serving.region.brownout_queue_per_replica must be > 0, "
+                f"got {out.brownout_queue_per_replica}")
+        if not 0.0 <= out.brownout_exit_ratio <= 1.0:
+            # exit above entry would re-enter the level it just left on
+            # the very next poll (oscillation, not hysteresis)
+            raise ConfigError(
+                f"serving.region.brownout_exit_ratio must be in [0, 1], "
+                f"got {out.brownout_exit_ratio}")
+        if out.rebalance_threshold < 0:
+            raise ConfigError(
+                f"serving.region.rebalance_threshold must be >= 0, got "
+                f"{out.rebalance_threshold}")
+        _warn_unknown(d, "serving.region")
+        return out
+
+
+@dataclass
 class ServingConfig:
     """The ``serving`` block: knobs for the request front-end over the
     ragged engine (docs/serving.md).
@@ -998,6 +1112,7 @@ class ServingConfig:
     stuck_tick_timeout_s: float = 30.0
     tick_retry_limit: int = 1
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    region: RegionConfig = field(default_factory=RegionConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
@@ -1006,6 +1121,7 @@ class ServingConfig:
         d = dict(d)
         out = cls(
             fleet=FleetConfig.from_dict(_take(d, "fleet", None)),
+            region=RegionConfig.from_dict(_take(d, "region", None)),
             max_queue=int(_take(d, "max_queue", 256)),
             policy=str(_take(d, "policy", "slo")),
             kv_pressure=float(_take(d, "kv_pressure", 0.90)),

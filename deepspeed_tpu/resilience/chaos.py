@@ -26,7 +26,19 @@ event so tests (tests/test_fault_tolerance.py) and the chaos smoke loop
   replica of a :class:`~deepspeed_tpu.serving.ServingFleet` once it has
   run N engine ticks (polled by the fleet health monitor via
   :meth:`should_kill_replica`; the fleet's failover re-queues the dead
-  replica's in-flight requests on the survivors).
+  replica's in-flight requests on the survivors);
+* ``cell_die_at_tick`` / ``cell_die_index`` — kill a whole
+  :class:`~deepspeed_tpu.serving.ServingCell` (correlated replica death:
+  the region's failure domain goes dark at once; polled by the region
+  monitor via :meth:`should_kill_cell`);
+* :meth:`sever` / :meth:`heal_partitions` — a network-partition model
+  over named nodes (cells plus the region front-end): routing and
+  cross-cell KV hand-off consult :meth:`reachable` and fail with typed
+  errors across a severed pair instead of silently succeeding in one
+  process (docs/serving.md "Region & cells");
+* :meth:`set_autoscaler_lag` — delays every fleet autoscaler decision by
+  a fixed virtual interval (controller lag: real autoscalers observe,
+  deliberate and boot capacity minutes behind the demand curve).
 
 Faults raise :class:`InjectedFault` (a ``BaseException``) so retry helpers
 and broad ``except Exception`` recovery code never swallow an injected
@@ -94,7 +106,10 @@ class FaultInjector:
                  serving_tick_fail_at: int = -1,
                  serving_tick_fail_every: int = 0,
                  replica_die_at_tick: int = -1,
-                 replica_die_index: int = 0):
+                 replica_die_index: int = 0,
+                 cell_die_at_tick: int = -1,
+                 cell_die_index: int = 0,
+                 autoscaler_lag_s: float = 0.0):
         fields = {
             "seed": seed,
             "crash_before_commit_at_save": crash_before_commit_at_save,
@@ -112,6 +127,9 @@ class FaultInjector:
             "serving_tick_fail_every": serving_tick_fail_every,
             "replica_die_at_tick": replica_die_at_tick,
             "replica_die_index": replica_die_index,
+            "cell_die_at_tick": cell_die_at_tick,
+            "cell_die_index": cell_die_index,
+            "autoscaler_lag_s": autoscaler_lag_s,
         }
         for name, default in fields.items():
             setattr(self, name,
@@ -121,6 +139,13 @@ class FaultInjector:
         self.save_count = 0
         self.injected: Dict[str, int] = {}
         self._collective_calls: Dict[str, int] = {}
+        # active network partitions: (group_a, group_b) name sets. Nodes
+        # in different groups of any active partition cannot reach each
+        # other; nodes a partition does not mention are unaffected by it.
+        self._partitions: List[Tuple[frozenset, frozenset]] = []
+        # bumped on every sever/heal so observers (the region monitor)
+        # can detect connectivity changes without diffing group sets
+        self.partition_epoch = 0
 
     # ------------------------------------------------------------------
     @classmethod
@@ -153,7 +178,8 @@ class FaultInjector:
                  "collective_fail_at_call", "collective_delay_s",
                  "collective_delay_every", "serving_tick_fail_at",
                  "serving_tick_fail_every", "replica_die_at_tick",
-                 "replica_die_index"}
+                 "replica_die_index", "cell_die_at_tick",
+                 "cell_die_index", "autoscaler_lag_s"}
         unknown = set(spec) - known
         if unknown:
             logger.warning(f"{CHAOS_ENV}: ignoring unknown keys {sorted(unknown)}")
@@ -242,6 +268,72 @@ class FaultInjector:
             f"chaos: killing serving replica {replica_index} at tick {ticks}")
         return True
 
+    def should_kill_cell(self, cell_index: int, ticks: int) -> bool:
+        """Injected whole-cell outage: True once, for the cell whose
+        index matches ``cell_die_index``, as soon as any of its replicas
+        has run ``cell_die_at_tick`` engine ticks (>= 0 enables). The
+        region's monitor polls this and performs the kill + cross-cell
+        failover — a cell outage is a REGION-level event (the entire
+        failure domain went dark: power, ToR switch, pod), the one-tier-
+        up analog of :meth:`should_kill_replica`."""
+        if self.cell_die_at_tick < 0:
+            return False
+        if cell_index != self.cell_die_index:
+            return False
+        if ticks < self.cell_die_at_tick:
+            return False
+        if self.injected.get("cell_outage"):
+            return False
+        self._count("cell_outage")
+        logger.warning(
+            f"chaos: killing serving cell {cell_index} at tick {ticks}")
+        return True
+
+    # -- network partitions ---------------------------------------------
+    def sever(self, group_a, group_b) -> None:
+        """Partition the network between two named node groups (cell
+        names, plus ``\"region\"`` for the front-end itself). Active
+        until :meth:`heal_partitions`. Groups must be disjoint."""
+        a, b = frozenset(map(str, group_a)), frozenset(map(str, group_b))
+        if not a or not b:
+            raise ValueError("partition groups must be non-empty")
+        if a & b:
+            raise ValueError(f"partition groups overlap: {sorted(a & b)}")
+        self._partitions.append((a, b))
+        self.partition_epoch += 1
+        self._count("partition")
+        logger.warning(f"chaos: partition {sorted(a)} | {sorted(b)}")
+
+    def heal_partitions(self) -> None:
+        """Heal every active partition (connectivity restored at once)."""
+        if not self._partitions:
+            return
+        self._partitions = []
+        self.partition_epoch += 1
+        self._count("partition_heal")
+        logger.warning("chaos: all partitions healed")
+
+    @property
+    def partitioned(self) -> bool:
+        return bool(self._partitions)
+
+    def reachable(self, a: str, b: str) -> bool:
+        """False when any active partition separates ``a`` from ``b``."""
+        for ga, gb in self._partitions:
+            if (a in ga and b in gb) or (a in gb and b in ga):
+                return False
+        return True
+
+    def set_autoscaler_lag(self, lag_s: float) -> None:
+        """Delay every autoscaler decision by ``lag_s`` (virtual)
+        seconds — fleets add it to their decision interval, so demand
+        runs ahead of capacity exactly like a real control loop lags."""
+        if lag_s < 0:
+            raise ValueError(f"autoscaler lag must be >= 0, got {lag_s}")
+        self.autoscaler_lag_s = float(lag_s)
+        self._count("autoscaler_lag")
+        logger.warning(f"chaos: autoscaler decisions lagged by {lag_s}s")
+
     def on_collective(self, op: str) -> None:
         n = self._collective_calls.get(op, 0) + 1
         self._collective_calls[op] = n
@@ -290,6 +382,16 @@ _INJECTOR: Optional[FaultInjector] = None
 
 def get_fault_injector() -> Optional[FaultInjector]:
     return _INJECTOR
+
+
+def is_reachable(a: str, b: str) -> bool:
+    """Whether nodes ``a`` and ``b`` can reach each other under the
+    installed injector's partition model (always True with no injector:
+    chaos off means the network is whole). The region/cell layer's one
+    connectivity oracle — routing, cross-cell hand-off and KV adoption
+    all consult it so a severed pair fails TYPED, never silently."""
+    inj = _INJECTOR
+    return True if inj is None else inj.reachable(a, b)
 
 
 def install_fault_injector(inj: Optional[FaultInjector]) -> Optional[FaultInjector]:

@@ -21,6 +21,15 @@ function of the schedule, so
 * the same seed produces a **bit-identical event-trace hash**, asserted
   in tests/test_dst.py.
 
+The same discipline runs one failure-domain up:
+:func:`generate_region_schedule` / :func:`run_region_schedule` drive
+the real :class:`~deepspeed_tpu.serving.Region` (cells of fleets,
+two-tier routing) through region-scale chaos — whole-cell outages,
+inter-cell partitions + heals, autoscaler lag — audited by
+:class:`RegionInvariantAuditor` (every fleet invariant region-wide,
+plus heal convergence / single ownership and shed-span). See
+docs/dst.md "Region-scale events".
+
 The device is replaced by :class:`SimEngine` — a host-only model of the
 ragged engine's serving contract that *reuses the real*
 :class:`~deepspeed_tpu.inference.ragged.BlockedAllocator`,
@@ -52,8 +61,10 @@ from .chaos import FaultInjector, TickFault, install_fault_injector
 from .clock import SimClock, use_clock
 
 __all__ = ["SimConfig", "SimEngine", "SimKVExport", "SimEvent", "Schedule",
-           "SimReport", "generate_schedule", "run_schedule",
-           "shrink_schedule", "dump_repro", "load_repro"]
+           "RegionSchedule", "SimReport", "generate_schedule",
+           "generate_region_schedule", "run_schedule",
+           "run_region_schedule", "shrink_schedule", "dump_repro",
+           "load_repro"]
 
 
 # ----------------------------------------------------------------------
@@ -367,6 +378,40 @@ class Schedule:
                         events=list(events))
 
 
+@dataclass
+class RegionSchedule(Schedule):
+    """A region-scale schedule: the base fields plus the
+    :class:`~deepspeed_tpu.config.RegionConfig` dict and region-scale
+    event kinds (``cell_outage``, ``partition``, ``heal``,
+    ``autoscaler_lag`` — docs/dst.md "Region-scale events").
+    ``run_region_schedule(generate_region_schedule(seed))`` is a pure
+    function, same as the fleet tier."""
+
+    region_cfg: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = super().to_dict()
+        d["region_cfg"] = dict(self.region_cfg)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RegionSchedule":
+        return cls(seed=int(d["seed"]), horizon=float(d["horizon"]),
+                   engine_cfg=dict(d["engine_cfg"]),
+                   fleet_cfg=dict(d["fleet_cfg"]),
+                   serving_cfg=dict(d["serving_cfg"]),
+                   region_cfg=dict(d.get("region_cfg", {})),
+                   events=[SimEvent.from_dict(e) for e in d["events"]])
+
+    def replace_events(self, events: List[SimEvent]) -> "RegionSchedule":
+        return RegionSchedule(seed=self.seed, horizon=self.horizon,
+                              engine_cfg=dict(self.engine_cfg),
+                              fleet_cfg=dict(self.fleet_cfg),
+                              serving_cfg=dict(self.serving_cfg),
+                              region_cfg=dict(self.region_cfg),
+                              events=list(events))
+
+
 def _event_order(e: SimEvent):
     """Deterministic total order for schedule events (repr-keyed payload
     tie-break: payload values are mixed types, so direct comparison
@@ -475,6 +520,146 @@ def generate_schedule(seed: int) -> Schedule:
                     events=events)
 
 
+def generate_region_schedule(seed: int) -> RegionSchedule:
+    """Expand a seed into a REGION-scale fault schedule: N cells of M
+    replicas behind the two-tier router, request traffic (with bursts
+    sized to trip the brownout ladder), and the failure modes that
+    dominate at pod scale — whole-cell outages, inter-cell partitions
+    (with and without the region front-end on the severed side), heals,
+    and autoscaler lag — composed with every fleet-tier fault kind."""
+    import random
+
+    # a distinct stream from generate_schedule: region seed N must not
+    # be the fleet-tier seed N wearing a different config
+    rng = random.Random(f"region-{seed}")
+    engine_cfg = SimConfig().to_dict()
+    n_cells = rng.randint(2, 3)
+    replicas = rng.randint(1, 2)
+    disaggregated = rng.random() < 0.25
+    fleet_cfg: Dict[str, Any] = {
+        "replicas": replicas,
+        "router": rng.choice(["least_loaded", "prefix_affinity"]),
+        "failover": True,
+        "respawn": rng.random() < 0.4,
+        "autoscale": rng.random() < 0.25,
+        "autoscale_interval_s": 4.0,
+        "min_replicas": 1,
+        "max_replicas": 3,
+        "route_backoff_s": 0.05,
+    }
+    if disaggregated:
+        fleet_cfg.update(disaggregated=True, prefill_replicas=1,
+                         replicas=max(1, replicas - 1))
+    region_cfg: Dict[str, Any] = {
+        "cells": n_cells,
+        "cell_ring_vnodes": 16,
+        "brownout_queue_per_replica": rng.choice([2.0, 4.0, 8.0]),
+        "rebalance_threshold": rng.choice([0.0, 1.0, 2.0]),
+        "cell_spill_load": rng.choice([0, 0, 6]),
+    }
+    serving_cfg: Dict[str, Any] = {
+        "policy": "slo" if rng.random() < 0.8 else "fcfs",
+        "max_queue": rng.choice([8, 32]),
+        "tick_retry_limit": rng.randint(0, 2),
+        "reserve_output_blocks": rng.random() < 0.7,
+        "kv_pressure": rng.choice([0.5, 0.8, 0.9]),
+        "stuck_tick_timeout_s": 0.0,
+        "drain_timeout_s": 600.0,
+        "poll_interval_s": 0.25,
+    }
+    horizon = float(rng.randint(40, 80))
+    vocab = engine_cfg["vocab"]
+    events: List[SimEvent] = []
+    prefixes = [[rng.randrange(1, vocab) for _ in range(8)]
+                for _ in range(2)]
+
+    def add_submit(ix: int, t: float) -> None:
+        if rng.random() < 0.3:
+            prompt = list(rng.choice(prefixes)) + [
+                rng.randrange(1, vocab) for _ in range(rng.randint(1, 4))]
+        else:
+            prompt = [rng.randrange(1, vocab)
+                      for _ in range(rng.randint(3, 14))]
+        payload: Dict[str, Any] = {
+            "ix": ix, "prompt": prompt,
+            "max_new": rng.randint(1, 10),
+            "priority": rng.randint(0, 2),
+        }
+        if rng.random() < 0.5:
+            payload["deadline"] = round(rng.uniform(4.0, 40.0), 3)
+        if rng.random() < 0.25:
+            payload["ttft_deadline"] = round(rng.uniform(2.0, 12.0), 3)
+        if rng.random() < 0.2:
+            payload["eos"] = rng.randrange(0, vocab)
+        if rng.random() < 0.04:
+            payload["max_new"] = engine_cfg["max_context"] * 2
+        events.append(SimEvent(t=t, kind="submit", payload=payload))
+        if rng.random() < 0.12:
+            events.append(SimEvent(
+                t=round(t + rng.uniform(0.5, 10.0), 3), kind="cancel",
+                payload={"target": ix}))
+
+    ix = 0
+    for _ in range(rng.randint(8, 18)):
+        add_submit(ix, round(rng.uniform(0.0, horizon * 0.6), 3))
+        ix += 1
+    if rng.random() < 0.45:
+        # a correlated burst: the brownout ladder's natural trigger
+        t0 = round(rng.uniform(2.0, horizon * 0.5), 3)
+        for _ in range(rng.randint(6, 14)):
+            add_submit(ix, round(t0 + rng.uniform(0.0, 1.5), 3))
+            ix += 1
+    for _ in range(rng.randint(0, 2)):
+        events.append(SimEvent(t=round(rng.uniform(1.0, horizon * 0.7), 3),
+                               kind="tick_fault",
+                               payload={"n": rng.randint(1, 2)}))
+    for _ in range(rng.randint(0, 2)):
+        events.append(SimEvent(t=round(rng.uniform(2.0, horizon * 0.8), 3),
+                               kind="replica_death",
+                               payload={"cell": rng.randint(0, 3),
+                                        "which": rng.randint(0, 3)}))
+    if n_cells > 1 and rng.random() < 0.5:
+        events.append(SimEvent(t=round(rng.uniform(3.0, horizon * 0.7), 3),
+                               kind="cell_outage",
+                               payload={"which": rng.randint(0, 3)}))
+    if n_cells > 1 and rng.random() < 0.55:
+        t_p = round(rng.uniform(2.0, horizon * 0.6), 3)
+        far = sorted(rng.sample(range(n_cells),
+                                rng.randint(1, n_cells - 1)))
+        events.append(SimEvent(t=t_p, kind="partition",
+                               payload={"far": far,
+                                        "sever_region":
+                                        rng.random() < 0.6}))
+        if rng.random() < 0.85:
+            events.append(SimEvent(
+                t=round(t_p + rng.uniform(4.0, 25.0), 3), kind="heal",
+                payload={}))
+    if rng.random() < 0.3:
+        events.append(SimEvent(t=round(rng.uniform(1.0, horizon * 0.5), 3),
+                               kind="autoscaler_lag",
+                               payload={"dt": rng.choice([5.0, 10.0,
+                                                          20.0])}))
+    if rng.random() < 0.08:
+        events.append(SimEvent(t=round(rng.uniform(horizon * 0.5,
+                                                   horizon * 0.9), 3),
+                               kind="latch", payload={}))
+    if not disaggregated and rng.random() < 0.2:
+        events.append(SimEvent(t=round(rng.uniform(2.0, horizon * 0.8), 3),
+                               kind="scale",
+                               payload={"cell": rng.randint(0, 3),
+                                        "n": rng.randint(1, 3)}))
+    if rng.random() < 0.2:
+        events.append(SimEvent(t=round(rng.uniform(1.0, horizon * 0.6), 3),
+                               kind="stall",
+                               payload={"dt": round(rng.uniform(3.0,
+                                                                15.0), 3)}))
+    events.sort(key=_event_order)
+    return RegionSchedule(seed=seed, horizon=horizon,
+                          engine_cfg=engine_cfg, fleet_cfg=fleet_cfg,
+                          serving_cfg=serving_cfg, region_cfg=region_cfg,
+                          events=events)
+
+
 # ----------------------------------------------------------------------
 # harness internals
 # ----------------------------------------------------------------------
@@ -552,6 +737,24 @@ class _Trace:
         self.rows.append(("T", n, round(vt, 6), reps,
                           tuple(sorted(states.items())), total_tokens))
 
+    def tick_region(self, n: int, vt: float, region,
+                    tracked: List[_Tracked]) -> None:
+        cells = tuple(
+            (c.name, c.state, tuple(
+                (r.name, r.state, r.serving._tick_count,
+                 len(r.serving._queue), len(r.serving._live),
+                 r.serving.pending_work)
+                for r in c.fleet.replicas))
+            for c in region.cells)
+        states: Dict[str, int] = {}
+        total_tokens = 0
+        for t in tracked:
+            states[t.req.state.value] = states.get(t.req.state.value, 0) + 1
+            total_tokens += len(t.req.tokens)
+        self.rows.append(("T", n, round(vt, 6), cells,
+                          tuple(sorted(states.items())), total_tokens,
+                          region.brownout_floor))
+
     def finish(self, tracked: List[_Tracked]) -> None:
         self.rows.append(("F", tuple(
             (t.ix, t.req.state.value, tuple(t.req.tokens),
@@ -587,6 +790,13 @@ class InvariantAuditor:
         self._trees_checked: set = set()
         self._last_now = clock.now()
 
+    def _replicas(self):
+        """Every replica under audit. The region subclass widens this to
+        all cells' fleets — every invariant below then holds REGION-wide
+        for free (conservation across cell death, ownership across
+        partitions)."""
+        return list(self.fleet.replicas)
+
     def audit(self, tracked: List[_Tracked]) -> List[str]:
         from ..serving.request import RequestState
 
@@ -598,11 +808,11 @@ class InvariantAuditor:
                      f"{self._last_now} -> {now}")
         self._last_now = now
         # 1. KV block-balance partition, every replica incl. dead ones
-        for rep in self.fleet.replicas:
+        for rep in self._replicas():
             for p in block_balance_report(rep.engine)["problems"]:
                 v.append(f"[block-balance] {rep.name}: {p}")
         # 2. request state-machine legality / containment
-        for rep in self.fleet.replicas:
+        for rep in self._replicas():
             srv = rep.serving
             for r in srv._queue:
                 if r.state is not RequestState.QUEUED:
@@ -619,7 +829,7 @@ class InvariantAuditor:
         # 3. conservation: every submitted request is terminal or owned
         # by exactly one replica (no lost, no duplicated requests)
         for t in tracked:
-            owners = [rep.name for rep in self.fleet.replicas
+            owners = [rep.name for rep in self._replicas()
                       if t.req.uid in rep.serving._requests]
             if t.req.is_terminal:
                 if owners:
@@ -697,6 +907,110 @@ class InvariantAuditor:
         return v
 
 
+class RegionInvariantAuditor(InvariantAuditor):
+    """The region tier's audits: every base invariant widened to ALL
+    cells' replicas (conservation now holds across cell death and
+    partitions for free), plus three region-specific invariants
+    (docs/dst.md):
+
+    * **#8 heal convergence / single ownership** — a request is never
+      owned by replicas of two cells (the double-ownership a fenceless
+      cross-partition failover would mint), and the region's routing
+      table always names the cell that actually owns it: after a heal,
+      both sides agree — nothing stranded on both, nothing stranded on
+      neither (the zero-owner half is base invariant #3). Terminal
+      requests linger in NO table, region or cell fleet — a stale
+      ownership row is a leak in the making.
+    * **#9 shed-span** — every REJECTED request (brownout sheds
+      included) retired with exactly one span whose recorded state is
+      ``rejected`` and a human-readable reason: load shedding is
+      explicit, never silent.
+    * The base liveness rail doubles as the partition-tolerance check:
+      requests on a severed-but-alive cell must still finish (the cell
+      computes locally) — a harness or region bug that stalls them
+      trips [liveness].
+    """
+
+    def __init__(self, region, clock, capture: _CaptureTelemetry,
+                 tracer: Optional[Tracer] = None) -> None:
+        super().__init__(fleet=None, clock=clock, capture=capture,
+                         tracer=tracer)
+        self.region = region
+
+    def _replicas(self):
+        out = []
+        for cell in self.region.cells:
+            out.extend(cell.fleet.replicas)
+        return out
+
+    def audit(self, tracked: List[_Tracked]) -> List[str]:
+        from ..serving.request import RequestState
+
+        v = super().audit(tracked)
+        region = self.region
+        # 8. convergence: cell-level ownership vs the region table
+        owner_cells: Dict[int, List[str]] = {}
+        for cell in region.cells:
+            for rep in cell.fleet.replicas:
+                for uid in rep.serving._requests:
+                    cells = owner_cells.setdefault(uid, [])
+                    if cell.name not in cells:
+                        cells.append(cell.name)
+        with region._lock:
+            table = {uid: name for uid, (_r, name)
+                     in region._requests.items()}
+        fleet_tables: Dict[str, set] = {}
+        for cell in region.cells:
+            with cell.fleet._lock:
+                fleet_tables[cell.name] = set(cell.fleet._requests)
+        for t in tracked:
+            uid = t.req.uid
+            if t.req.is_terminal:
+                if uid in table:
+                    v.append(f"[convergence] r{t.ix} terminal but still "
+                             f"in the region table ({table[uid]})")
+                # a terminal request must not linger in any cell's FLEET
+                # table either — escalation paths that hand ownership up
+                # to the region must drop the source fleet's row, or the
+                # row leaks for the fleet's lifetime
+                stale = [name for name, uids in fleet_tables.items()
+                         if uid in uids]
+                if stale:
+                    v.append(f"[convergence] r{t.ix} terminal but still "
+                             f"in fleet table(s) {stale} — stale "
+                             f"ownership row")
+                continue
+            cells = owner_cells.get(uid, [])
+            if len(cells) > 1:
+                v.append(f"[convergence] r{t.ix} owned by replicas of "
+                         f"{cells} — double ownership across cells")
+            elif cells:
+                if uid not in table:
+                    v.append(f"[convergence] r{t.ix} owned by "
+                             f"{cells[0]} but missing from the region "
+                             f"table")
+                elif table[uid] != cells[0]:
+                    v.append(f"[convergence] r{t.ix}: region table says "
+                             f"{table[uid]} but {cells[0]} owns it")
+        # 9. shed-span: rejects carry exactly one 'rejected' span + a
+        # reason (the silent-shed detector)
+        spans_by_uid: Dict[int, List[Any]] = {}
+        for s in self.capture.spans:
+            spans_by_uid.setdefault(s.uid, []).append(s)
+        for t in tracked:
+            if t.req.state is not RequestState.REJECTED:
+                continue
+            spans = spans_by_uid.get(t.req.uid, [])
+            if len(spans) != 1 or spans[0].state != "rejected":
+                v.append(f"[shed-span] r{t.ix} rejected with "
+                         f"{[s.state for s in spans]} span(s) — "
+                         f"expected exactly one 'rejected'")
+            elif not t.req.error:
+                v.append(f"[shed-span] r{t.ix} rejected without a "
+                         f"reason — silent shed")
+        return v
+
+
 # ----------------------------------------------------------------------
 # the simulation driver
 # ----------------------------------------------------------------------
@@ -722,6 +1036,9 @@ class SimReport:
     # the span timeline (span dicts), kept only for failing runs so
     # dump_repro can ship the event timeline with the repro
     spans: Optional[List[Dict[str, Any]]] = None
+    # region runs only: the brownout admit/shed rows — the soak's
+    # strictly-priority-ordered shedding gate reads these
+    brownout_log: Optional[List[Dict[str, Any]]] = None
 
     @property
     def ok(self) -> bool:
@@ -894,6 +1211,180 @@ def _apply_event(fleet, ev: SimEvent, tracked: List[_Tracked], guard,
         raise ValueError(f"unknown simulation event kind '{ev.kind}'")
 
 
+def run_region_schedule(schedule: RegionSchedule,
+                        engine_factory: Optional[Callable[[], SimEngine]] = None,
+                        region_factory=None,
+                        stop_on_violation: bool = True) -> SimReport:
+    """Execute one REGION schedule under virtual time, auditing after
+    every event and tick with :class:`RegionInvariantAuditor`. Pure:
+    same schedule, same (trace_hash, span_hash). ``region_factory``
+    lets tests plant region-layer bugs (the auditor's teeth), exactly
+    as ``engine_factory`` plants engine bugs one tier down."""
+    from ..serving.region import Region
+    from ..serving.request import RequestState
+    from ..telemetry.registry import get_registry, set_registry
+    from ..telemetry.telemetry import get_telemetry
+
+    clock = SimClock()
+    capture = _CaptureTelemetry()
+    injector = _ScheduledFaultInjector()
+    tracer = Tracer(enabled=True, ring_size=32768, flight_capacity=2048)
+    prev_telemetry = get_telemetry()
+    prev_registry = get_registry()
+    engines: List[SimEngine] = []
+    sim_cfg = SimConfig(**schedule.engine_cfg)
+
+    def factory() -> SimEngine:
+        eng = (engine_factory() if engine_factory is not None
+               else SimEngine(sim_cfg))
+        engines.append(eng)
+        return eng
+
+    trace = _Trace()
+    tracked: List[_Tracked] = []
+    violations: List[str] = []
+    n_ticks = 0
+    with use_clock(clock), use_tracer(tracer):
+        set_telemetry(capture)
+        install_fault_injector(injector)
+        try:
+            guard = _SimGuard()
+            builder = (region_factory if region_factory is not None
+                       else Region)
+            region = builder(factory, dict(schedule.region_cfg),
+                             dict(schedule.fleet_cfg),
+                             dict(schedule.serving_cfg),
+                             preemption_guard=guard, start=False)
+            auditor = RegionInvariantAuditor(region, clock, capture,
+                                             tracer=tracer)
+            events = sorted(schedule.events, key=_event_order)
+            i = 0
+            while True:
+                while i < len(events) and events[i].t <= clock.now() + 1e-9:
+                    ev = events[i]
+                    i += 1
+                    _apply_region_event(region, ev, tracked, guard,
+                                        injector, clock)
+                    trace.event(clock.now(), ev.kind, ev.payload)
+                    step_violations = auditor.audit(tracked)
+                    violations.extend(step_violations)
+                    if step_violations and stop_on_violation:
+                        break
+                if violations and stop_on_violation:
+                    break
+                did = region.step()
+                clock.advance(1.0)
+                n_ticks += 1
+                step_violations = auditor.audit(tracked)
+                violations.extend(step_violations)
+                trace.tick_region(n_ticks, clock.now(), region, tracked)
+                if step_violations and stop_on_violation:
+                    break
+                quiescent = (not did and region.queue_depth == 0
+                             and all(t.req.is_terminal for t in tracked))
+                if i >= len(events) and quiescent:
+                    break
+                if not did and i < len(events) and events[i].t > clock.now():
+                    clock.advance(events[i].t - clock.now())
+                if n_ticks > schedule.horizon + LIVENESS_SLACK_TICKS:
+                    stuck = [t.ix for t in tracked if not t.req.is_terminal]
+                    violations.append(
+                        f"[liveness] region simulation did not quiesce "
+                        f"within {n_ticks} ticks; live requests: {stuck}")
+                    break
+            clock.pump = region.step
+            region.close(timeout=30.0)
+            clock.pump = None
+            violations.extend(auditor.audit(tracked))
+            violations.extend(auditor.final(tracked, engines))
+            trace.finish(tracked)
+            if violations:
+                tracer.flight.note("invariant_audit_failed",
+                                   n_violations=len(violations))
+                tracer.flight.dump("invariant-audit")
+        finally:
+            install_fault_injector(None)
+            set_telemetry(prev_telemetry
+                          if prev_telemetry is not None
+                          and prev_telemetry.enabled else None)
+            set_registry(prev_registry)
+    states = [t.req.state for t in tracked]
+    return SimReport(
+        seed=schedule.seed, trace_hash=trace.hash(),
+        violations=violations, n_ticks=n_ticks, n_events=len(schedule.events),
+        submitted=len(tracked),
+        finished=sum(s is RequestState.FINISHED for s in states),
+        cancelled=sum(s is RequestState.CANCELLED for s in states),
+        rejected=sum(s is RequestState.REJECTED for s in states),
+        tokens={t.ix: list(t.req.tokens) for t in tracked},
+        span_hash=tracer.canonical_hash(), n_spans=len(tracer.spans()),
+        spans=([s.to_dict() for s in tracer.spans()]
+               if violations else None),
+        brownout_log=list(region.brownout_log))
+
+
+def _apply_region_event(region, ev: SimEvent, tracked: List[_Tracked],
+                        guard, injector: _ScheduledFaultInjector,
+                        clock: SimClock) -> None:
+    p = ev.payload
+    if ev.kind == "submit":
+        entry = _Tracked(ix=int(p["ix"]), req=None)
+        entry.req = region.submit(
+            list(p["prompt"]), max_new_tokens=int(p["max_new"]),
+            priority=int(p.get("priority", 0)),
+            deadline_s=p.get("deadline"),
+            ttft_deadline_s=p.get("ttft_deadline"),
+            eos_token_id=p.get("eos"),
+            on_token=entry.delivered.append)
+        tracked.append(entry)
+    elif ev.kind == "cancel":
+        target = int(p["target"])
+        for t in tracked:
+            if t.ix == target and not t.req.is_terminal:
+                region.cancel(t.req)
+                break
+    elif ev.kind == "tick_fault":
+        injector.arm(int(p.get("n", 1)))
+    elif ev.kind == "replica_death":
+        cells = sorted((c for c in region.live_cells),
+                       key=lambda c: c.name)
+        if cells:
+            cell = cells[int(p.get("cell", 0)) % len(cells)]
+            healthy = sorted(r.name for r in cell.fleet.healthy_replicas)
+            if healthy:
+                name = healthy[int(p.get("which", 0)) % len(healthy)]
+                cell.fleet.kill_replica(name, reason="dst: scheduled death")
+    elif ev.kind == "cell_outage":
+        cells = sorted(c.name for c in region.live_cells)
+        if cells:
+            region.kill_cell(cells[int(p.get("which", 0)) % len(cells)],
+                             reason="dst: scheduled cell outage")
+    elif ev.kind == "partition":
+        names = sorted(c.name for c in region.cells)
+        far = {names[int(ix) % len(names)] for ix in p.get("far", [])}
+        near = set(names) - far
+        if p.get("sever_region", True):
+            near.add(region.name)
+        if far and near:
+            injector.sever(sorted(near), sorted(far))
+    elif ev.kind == "heal":
+        injector.heal_partitions()
+    elif ev.kind == "autoscaler_lag":
+        injector.set_autoscaler_lag(float(p.get("dt", 5.0)))
+    elif ev.kind == "latch":
+        guard.should_stop = True
+    elif ev.kind == "scale":
+        cells = sorted((c for c in region.live_cells),
+                       key=lambda c: c.name)
+        if cells:
+            cell = cells[int(p.get("cell", 0)) % len(cells)]
+            cell.fleet.scale_to(int(p["n"]))
+    elif ev.kind == "stall":
+        clock.advance(float(p.get("dt", 1.0)))
+    else:
+        raise ValueError(f"unknown region simulation event '{ev.kind}'")
+
+
 # ----------------------------------------------------------------------
 # shrinking + regression artifacts
 # ----------------------------------------------------------------------
@@ -908,7 +1399,9 @@ def shrink_schedule(schedule: Schedule,
     the run budget: removing any single remaining event makes it pass."""
     if fails is None:
         def fails(s: Schedule) -> bool:
-            return bool(run_schedule(s).violations)
+            runner = (run_region_schedule if isinstance(s, RegionSchedule)
+                      else run_schedule)
+            return bool(runner(s).violations)
 
     events = list(schedule.events)
     if not fails(schedule.replace_events(events)):
@@ -969,5 +1462,6 @@ def dump_repro(schedule: Schedule, violations: List[str],
 def load_repro(path: str) -> Tuple[Schedule, List[str]]:
     with open(path, "r", encoding="utf-8") as fh:
         data = json.load(fh)
-    return (Schedule.from_dict(data["schedule"]),
-            list(data.get("violations", [])))
+    sched = data["schedule"]
+    cls = RegionSchedule if "region_cfg" in sched else Schedule
+    return (cls.from_dict(sched), list(data.get("violations", [])))

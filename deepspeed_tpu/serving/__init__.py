@@ -23,7 +23,15 @@ from ..inference.ragged import (  # noqa: F401
     assert_block_balance,
     block_balance_report,
 )
+from .cell import (  # noqa: F401
+    CellDigest,
+    CellState,
+    CellUnreachable,
+    ServingCell,
+    check_reachable,
+)
 from .fleet import Replica, ReplicaState, ServingFleet  # noqa: F401
+from .region import Region  # noqa: F401
 from .request import (  # noqa: F401
     InvalidTransition,
     Request,

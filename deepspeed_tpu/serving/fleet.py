@@ -49,16 +49,35 @@ from __future__ import annotations
 
 import collections
 import itertools
+import random
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..resilience.clock import Clock, get_clock
+from ..resilience.retry import RetryBudget
 from ..telemetry.tracing import get_tracer, request_event
 from ..utils.logging import log_dist, logger
 from .request import Request, RequestState
 from .router import (NoHealthyReplica, PrefixAffinityRouter, RouterPolicy,
                      least_loaded_pick, make_router)
 from .server import ServingEngine, stream_tokens
+
+
+def route_budget_for(req: Request, size: int) -> RetryBudget:
+    """The request's route-retry budget, created at first need and
+    carried on the request itself. ONE budget per request LIFECYCLE,
+    drawn from by every tier that re-routes it — this fleet's replica
+    loop, a region's cell loop, failover and hand-off continuations —
+    so a refusing or partitioned target is given up on explicitly
+    rather than hammered forever. Scoping the pool to the request (not
+    the fleet/region) matters: a process-lifetime pool would let past
+    refusals accumulated across OTHER requests permanently starve
+    future, healthy work of its retries."""
+    budget = getattr(req, "_route_budget", None)
+    if budget is None:
+        budget = RetryBudget(size)
+        req._route_budget = budget
+    return budget
 
 
 class ReplicaState:
@@ -114,7 +133,11 @@ class ServingFleet:
                  router: Optional[RouterPolicy] = None,
                  preemption_guard: Any = None,
                  start: bool = True,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None,
+                 name: Optional[str] = None,
+                 on_retire=None,
+                 on_handoff_escalation=None,
+                 on_route_escalation=None):
         from ..config import FleetConfig, ServingConfig
 
         if config is None:
@@ -130,6 +153,28 @@ class ServingFleet:
         self._factory = engine_factory
         self._guard = preemption_guard
         self._start_drivers = start
+        # cell identity (docs/serving.md "Region & cells"): a named
+        # fleet IS one cell of a region — its replica names and every
+        # metric it emits are namespaced serving/<name>/... so N cells
+        # never stomp one gauge, and the trace tracks read cell/replica
+        self.name = name
+        self._metric_root = (f"serving/{name}/fleet" if name
+                             else "serving/fleet")
+        # route-retry discipline: refusals past the first draw from the
+        # request's OWN budget (route_budget_for) — shared by every tier
+        # that re-routes it, never by other requests — with jittered
+        # exponential backoff. Deterministic jitter: the rng is seeded
+        # by the fleet's name so a DST replay draws the identical
+        # backoff sequence.
+        self._route_rng = random.Random(name or "fleet")
+        # region wiring: _retire_hook fires once per terminal request
+        # AFTER the fleet's own bookkeeping (outside the fleet lock);
+        # _handoff_escalation is offered (req, export) when no replica
+        # in THIS fleet can take a disaggregated hand-off — the region
+        # places it on another cell (True = taken)
+        self._retire_hook = on_retire
+        self._handoff_escalation = on_handoff_escalation
+        self._route_escalation = on_route_escalation
         # the fleet's timebase: health/autoscale intervals, respawn
         # backoff, drain budgets, request submit stamps — and every
         # replica it spawns inherits it (docs/dst.md)
@@ -195,7 +240,7 @@ class ServingFleet:
         return get_telemetry()
 
     def _count(self, name: str, n: float = 1.0) -> None:
-        self._telemetry.registry.counter(f"serving/fleet/{name}").inc(n)
+        self._telemetry.registry.counter(f"{self._metric_root}/{name}").inc(n)
 
     def _update_gauges(self) -> None:
         t = self._telemetry
@@ -205,8 +250,8 @@ class ServingFleet:
             healthy = [r for r in self._replicas.values()
                        if r.state == ReplicaState.HEALTHY]
             depth = sum(r.serving.queue_depth for r in healthy)
-        t.registry.gauge("serving/fleet/replicas").set(len(healthy))
-        t.registry.gauge("serving/fleet/queue_depth").set(depth)
+        t.registry.gauge(f"{self._metric_root}/replicas").set(len(healthy))
+        t.registry.gauge(f"{self._metric_root}/queue_depth").set(depth)
 
     # -- replica lifecycle ----------------------------------------------
     def _spawn(self, role: str = "unified") -> Replica:
@@ -218,6 +263,11 @@ class ServingFleet:
         else:
             engine = self._factory()
         name = f"replica-{next(self._name_counter)}"
+        if self.name:
+            # cell-namespaced replica id: metrics land under
+            # serving/<cell>/replica-N/... and trace tracks read the
+            # same path, so a region's timeline groups by failure domain
+            name = f"{self.name}/{name}"
         serving = ServingEngine(
             engine, self._serving_config,
             preemption_guard=self._guard,
@@ -298,18 +348,37 @@ class ServingFleet:
         self._flush_shed()
         return req
 
-    def _route(self, req: Request, requeue: bool = False) -> None:
+    def route_request(self, req: Request, requeue: bool = False,
+                      shed: bool = True) -> bool:
+        """Public routing entry for an EXISTING request — the region's
+        cell tier hands pre-built requests here after its own cell pick
+        (two-tier routing: cell ring, then this fleet's router). With
+        ``shed=False`` a placement failure returns False with the
+        request untouched (still QUEUED) so the caller can try another
+        cell, instead of terminally rejecting it here."""
+        return self._route(req, requeue=requeue, shed=shed)
+
+    def _route(self, req: Request, requeue: bool = False,
+               shed: bool = True) -> bool:
         """Pick a replica and enqueue. ``requeue`` marks the continuation
         of an already-admitted request (fail-over, hand-off fallback): it
         bypasses the fleet and replica admission gates — a draining fleet
         must serve out admitted work — and may land on DRAINING (never
         DEAD) replicas. A pick whose driver stopped between the view
         snapshot and the enqueue refuses non-terminally; the loop places
-        the request elsewhere."""
+        the request elsewhere — but NOT for free: every retry past the
+        first pick draws from the request's own :class:`RetryBudget`
+        (:func:`route_budget_for` — shared with the region tier's cell
+        loop) with jittered exponential backoff between attempts, so a
+        refusing (stopping, partitioned) target is given up on
+        explicitly instead of hammered in a tight loop. ``shed=False``:
+        failures return False with the request untouched (region
+        multi-cell retry)."""
         tracer = get_tracer()
         if requeue:
             request_event(req, "reroute")
         refused: set = set()
+        backoff = self.config.route_backoff_s
         while True:
             # the router decision is a span of its own on the request's
             # tree: replica pick + (for the affinity ring) hit/miss/spill
@@ -317,57 +386,116 @@ class ServingFleet:
             route_span = tracer.begin_span(
                 "route", getattr(req, "_trace_root", None),
                 requeue=bool(requeue), attempt=len(refused))
+            fail: Optional[str] = None
+            name = ""
             with self._lock:
                 if not self._accepting and not requeue:
-                    tracer.finish_span(route_span, error="fleet closed")
-                    self._reject(req, "fleet closed to new requests")
-                    return
-                if self.config.disaggregated:
-                    # prefill pool first — routed by the CONFIGURED
-                    # router below (affinity composes with
-                    # disaggregation: the ring hashes the prefill
-                    # replicas, where repeat prefixes find their cached
-                    # KV); the handoff hook ships the result onward
-                    view = self._view("prefill", live=requeue,
-                                      refused=refused)
-                    if not view:
-                        # degrade: unified path on whatever can serve
-                        view = self._view(live=requeue, refused=refused)
-                        req._handoff_requested = False
-                    else:
-                        req._handoff_requested = True
+                    fail = "fleet closed to new requests"
                 else:
-                    view = self._view(live=requeue, refused=refused)
-                if not view:
-                    tracer.finish_span(route_span, error="no replica")
-                    self._reject(req, "no healthy replica")
-                    return
-                try:
-                    name = self.router.route(view, req.prompt)
-                except NoHealthyReplica:
-                    tracer.finish_span(route_span, error="no replica")
-                    self._reject(req, "no healthy replica")
-                    return
-                if isinstance(self.router, PrefixAffinityRouter):
-                    self._count("affinity_hits"
-                                if self.router.last_was_primary
-                                else "affinity_misses")
-                # router verdict captured under the lock (router state
-                # mutates per route()); the span finishes only after the
-                # enqueue, so a refused pick is marked as such and the
-                # trace shows which replica actually ACCEPTED
-                route_info = self.router.route_info()
-                self._requests[req.uid] = (req, name)
-                replica = self._replicas[name]
+                    if self.config.disaggregated:
+                        # prefill pool first — routed by the CONFIGURED
+                        # router below (affinity composes with
+                        # disaggregation: the ring hashes the prefill
+                        # replicas, where repeat prefixes find their
+                        # cached KV); the handoff hook ships the result
+                        # onward
+                        view = self._view("prefill", live=requeue,
+                                          refused=refused)
+                        if not view:
+                            # degrade: unified path on whatever can serve
+                            view = self._view(live=requeue,
+                                              refused=refused)
+                            req._handoff_requested = False
+                        else:
+                            req._handoff_requested = True
+                    else:
+                        view = self._view(live=requeue, refused=refused)
+                    if not view:
+                        fail = "no healthy replica"
+                    else:
+                        try:
+                            name = self.router.route(view, req.prompt)
+                        except NoHealthyReplica:
+                            fail = "no healthy replica"
+                if fail is None:
+                    if isinstance(self.router, PrefixAffinityRouter):
+                        self._count("affinity_hits"
+                                    if self.router.last_was_primary
+                                    else "affinity_misses")
+                    # router verdict captured under the lock (router
+                    # state mutates per route()); the span finishes only
+                    # after the enqueue, so a refused pick is marked as
+                    # such and the trace shows which replica ACCEPTED
+                    route_info = self.router.route_info()
+                    self._requests[req.uid] = (req, name)
+                    replica = self._replicas[name]
+            if fail is not None:
+                # failure handling OUTSIDE the fleet lock: the requeue
+                # escalation hook re-routes through the REGION (its lock
+                # sits ABOVE ours in the documented order)
+                tracer.finish_span(route_span, error=fail)
+                return self._shed_or_escalate(req, requeue, shed, fail)
             accepted = replica.serving.submit_request(
                 req, requeue=requeue) is not None
             tracer.finish_span(route_span, replica=name,
                                accepted=accepted, **route_info)
             if accepted:
                 self._count("routed")
-                return
+                return True
             refused.add(name)      # stopped mid-race: try the next one
+            with self._lock:
+                ent = self._requests.get(req.uid)
+                if ent is not None and ent[1] == name:
+                    del self._requests[req.uid]
+            if not route_budget_for(
+                    req, self.config.route_retry_budget).take("fleet_route"):
+                request_event(req, "route_budget_exhausted")
+                logger.warning(
+                    f"ServingFleet{f'[{self.name}]' if self.name else ''}: "
+                    f"route retry budget exhausted for request {req.uid}")
+                if shed:
+                    self._reject(req, "route retry budget exhausted")
+                return False
+            self._count("route_retries")
+            d = backoff
+            if d > 0:
+                d *= 1.0 + self._route_rng.uniform(
+                    0.0, self.config.route_backoff_jitter)
+                self._clock.sleep(d)
+            backoff = min(backoff * 2.0, 1.0)
 
+    def _shed_or_escalate(self, req: Request, requeue: bool, shed: bool,
+                          reason: str) -> bool:
+        """A placement failure's endgame. ``shed=False``: hand the
+        untouched request back to the caller (the region's multi-cell
+        loop). Continuations (``requeue``) of a region-managed fleet
+        first get offered one tier up — a cell with no replica left must
+        not shed work another cell could finish — and only then retire
+        with a REJECTED span (explicit, never silent). Runs WITHOUT the
+        fleet lock: the escalation re-enters routing through the region,
+        whose lock sits above ours."""
+        if not shed:
+            return False
+        if requeue and self._route_escalation is not None:
+            # ownership leaves this fleet: drop our table row BEFORE the
+            # hand-over. The region may place the request on another
+            # cell, whose retire hook never reaches this table — a row
+            # left behind would leak for the fleet's lifetime and
+            # resolve cancels to a replica that no longer owns the work.
+            # (If the region routes it back here, placement writes a
+            # fresh row.)
+            with self._lock:
+                self._requests.pop(req.uid, None)
+            try:
+                if self._route_escalation(req):
+                    self._count("route_escalations")
+                    return True
+            except Exception:  # dslint: disable=exception-discipline -- escalation isolation: a region-layer bug must fall back to the local shed path, not strand an admitted request
+                logger.exception(
+                    f"ServingFleet: route escalation failed for request "
+                    f"{req.uid}")
+        self._reject(req, reason)
+        return False
 
     def stream(self, prompt: Sequence[int], **kwargs):
         """Generator yielding tokens as they are emitted (see
@@ -480,6 +608,31 @@ class ServingFleet:
                 problems.append(f"{r.name}: {p}")
         return problems
 
+    def digest_fields(self) -> Dict[str, Any]:
+        """One summarizing pass over this fleet for the cell digest
+        (docs/serving.md "Region & cells"): every replica is visited
+        ONCE, here, on the publish cadence — the region's per-route path
+        reads the published digest and never scans replicas."""
+        with self._lock:
+            replicas = list(self._replicas.values())
+            accepting = self._accepting
+        queue = live = pending = healthy = 0
+        kv = 0.0
+        for r in replicas:
+            if r.state == ReplicaState.DEAD:
+                continue
+            q, lv, pw = r.serving.snapshot()
+            queue += q
+            live += lv
+            pending += pw
+            if r.state == ReplicaState.HEALTHY:
+                healthy += 1
+                kv = max(kv, float(r.engine.kv_demand()))
+        return {"queue_depth": queue, "live": live, "pending_work": pending,
+                "healthy_replicas": healthy, "kv_demand": kv,
+                "in_sla": self.in_sla_ratio(),
+                "accepting": accepting and healthy > 0}
+
     def in_sla_ratio(self) -> Optional[float]:
         """Fraction of recent SLO-carrying requests that met their SLO
         (None until one lands) — the autoscaler's quality signal."""
@@ -504,39 +657,97 @@ class ServingFleet:
             elif had_slo and not (req.state is RequestState.CANCELLED
                                   and req.error is None):
                 self._sla_window.append(False)
+        if self._retire_hook is not None:
+            # region bookkeeping, chained OUTSIDE the fleet lock (the
+            # hook takes the Region lock; region -> cell -> fleet is the
+            # documented order, so fleet-under-region would invert it)
+            try:
+                self._retire_hook(req)
+            except Exception:  # dslint: disable=exception-discipline -- callback isolation: a region bookkeeping crash must not stop later retires on this fleet
+                logger.exception(
+                    f"ServingFleet: retire hook failed (request {req.uid})")
 
-    def _on_handoff(self, req: Request, export) -> None:
-        """A prefill replica finished a flagged request's prompt: ship
-        the KV to a decode replica (least-loaded — the pages are new to
-        every decode replica, affinity buys nothing here). A hand-off is
-        the CONTINUATION of an admitted request, so draining replicas
-        (admission closed, serving out) still take it — only dead ones
-        are excluded. No live decode replica means the request re-queues
-        wherever possible and re-prefills (degraded, never lost)."""
+    def place_handoff(self, req: Request, export,
+                      allow_prefill_fallback: bool = True) -> bool:
+        """Place a prefilled (request, KV export) pair on a live replica
+        of THIS fleet for decode — least-loaded (the pages are new to
+        every decode replica, affinity buys nothing here).
+        ``allow_prefill_fallback`` lets a prefill replica decode it
+        itself as the last resort (clearing the flag, or its next
+        first-token would hand off again in an endless loop); the
+        region's escalation path disables the fallback on the FIRST
+        local attempt so healthy decode capacity on another cell is
+        preferred over cannibalizing the local prefill pool. Returns
+        False with the request untouched when nothing qualifies — the
+        cross-cell adoption path calls this on another cell's fleet, so
+        refusal must stay non-terminal here."""
         refused: set = set()
         while True:
             with self._lock:
                 view = self._view("decode", live=True, refused=refused)
-                if not view:
-                    # last resort: decode ON a prefill replica (same
-                    # engine, same weights) rather than shed admitted
-                    # work — clear the flag or its next first-token
-                    # would hand off again in an endless loop
+                if not view and allow_prefill_fallback:
                     view = self._view("prefill", live=True,
                                       refused=refused)
                     req._handoff_requested = False
                 if not view:
-                    self._reject(req, "no live replica for decode handoff")
-                    break
+                    return False
                 name = least_loaded_pick(view)
                 self._requests[req.uid] = (req, name)
                 replica = self._replicas[name]
             if replica.serving.adopt(req, export):
                 self._count("handoffs")
-                return
+                return True
             # the pick stopped between the view snapshot and adopt()
             # (scale-down reap / kill race): place it elsewhere
             refused.add(name)
+            with self._lock:
+                ent = self._requests.get(req.uid)
+                if ent is not None and ent[1] == name:
+                    del self._requests[req.uid]
+
+    def _on_handoff(self, req: Request, export) -> None:
+        """A prefill replica finished a flagged request's prompt: ship
+        the KV to a decode replica. A hand-off is the CONTINUATION of an
+        admitted request, so draining replicas (admission closed,
+        serving out) still take it — only dead ones are excluded.
+        Placement preference: the local decode pool, then (region mode)
+        ESCALATION to another cell's decode pool — cross-cell KV
+        adoption, partition-checked by the region — then a local
+        prefill replica decoding it itself (the KV is already here),
+        then a route escalation for a full re-prefill on another cell;
+        only when nobody anywhere can take it is the request shed, with
+        a span, never silently (degraded, never lost)."""
+        if self.place_handoff(req, export,
+                              allow_prefill_fallback=(
+                                  self._handoff_escalation is None)):
+            return
+        if self._handoff_escalation is not None:
+            # same table discipline as _shed_or_escalate: the region may
+            # place the pair on another cell, so this fleet's row (still
+            # naming the prefill replica) must go before the hand-over —
+            # any placement back here writes a fresh row
+            with self._lock:
+                self._requests.pop(req.uid, None)
+            try:
+                if self._handoff_escalation(req, export):
+                    return
+            except Exception:  # dslint: disable=exception-discipline -- escalation isolation: a region-layer bug must degrade to the local shed path, not strand an admitted request
+                logger.exception(
+                    f"ServingFleet: handoff escalation failed for "
+                    f"request {req.uid}")
+            # the region had nowhere better either: local prefill-pool
+            # decode is now the preferred fallback — the KV is already
+            # here (a cross-cell re-prefill would recompute it on the
+            # slow path, or ping-pong back to this very pool)
+            if self.place_handoff(req, export,
+                                  allow_prefill_fallback=True):
+                return
+        # nothing HERE can decode it: drop the export and escalate the
+        # route for a full re-prefill continuation elsewhere (region
+        # mode), else shed with a span — never silently
+        req._handoff_requested = False
+        self._shed_or_escalate(req, requeue=True, shed=True,
+                               reason="no live replica for decode handoff")
         self._flush_shed()
 
     def _reject(self, req: Request, reason: str) -> None:
@@ -569,6 +780,59 @@ class ServingFleet:
             self._on_retire(req)
 
     # -- health / chaos / failover --------------------------------------
+    def shutdown_abrupt(self, reason: str = "cell outage") -> List[Request]:
+        """Whole-fleet death — the CELL-outage shape (correlated replica
+        death: the entire failure domain went dark at once). Every
+        replica is flipped DEAD and killed, every non-terminal request
+        harvested and returned UNROUTED (state QUEUED, engine state
+        discarded — the whole cell's KV is suspect): there are no
+        survivors here to fail over to, so placement is the REGION's
+        job, one tier up. The monitor stops; the fleet is done."""
+        with self._lock:
+            self._accepting = False
+            replicas = list(self._replicas.values())
+            for rep in replicas:
+                if rep.state != ReplicaState.DEAD:
+                    rep.state = ReplicaState.DEAD
+                    self.router.on_leave(rep.name)
+        self._stop_evt.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        orphans: List[Request] = []
+        for rep in replicas:
+            rep.serving.kill()
+            orphans.extend(rep.serving.evacuate())
+        with self._lock:
+            self._requests.clear()
+        logger.warning(f"ServingFleet{f'[{self.name}]' if self.name else ''}"
+                       f": abrupt shutdown ({reason}); "
+                       f"{len(orphans)} requests harvested")
+        self._update_gauges()
+        return orphans
+
+    def steal_queued(self, max_n: int) -> List[Request]:
+        """Harvest up to ``max_n`` QUEUED requests off this fleet's most
+        loaded replicas (the region's heal-time rebalance seam — see
+        ``ServingEngine.steal_queued`` for the per-replica contract).
+        The stolen requests stay QUEUED and must be re-routed by the
+        caller."""
+        out: List[Request] = []
+        with self._lock:
+            replicas = sorted(
+                (r for r in self._replicas.values()
+                 if r.state == ReplicaState.HEALTHY),
+                key=lambda r: (-r.load, r.name))
+        for rep in replicas:
+            if len(out) >= max_n:
+                break
+            got = rep.serving.steal_queued(max_n - len(out))
+            with self._lock:
+                for req in got:
+                    self._requests.pop(req.uid, None)
+            out.extend(got)
+        return out
+
     def kill_replica(self, name: str, reason: str = "killed") -> bool:
         """Abrupt replica death (tests, chaos, ops). In-flight work fails
         over to the survivors when ``config.failover`` is on."""
@@ -619,8 +883,17 @@ class ServingFleet:
         self._check_health()
         self._check_respawn()
         if self.config.autoscale:
+            from ..resilience.chaos import get_fault_injector
+
             now = self._clock.now()
-            if now - self._last_autoscale >= self.config.autoscale_interval_s:
+            interval = self.config.autoscale_interval_s
+            inj = get_fault_injector()
+            if inj is not None:
+                # injected controller lag: the decision cadence slows,
+                # so demand runs ahead of capacity like it does behind a
+                # real autoscaler's observe/decide/boot loop
+                interval += getattr(inj, "autoscaler_lag_s", 0.0)
+            if now - self._last_autoscale >= interval:
                 self._last_autoscale = now
                 self.autoscale_once()
         self._flush_shed()

@@ -1,0 +1,880 @@
+"""The region front-end: a fleet of fleets behind two-tier routing.
+
+``Region`` scales the serving plane one failure domain up: N
+:class:`~.cell.ServingCell` cells (each one :class:`~.fleet.ServingFleet`
+— the unit a rack/pod outage kills at once) behind a single
+submit/stream/cancel/drain/close surface. The design goals, in order:
+
+* **O(1)-in-replicas routing** — a request costs one brownout check,
+  one cell-ring walk over PUBLISHED :class:`~.cell.CellDigest` reads
+  (never a replica scan), then the chosen cell's own router (its ring
+  walk over a bounded replica set). Per-route work is independent of
+  the total replica count, pinned by a test — the property that lets
+  one process simulate thousands of replicas (ROADMAP item 3b).
+* **Provable chaos tolerance** — the failure modes that dominate at
+  region scale are first-class, typed, and DST-auditable
+  (docs/dst.md): a whole-cell outage harvests every admitted request
+  and re-places it on reachable cells through the bit-exact re-prefill
+  resume path (the PR-6 evacuation discipline lifted one tier — the
+  dead cell's KV is suspect in toto); an inter-cell partition makes
+  cross-cell hand-off/KV adoption fail with the typed
+  :class:`~.cell.CellUnreachable` and degrade to re-prefill on a
+  reachable cell (degraded, never lost); a partitioned-but-alive cell
+  keeps serving its admitted work locally and is NOT failed over — the
+  region has no cross-partition fencing, so re-routing a live cell's
+  requests would mint the double-ownership the DST heal-convergence
+  invariant exists to catch.
+* **Explicit brownout, never silent drops** — when demand exceeds
+  reachable capacity the region sheds NEW work below a priority floor
+  that climbs one tier per multiple of
+  ``region.brownout_queue_per_replica`` (the brownout ladder), each
+  shed retiring with a REJECTED span; entry/exit and every cell
+  death/partition land in the flight recorder so the post-mortem
+  timeline shows the trigger next to the fallout.
+
+Route retries at BOTH tiers draw from the request's own
+:class:`~deepspeed_tpu.resilience.retry.RetryBudget`
+(:func:`~.fleet.route_budget_for` — one budget per request lifecycle,
+shared by the fleet's replica loop and the region's cell loop) with
+jittered exponential backoff, so a flapping or partitioned cell is
+given up on explicitly instead of hammered forever — while a fresh
+request always starts with a full budget.
+
+Lock order (dslint-enforced, docs/serving.md): ``Region._lock`` ->
+``ServingCell._lock`` -> ``ServingFleet._lock`` ->
+``ServingEngine._lock``. Fleet->region callbacks (retire hook, route /
+hand-off escalation) are invoked by the fleet OUTSIDE its own lock, so
+taking the region lock there cannot invert the order.
+
+Telemetry: counters/gauges under ``serving/region/...``; each cell's
+fleet under ``serving/<cell>/fleet/...`` and its replicas under
+``serving/<cell>/replica-N/...`` — per-cell namespacing end to end.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..resilience.chaos import get_fault_injector, is_reachable
+from ..resilience.clock import Clock, get_clock
+from ..telemetry.tracing import get_tracer, request_event
+from ..utils.logging import log_dist, logger
+from .cell import CellDigest, CellUnreachable, ServingCell, check_reachable
+from .fleet import ServingFleet, route_budget_for
+from .request import Request, RequestState
+from .router import ConsistentHashRing, _hash64, prefix_key
+from .server import emit_request_span, stream_tokens
+
+#: the brownout ladder's top rung: an effectively-infinite priority
+#: floor (shed ALL new work) without overflowing int arithmetic when
+#: reachable capacity is zero and pressure divides to infinity
+FLOOR_MAX = 1 << 30
+
+
+class Region:
+    """Cell-based fleet-of-fleets serving front-end (docs/serving.md
+    "Region & cells"). Same call surface as :class:`ServingFleet` /
+    :class:`ServingEngine`, one tier up.
+
+    ``engine_factory`` must return a fresh engine per call — it is
+    handed to every cell's fleet. ``config`` is the
+    :class:`~deepspeed_tpu.config.RegionConfig`; ``fleet_config`` /
+    ``serving_config`` apply to every cell identically (cells are
+    interchangeable failure domains). With ``start=False`` nothing
+    ticks on its own: drive deterministically via :meth:`step`.
+    """
+
+    def __init__(self, engine_factory, config: Any = None,
+                 fleet_config: Any = None,
+                 serving_config: Any = None,
+                 preemption_guard: Any = None,
+                 start: bool = True,
+                 clock: Optional[Clock] = None,
+                 name: str = "region"):
+        from ..config import FleetConfig, RegionConfig, ServingConfig
+
+        if config is None:
+            config = RegionConfig()
+        elif isinstance(config, dict):
+            config = RegionConfig.from_dict(config)
+        self.config = config
+        if fleet_config is None:
+            fleet_config = FleetConfig()
+        elif isinstance(fleet_config, dict):
+            fleet_config = FleetConfig.from_dict(fleet_config)
+        self._fleet_config = fleet_config
+        if serving_config is None:
+            serving_config = ServingConfig()
+        elif isinstance(serving_config, dict):
+            serving_config = ServingConfig.from_dict(serving_config)
+        self._serving_config = serving_config
+        self.name = name
+        self._factory = engine_factory
+        self._guard = preemption_guard
+        self._start_drivers = start
+        self._clock = clock if clock is not None else get_clock()
+        self._lock = threading.RLock()
+        self._cells: Dict[str, ServingCell] = {}
+        self._ring = ConsistentHashRing(vnodes=config.cell_ring_vnodes)
+        self._requests: Dict[int, Tuple[Request, str]] = {}
+        self._accepting = True
+        self._shed_backlog: List[Request] = []
+        self._sla_window = collections.deque(maxlen=fleet_config.sla_window)
+        self._stop_evt = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        # route retries draw from the REQUEST's own budget
+        # (route_budget_for): fleet-internal replica retries and
+        # region-level cell retries share the request's pool, so a
+        # partitioned cell cannot be hammered forever by EITHER tier's
+        # re-route loop (satellite: resilience/retry.py wiring).
+        # Deterministic jitter, same rule as the cell tier: name-seeded
+        # rng so a DST replay draws the identical backoff sequence.
+        self._route_rng = random.Random(f"{name}/route")
+        # brownout ladder state (docs/serving.md): floor 0 = off; floor
+        # f sheds NEW requests with priority < f
+        self._brownout_floor = 0
+        #: (t, kind, priority, floor) rows while a brownout is active —
+        #: the soak's strictly-priority-ordered shedding gate reads
+        #: this. Bounded: a production region under sustained overload
+        #: appends one row per admit/shed for as long as a floor is
+        #: up, and the audit only ever needs a recent window
+        self.brownout_log: collections.deque = collections.deque(
+            maxlen=4096)
+        self._partition_epoch_seen = 0
+        self._partition_active = False
+        self._cell_chaos_fired = False
+        # per-route work accounting, pinned by tests: digest lookups +
+        # ring steps for the LAST route and cumulatively — must be
+        # independent of replica count per request
+        self.route_work_last = 0
+        self.route_work_total = 0
+        for i in range(config.cells):
+            self._spawn_cell(f"cell-{i}")
+        # the region's prefix key must match the cells' prefix-cache
+        # unit (same rule as the fleet's affinity ring one tier down)
+        self._block_size = 16
+        first = next(iter(self._cells.values()), None)
+        if first is not None and first.fleet.replicas:
+            eng = first.fleet.replicas[0].engine
+            self._block_size = int(getattr(eng.config, "kv_block_size", 16))
+        log_dist(f"Region[{name}]: {len(self._cells)} cells x "
+                 f"{fleet_config.replicas} replicas "
+                 f"router={fleet_config.router} "
+                 f"brownout_step={config.brownout_queue_per_replica}")
+        self._refresh_digests()
+        if start:
+            self._monitor = threading.Thread(target=self._monitor_loop,
+                                             daemon=True,
+                                             name="region-monitor")
+            self._monitor.start()
+
+    def _spawn_cell(self, name: str) -> ServingCell:
+        fleet = ServingFleet(
+            self._factory_for(name), self._fleet_config,
+            self._serving_config,
+            preemption_guard=self._guard,
+            start=self._start_drivers,
+            clock=self._clock,
+            name=name,
+            on_retire=self._on_fleet_retire,
+            on_handoff_escalation=(
+                lambda req, export, _src=name:
+                self._escalate_handoff(_src, req, export)),
+            on_route_escalation=(
+                lambda req, _src=name:
+                self._escalate_route(_src, req)))
+        cell = ServingCell(name, fleet, self._clock)
+        # ring membership changes outside the lock: cells join only at
+        # construction (single-threaded), and the vnode insertion loop
+        # has no business running under the routing lock
+        self._ring.join(name)
+        with self._lock:
+            self._cells[name] = cell
+        return cell
+
+    def _factory_for(self, cell_name: str):
+        # indirection point: multi-host deployments bind each cell's
+        # factory to its own host group; in-process every cell shares
+        # one factory
+        return self._factory
+
+    # -- telemetry -------------------------------------------------------
+    @property
+    def _telemetry(self):
+        from ..telemetry import get_telemetry
+
+        return get_telemetry()
+
+    def _count(self, name: str, n: float = 1.0) -> None:
+        self._telemetry.registry.counter(f"serving/region/{name}").inc(n)
+
+    def _update_gauges(self) -> None:
+        t = self._telemetry
+        if not t.enabled:
+            return
+        with self._lock:
+            cells = list(self._cells.values())
+            floor = self._brownout_floor
+        alive = [c for c in cells if c.alive]
+        reachable = [c for c in alive
+                     if is_reachable(self.name, c.name)]
+        depth = 0
+        for c in reachable:
+            # bind once: a concurrent mark_dead() nulls c.digest
+            d = c.digest
+            if d is not None:
+                depth += d.queue_depth
+        r = t.registry
+        r.gauge("serving/region/cells").set(len(alive))
+        r.gauge("serving/region/reachable_cells").set(len(reachable))
+        r.gauge("serving/region/queue_depth").set(depth)
+        r.gauge("serving/region/brownout_floor").set(floor)
+
+    # -- submission ------------------------------------------------------
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               priority: int = 0,
+               deadline_s: Optional[float] = None,
+               ttft_deadline_s: Optional[float] = None,
+               client_request_id: Optional[str] = None,
+               on_token=None) -> Request:
+        """Route a request through the cell ring. Same contract as
+        ``ServingFleet.submit``: returns immediately, possibly already
+        REJECTED (brownout shed, no reachable cell, backpressure)."""
+        req = Request(
+            prompt=list(prompt),
+            max_new_tokens=(max_new_tokens if max_new_tokens is not None
+                            else self._serving_config.default_max_new_tokens),
+            eos_token_id=eos_token_id, priority=priority,
+            deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+            client_request_id=client_request_id, on_token=on_token)
+        # one timebase per lifecycle (the fleet/engine rule, one tier up)
+        req._clock = self._clock
+        req.t_submit = self._clock.now()
+        tracer = get_tracer()
+        if tracer.enabled:
+            req._trace_root = tracer.new_trace(
+                "request", prompt_tokens=len(req.prompt),
+                priority=req.priority)
+        self._route_request(req)
+        self._flush_shed()
+        return req
+
+    def _cell_eligible(self, name: str, refused: set,
+                       counter: List[int]) -> Optional[CellDigest]:
+        """Digest-only eligibility read (NO fleet/replica access): the
+        entire per-cell routing cost. ``counter`` meters the work."""
+        counter[0] += 1
+        if name in refused:
+            return None
+        cell = self._cells.get(name)
+        if cell is None or not cell.alive:
+            return None
+        if not is_reachable(self.name, name):
+            return None
+        d = cell.digest
+        if d is None or not d.accepting or d.healthy_replicas <= 0:
+            return None
+        return d
+
+    def _pick_cell(self, prompt: Sequence[int],
+                   refused: set) -> Optional[str]:
+        """Two-tier hash tier one: walk the cell ring from the prompt's
+        prefix-key hash, judging each candidate by its PUBLISHED digest;
+        then the optional spill valve (an overloaded primary cell spills
+        to the least-loaded reachable one — affinity is a throughput
+        optimisation, not a hostage situation, at this tier too)."""
+        work = [0]
+        digests: Dict[str, CellDigest] = {}
+
+        def eligible(name: str) -> bool:
+            d = self._cell_eligible(name, refused, work)
+            if d is None:
+                return False
+            digests[name] = d
+            return True
+
+        h = _hash64(",".join(map(str, prefix_key(prompt,
+                                                 self._block_size))))
+        chosen = self._ring.walk(h, eligible)
+        spill = self.config.cell_spill_load
+        if (chosen is not None and spill > 0
+                and digests[chosen].load_per_replica >= spill):
+            # the spill scan reads every cell's DIGEST (O(cells),
+            # replica-independent — the same accounting unit as the walk)
+            for name in self._cells:
+                if name not in digests:
+                    d = self._cell_eligible(name, refused, work)
+                    if d is not None:
+                        digests[name] = d
+            alt = min(digests,
+                      key=lambda n: (digests[n].load_per_replica, n))
+            if digests[alt].load_per_replica \
+                    < digests[chosen].load_per_replica:
+                chosen = alt
+        self.route_work_last = work[0]
+        self.route_work_total += work[0]
+        return chosen
+
+    def _route_request(self, req: Request, requeue: bool = False) -> bool:
+        """Tier-one placement loop. New work passes the brownout gate;
+        continuations (cell failover, cross-cell degrade) bypass it —
+        they were already admitted. Failures ALWAYS end in a terminal
+        REJECTED span (never silent); refusals retry other cells under
+        the request's own budget, shared with the fleet tier's loop."""
+        tracer = get_tracer()
+        if requeue:
+            request_event(req, "region_reroute")
+        refused: set = set()
+        backoff = self._fleet_config.route_backoff_s
+        while True:
+            span = tracer.begin_span(
+                "region_route", getattr(req, "_trace_root", None),
+                requeue=bool(requeue), attempt=len(refused))
+            with self._lock:
+                if not self._accepting and not requeue:
+                    tracer.finish_span(span, error="region closed")
+                    self._reject(req, "region closed to new requests")
+                    return False
+                floor = self._brownout_floor
+                if not requeue and floor > 0 and req.priority < floor:
+                    tracer.finish_span(span, error="brownout",
+                                       floor=floor)
+                    self._shed_brownout(req, floor)
+                    return False
+                name = self._pick_cell(req.prompt, refused)
+                if name is None:
+                    tracer.finish_span(span, error="no reachable cell")
+                    self._reject(req, "no reachable cell with capacity")
+                    return False
+                self._requests[req.uid] = (req, name)
+                cell = self._cells[name]
+            accepted = cell.fleet.route_request(req, requeue=requeue,
+                                                shed=False)
+            tracer.finish_span(span, cell=name, accepted=accepted,
+                               work=self.route_work_last)
+            if accepted:
+                self._count("routed")
+                if floor > 0 and not requeue:
+                    with self._lock:
+                        self.brownout_log.append(
+                            {"t": self._clock.now(), "kind": "admit",
+                             "priority": req.priority, "floor": floor})
+                return True
+            refused.add(name)
+            with self._lock:
+                ent = self._requests.get(req.uid)
+                if ent is not None and ent[1] == name:
+                    del self._requests[req.uid]
+            if not route_budget_for(
+                    req, self._fleet_config.route_retry_budget).take(
+                        "region_route"):
+                request_event(req, "route_budget_exhausted")
+                logger.warning(f"Region[{self.name}]: route retry budget "
+                               f"exhausted for request {req.uid}")
+                self._reject(req, "route retry budget exhausted")
+                return False
+            self._count("route_retries")
+            d = backoff
+            if d > 0:
+                d *= 1.0 + self._route_rng.uniform(
+                    0.0, self._fleet_config.route_backoff_jitter)
+                self._clock.sleep(d)
+            backoff = min(backoff * 2.0, 1.0)
+
+    # -- shedding --------------------------------------------------------
+    def _shed_brownout(self, req: Request, floor: int) -> None:
+        """Priority-tiered load shed (region lock held, reentrant). The
+        span (emitted at the next flush, outside the lock) carries the
+        brownout reason — sheds are EXPLICIT: a terminal REJECTED span
+        per shed request, audited by the DST shed-span invariant."""
+        self.brownout_log.append(
+            {"t": self._clock.now(), "kind": "shed",
+             "priority": req.priority, "floor": floor})
+        self._count("brownout_sheds")
+        request_event(req, "brownout_shed", floor=floor)
+        self._reject(req, f"brownout: shed at priority {req.priority} "
+                          f"< floor {floor}")
+
+    def _reject(self, req: Request, reason: str) -> None:
+        """Region-level shed. Same observable contract as fleet/replica
+        rejects: terminal REJECTED + span in requests.jsonl + an SLA
+        miss when the request carried an SLO. Span I/O deferred out of
+        the lock (the fleet's backlog discipline, one tier up)."""
+        req.error = reason
+        req.transition(RequestState.REJECTED)
+        self._count("rejected")
+        with self._lock:
+            self._shed_backlog.append(req)
+
+    def _flush_shed(self) -> None:
+        if not self._shed_backlog:
+            return
+        with self._lock:
+            backlog, self._shed_backlog = self._shed_backlog, []
+        for req in backlog:
+            emit_request_span(self._telemetry, req)
+            self._on_fleet_retire(req)
+
+    # -- fleet callbacks (invoked OUTSIDE fleet locks) -------------------
+    def _on_fleet_retire(self, req: Request) -> None:
+        had_slo = (req.deadline_s is not None
+                   or req.ttft_deadline_s is not None)
+        with self._lock:
+            self._requests.pop(req.uid, None)
+            if req.state is RequestState.FINISHED:
+                verdict = req.in_slo()
+                if verdict is not None:
+                    self._sla_window.append(bool(verdict))
+            elif had_slo and not (req.state is RequestState.CANCELLED
+                                  and req.error is None):
+                self._sla_window.append(False)
+
+    def _escalate_route(self, src_cell: str, req: Request) -> bool:
+        """A cell found no replica for a CONTINUATION: place it on
+        another cell (re-prefill resume — the request's engine state is
+        already gone). True = the region took responsibility (placed or
+        terminally shed); False = untouched."""
+        self._count("route_escalations")
+        request_event(req, "cross_cell_reroute", source=src_cell)
+        with self._lock:
+            ent = self._requests.get(req.uid)
+            if ent is not None and ent[1] == src_cell:
+                del self._requests[req.uid]
+        self._route_request(req, requeue=True)
+        self._flush_shed()
+        return True     # placed or region-shed — either way, handled
+
+    def _escalate_handoff(self, src_cell: str, req: Request,
+                          export) -> bool:
+        """Cross-cell KV adoption: the source cell has nobody to decode
+        a prefilled hand-off. Offer the (request, KV export) pair to
+        reachable cells in digest-load order; an active partition makes
+        the pair's transfer fail TYPED (:class:`CellUnreachable`); when
+        nobody reachable can adopt, the pair is handed BACK to the
+        source fleet (False return), whose prefill replica decodes it
+        itself — the KV is already there, and a region-side re-prefill
+        would land back on that same live prefill pool with the
+        hand-off flag re-armed, ping-ponging forever. Only when local
+        decode is impossible too does the fleet escalate the route for
+        a full re-prefill on a reachable cell — degraded, never
+        lost."""
+        with self._lock:
+            cells = [c for c in self._cells.values()
+                     if c.alive and c.name != src_cell]
+        candidates = []
+        for c in cells:
+            # bind once: a concurrent mark_dead() nulls c.digest
+            d = c.digest
+            if d is not None and d.accepting and d.healthy_replicas > 0:
+                candidates.append((c.name, d))
+        candidates.sort(key=lambda nd: (nd[1].load_per_replica, nd[0]))
+        for name, _d in candidates:
+            try:
+                # the KV pages travel cell-to-cell: BOTH the inter-cell
+                # link and the region's control link must be up
+                check_reachable(src_cell, name, op="kv_adoption")
+                check_reachable(self.name, name, op="kv_adoption")
+            except CellUnreachable as e:
+                self._count("partition_blocked_handoffs")
+                request_event(req, "partition_degrade", target=name,
+                              op=e.op)
+                continue
+            # table entry BEFORE the placement: a fast replica could
+            # adopt, decode and retire the request while we are still
+            # here, and the retire hook must find the entry to pop —
+            # registering after the fact would resurrect it as a stale
+            # row (the convergence invariant's terminal-in-table case)
+            with self._lock:
+                self._requests[req.uid] = (req, name)
+            if self._cells[name].fleet.place_handoff(req, export):
+                self._count("handoff_escalations")
+                request_event(req, "cross_cell_handoff",
+                              source=src_cell, target=name)
+                return True
+            with self._lock:
+                ent = self._requests.get(req.uid)
+                if ent is not None and ent[1] == name:
+                    del self._requests[req.uid]
+        # nobody reachable can adopt the KV: hand the pair back to the
+        # source fleet (False), whose prefill replica decodes it itself
+        # as the last resort — the KV is already THERE, and a re-prefill
+        # from here would just land back on that same prefill pool with
+        # the hand-off flag re-armed (an endless prefill->hand-off->
+        # degrade cycle). The fleet escalates the route back up only
+        # when local decode is impossible too.
+        self._count("handoff_degrades")
+        request_event(req, "handoff_degraded", source=src_cell)
+        return False
+
+    # -- streaming / cancel ----------------------------------------------
+    def stream(self, prompt: Sequence[int], **kwargs):
+        """Generator yielding tokens as they are emitted (see
+        ``ServingEngine.stream``)."""
+        return stream_tokens(self, prompt, **kwargs)
+
+    def cancel(self, req) -> bool:
+        """Cancel by Request or uid, wherever in the region it lives."""
+        with self._lock:
+            if not isinstance(req, Request):
+                ent = self._requests.get(int(req))
+                if ent is None:
+                    return False
+                req = ent[0]
+            if req.is_terminal:
+                return False
+            req._cancel_requested = True
+            ent = self._requests.get(req.uid)
+            cell = self._cells.get(ent[1]) if ent is not None else None
+        if cell is not None:
+            cell.fleet.cancel(req)
+        return True
+
+    # -- monitor ---------------------------------------------------------
+    def poll(self) -> None:
+        """One monitor pass: injected chaos, partition-state tracking,
+        digest refresh (the ONE place replicas are scanned), dead-cell
+        detection, the brownout ladder. Tests call it directly; the
+        monitor thread loops it."""
+        self._check_chaos()
+        self._check_partitions()
+        self._refresh_digests()
+        self._check_dead_cells()
+        self._update_brownout()
+        self._flush_shed()
+        self._update_gauges()
+
+    def _monitor_loop(self) -> None:
+        while not self._clock.wait_event(self._stop_evt,
+                                         self.config.health_interval_s):
+            try:
+                self.poll()
+            except Exception:  # dslint: disable=exception-discipline -- monitor-loop bug guard: a digest/brownout crash must not kill the region thread; typed faults are handled inside poll()
+                logger.exception("Region: monitor pass crashed")
+
+    def _check_chaos(self) -> None:
+        if self._cell_chaos_fired:
+            return
+        inj = get_fault_injector()
+        if inj is None:
+            return
+        with self._lock:
+            cells = [c for c in self._cells.values() if c.alive]
+        for cell in cells:
+            if inj.should_kill_cell(cell.index, cell.ticks()):
+                self._cell_chaos_fired = True
+                self.kill_cell(cell.name, reason="chaos: injected cell "
+                                                 "outage")
+                return
+
+    def _check_partitions(self) -> None:
+        """Track the injector's partition epoch; on a change, record the
+        new connectivity in the flight recorder (a partition is exactly
+        the event whose trigger/fallout adjacency a post-mortem needs)
+        and — on heal — rebalance queued work onto rejoined capacity."""
+        inj = get_fault_injector()
+        epoch = 0 if inj is None else inj.partition_epoch
+        if epoch == self._partition_epoch_seen:
+            return
+        self._partition_epoch_seen = epoch
+        active = inj is not None and inj.partitioned
+        was_active = self._partition_active
+        self._partition_active = active
+        tracer = get_tracer()
+        if active:
+            unreachable = sorted(
+                name for name in self._cells
+                if not is_reachable(self.name, name))
+            self._count("partitions_detected")
+            logger.warning(f"Region: partition detected; unreachable "
+                           f"cells: {unreachable or 'none (inter-cell only)'}")
+            if tracer.enabled:
+                tracer.flight.note("partition_detected",
+                                   unreachable=",".join(unreachable))
+                tracer.flight.dump("partition-detected")
+        elif was_active:
+            self._count("partitions_healed")
+            logger.warning("Region: partition healed; rebalancing")
+            if tracer.enabled:
+                tracer.flight.note("partition_healed")
+            self._rebalance()
+
+    def _refresh_digests(self) -> None:
+        with self._lock:
+            cells = [c for c in self._cells.values() if c.alive]
+        for cell in cells:
+            cell.publish_digest()
+
+    def _check_dead_cells(self) -> None:
+        """A cell whose digest reports zero healthy replicas and whose
+        fleet will not respawn them is DEAD — declare it (flight-dump),
+        harvest, re-place. Respawning fleets are left to self-heal: a
+        premature declaration would double-place work the respawned
+        replicas still own."""
+        with self._lock:
+            cells = [c for c in self._cells.values() if c.alive]
+        for cell in cells:
+            d = cell.digest
+            if (d is not None and d.healthy_replicas == 0
+                    and not cell.fleet.config.respawn):
+                self.kill_cell(cell.name,
+                               reason="no healthy replicas left")
+
+    def _update_brownout(self) -> None:
+        """Walk the brownout ladder from reachable-capacity pressure
+        (queued per healthy reachable replica, digests only). The floor
+        climbs immediately with pressure; it descends only through the
+        ``brownout_exit_ratio`` hysteresis band, so the region does not
+        flap at a threshold."""
+        with self._lock:
+            cells = [c for c in self._cells.values()
+                     if c.alive and is_reachable(self.name, c.name)]
+        queue = healthy = 0
+        for c in cells:
+            d = c.digest
+            if d is None:
+                continue
+            queue += d.queue_depth
+            healthy += d.healthy_replicas
+        if healthy <= 0:
+            pressure = float("inf") if queue else 0.0
+        else:
+            pressure = queue / healthy
+        step = self.config.brownout_queue_per_replica
+        level = (FLOOR_MAX if pressure == float("inf")
+                 else min(FLOOR_MAX, int(pressure // step)))
+        tracer = get_tracer()
+        with self._lock:
+            cur = self._brownout_floor
+            if level > cur:
+                new = level
+            elif level < cur and pressure \
+                    <= self.config.brownout_exit_ratio * cur * step:
+                # <= not <: at exit_ratio 0 (a value validation allows)
+                # a fully drained region (pressure 0.0) must still
+                # descend, or one transient burst sheds low-priority
+                # work forever
+                new = level
+            else:
+                new = cur
+            self._brownout_floor = new
+        if new == cur:
+            return
+        if cur == 0 and new > 0:
+            self._count("brownout_entered")
+            logger.warning(f"Region: BROWNOUT entered (floor {new}, "
+                           f"pressure {pressure:.1f}/replica)")
+            if tracer.enabled:
+                tracer.flight.note("brownout_entered", floor=new)
+                tracer.flight.dump("brownout-entered")
+        elif cur > 0 and new == 0:
+            self._count("brownout_exited")
+            logger.warning("Region: brownout exited")
+            if tracer.enabled:
+                tracer.flight.note("brownout_exited")
+                tracer.flight.dump("brownout-exited")
+        else:
+            self._count("brownout_floor_moves")
+            if tracer.enabled:
+                tracer.flight.note("brownout_floor", floor=new)
+
+    # -- chaos / failover -----------------------------------------------
+    def kill_cell(self, name: str, reason: str = "killed") -> bool:
+        """Whole-cell outage: correlated death of every replica in one
+        failure domain. The cell leaves the ring, every admitted request
+        is harvested (its KV discarded as suspect) and re-placed on
+        reachable cells through the bit-exact re-prefill resume path —
+        under load, zero admitted requests are lost: each finishes
+        elsewhere or retires with a REJECTED span."""
+        with self._lock:
+            cell = self._cells.get(name)
+            if cell is None or not cell.alive:
+                return False
+            self._ring.leave(name)
+        logger.warning(f"Region: cell {name} died ({reason})")
+        self._count("cell_outages")
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.flight.note("cell_outage", cell=name, reason=reason)
+            tracer.flight.dump("cell-outage")
+        orphans = cell.kill(reason)
+        self._failover_orphans(orphans, source=name)
+        self._update_brownout()     # reachable capacity just shrank
+        self._update_gauges()
+        return True
+
+    def _failover_orphans(self, orphans: List[Request],
+                          source: str) -> None:
+        if orphans:
+            self._count("cell_failovers", len(orphans))
+        for req in orphans:
+            request_event(req, "cell_failover", source=source)
+            if req._cancel_requested:
+                req.transition(RequestState.CANCELLED)
+                self._count("cancelled")
+                emit_request_span(self._telemetry, req)
+                self._on_fleet_retire(req)
+                continue
+            self._route_request(req, requeue=True)
+        self._flush_shed()
+
+    def _rebalance(self) -> None:
+        """Heal-time rebalance: re-spread QUEUED (stateless) work from
+        cells that bore the partition onto rejoined capacity. Only
+        requests holding no engine state move — live decodes stay where
+        their KV lives. Conservative by design: steal only the excess
+        above the reachable mean + threshold."""
+        if self.config.rebalance_threshold <= 0:
+            return
+        self._refresh_digests()
+        with self._lock:
+            alive = [c for c in self._cells.values()
+                     if c.alive and is_reachable(self.name, c.name)]
+        # snapshot each digest ONCE: a concurrent mark_dead() nulls it
+        snap = []
+        for c in alive:
+            d = c.digest
+            if d is not None and d.healthy_replicas > 0:
+                snap.append((c, d))
+        if len(snap) < 2:
+            return
+        total_q = sum(d.queue_depth for _c, d in snap)
+        total_h = sum(d.healthy_replicas for _c, d in snap)
+        mean = total_q / max(1, total_h)
+        moved = 0
+        cells = [c for c, _d in snap]
+        loads = {c.name: d.load_per_replica for c, d in snap}
+        healthy = {c.name: d.healthy_replicas for c, d in snap}
+        for cell in sorted(cells, key=lambda c: (-loads[c.name], c.name)):
+            excess = loads[cell.name] - (mean
+                                         + self.config.rebalance_threshold)
+            if excess <= 0:
+                continue
+            n = int(excess * healthy[cell.name])
+            if n <= 0:
+                continue
+            stolen = cell.fleet.steal_queued(n)
+            with self._lock:
+                for req in stolen:
+                    self._requests.pop(req.uid, None)
+            for req in stolen:
+                request_event(req, "rebalance", source=cell.name)
+                target = min((name for name in loads
+                              if name != cell.name),
+                             key=lambda name: (loads[name], name))
+                # entry before placement (see _escalate_handoff): the
+                # retire hook must always find the row to pop
+                with self._lock:
+                    self._requests[req.uid] = (req, target)
+                placed = self._cells[target].fleet.route_request(
+                    req, requeue=True, shed=False)
+                if placed:
+                    loads[target] += 1.0 / max(1, healthy[target])
+                    moved += 1
+                else:
+                    # target refused (raced a stop): normal region
+                    # re-route — places or sheds with a span
+                    with self._lock:
+                        ent = self._requests.get(req.uid)
+                        if ent is not None and ent[1] == target:
+                            del self._requests[req.uid]
+                    self._route_request(req, requeue=True)
+        if moved:
+            self._count("rebalanced", moved)
+            logger.info(f"Region: rebalanced {moved} queued requests "
+                        f"after heal")
+        self._flush_shed()
+
+    # -- shutdown --------------------------------------------------------
+    def drain(self, timeout: Optional[float] = None,
+              reject_queued: bool = False) -> bool:
+        """Stop admission region-wide and serve out every cell's
+        backlog (partitioned cells included: in-process their fleets
+        still run — a real deployment drains them when connectivity
+        returns)."""
+        with self._lock:
+            self._accepting = False
+            cells = [c for c in self._cells.values() if c.alive]
+        budget = (timeout if timeout is not None
+                  else self._serving_config.drain_timeout_s)
+        deadline = self._clock.deadline(budget)
+        ok = True
+        for cell in cells:
+            left = max(0.0, deadline - self._clock.now())
+            ok = cell.fleet.drain(timeout=left,
+                                  reject_queued=reject_queued) and ok
+        return ok
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        self.drain(timeout=timeout)
+        self._stop_evt.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        with self._lock:
+            cells = [c for c in self._cells.values() if c.alive]
+        for cell in cells:
+            cell.fleet.close(timeout=timeout)
+        self._flush_shed()
+        self._update_gauges()
+
+    def __enter__(self) -> "Region":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- deterministic driving (tests / DST) -----------------------------
+    def step(self) -> bool:
+        """Manual-mode driver: one region poll plus one fleet step per
+        live cell (the DST drive seam — docs/dst.md). Partitioned cells
+        STILL step: their compute is local, only their network is cut."""
+        self.poll()
+        did = False
+        with self._lock:
+            cells = [c for c in self._cells.values() if c.alive]
+        for cell in cells:
+            did = cell.step() or did
+        return did
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def cells(self) -> List[ServingCell]:
+        with self._lock:
+            return list(self._cells.values())
+
+    @property
+    def live_cells(self) -> List[ServingCell]:
+        with self._lock:
+            return [c for c in self._cells.values() if c.alive]
+
+    @property
+    def brownout_floor(self) -> int:
+        with self._lock:
+            return self._brownout_floor
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(c.fleet.queue_depth for c in self.live_cells)
+
+    @property
+    def live_requests(self) -> int:
+        return sum(c.fleet.live_requests for c in self.live_cells)
+
+    def in_sla_ratio(self) -> Optional[float]:
+        with self._lock:
+            if not self._sla_window:
+                return None
+            return sum(self._sla_window) / len(self._sla_window)
+
+    def block_leaks(self) -> List[str]:
+        """Region-wide KV leak audit: the union of every cell's fleet
+        audit, dead cells included (their evacuations must balance)."""
+        problems: List[str] = []
+        for cell in self.cells:
+            problems.extend(cell.block_leaks())
+        return problems

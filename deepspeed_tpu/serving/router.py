@@ -65,6 +65,71 @@ def least_loaded_pick(replicas: Dict[str, float]) -> str:
     return min(replicas.items(), key=lambda kv: (kv[1], kv[0]))[0]
 
 
+class ConsistentHashRing:
+    """The classic consistent-hash ring over member names — the ONE
+    construction both routing tiers use: the fleet's replica ring
+    (:class:`PrefixAffinityRouter`) and the region's cell ring
+    (:class:`~.region.Region`). ``vnodes`` virtual points per member,
+    sorted by a process-stable sha256-derived hash; a key routes to the
+    first point clockwise. Membership changes move a bounded key set:
+    a join moves ~1/(N+1) of keys (all TO the joiner), a leave moves
+    only the leaver's own keys — the property failover at BOTH tiers
+    depends on (one dead cell must not reshuffle the healthy cells'
+    working sets any more than one dead replica may)."""
+
+    def __init__(self, vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = int(vnodes)
+        self._ring: List[Tuple[int, str]] = []   # (point, member) sorted
+        self._points: List[int] = []             # parallel sorted points
+        self._members: set = set()
+
+    @property
+    def members(self) -> set:
+        return set(self._members)
+
+    def join(self, member: str) -> None:
+        if member in self._members:
+            return
+        self._members.add(member)
+        for i in range(self.vnodes):
+            point = _hash64(f"{member}#{i}")
+            j = bisect.bisect_left(self._points, point)
+            self._points.insert(j, point)
+            self._ring.insert(j, (point, member))
+
+    def leave(self, member: str) -> None:
+        if member not in self._members:
+            return
+        self._members.discard(member)
+        keep = [(p, r) for p, r in self._ring if r != member]
+        self._ring = keep
+        self._points = [p for p, _ in keep]
+
+    def walk(self, h: int,
+             eligible: Optional[Callable[[str], bool]] = None
+             ) -> Optional[str]:
+        """First member clockwise from ``h``, skipping ones ``eligible``
+        rejects (each DISTINCT member is offered to ``eligible`` at most
+        once — the walk's cost is O(distinct members examined), not
+        O(vnodes)). None when the ring is empty or nothing qualifies."""
+        if not self._ring:
+            return None
+        start = bisect.bisect_right(self._points, h) % len(self._ring)
+        seen: set = set()
+        for off in range(len(self._ring)):
+            _, rep = self._ring[(start + off) % len(self._ring)]
+            if rep in seen:
+                continue
+            seen.add(rep)
+            if eligible is None or eligible(rep):
+                return rep
+            if len(seen) == len(self._members):
+                break
+        return None
+
+
 class RouterPolicy:
     """Base router: pick a replica name for a prompt.
 
@@ -126,9 +191,7 @@ class PrefixAffinityRouter(RouterPolicy):
         self.block_size = int(block_size)
         self.vnodes = int(vnodes)
         self.spill_load = int(spill_load)
-        self._ring: List[Tuple[int, str]] = []   # (point, replica) sorted
-        self._points: List[int] = []             # parallel sorted points
-        self._members: set = set()
+        self._hash_ring = ConsistentHashRing(vnodes=vnodes)
         # set by route(): True when the last pick was the ring's primary
         # owner (an affinity hit), False on ring-walk fallback or spill
         self.last_was_primary: Optional[bool] = None
@@ -137,22 +200,10 @@ class PrefixAffinityRouter(RouterPolicy):
 
     # -- membership ------------------------------------------------------
     def on_join(self, replica: str) -> None:
-        if replica in self._members:
-            return
-        self._members.add(replica)
-        for i in range(self.vnodes):
-            point = _hash64(f"{replica}#{i}")
-            j = bisect.bisect_left(self._points, point)
-            self._points.insert(j, point)
-            self._ring.insert(j, (point, replica))
+        self._hash_ring.join(replica)
 
     def on_leave(self, replica: str) -> None:
-        if replica not in self._members:
-            return
-        self._members.discard(replica)
-        keep = [(p, r) for p, r in self._ring if r != replica]
-        self._ring = keep
-        self._points = [p for p, _ in keep]
+        self._hash_ring.leave(replica)
 
     # -- routing ---------------------------------------------------------
     def _hash_for(self, prompt: Sequence[int]) -> int:
@@ -173,20 +224,7 @@ class PrefixAffinityRouter(RouterPolicy):
         """Ring walk from a precomputed key hash (``route`` needs both
         the unconditional primary and the health-filtered pick — hashing
         the prompt once serves both walks)."""
-        if not self._ring:
-            return None
-        start = bisect.bisect_right(self._points, h) % len(self._ring)
-        seen: set = set()
-        for off in range(len(self._ring)):
-            _, rep = self._ring[(start + off) % len(self._ring)]
-            if rep in seen:
-                continue
-            seen.add(rep)
-            if eligible is None or eligible(rep):
-                return rep
-            if len(seen) == len(self._members):
-                break
-        return None
+        return self._hash_ring.walk(h, eligible)
 
     def route(self, replicas: Dict[str, float],
               prompt: Sequence[int]) -> str:

@@ -516,6 +516,45 @@ class ServingEngine:
                     + len(self._adoptions) + len(self._handoff_backlog)
                     + self._handoffs_in_flight)
 
+    def snapshot(self) -> Tuple[int, int, int]:
+        """(queue_depth, live, pending_work) under ONE lock acquisition —
+        the cell-digest publisher reads every replica each poll, and
+        three separate locked property reads per replica would triple
+        the digest's lock traffic for values that must be mutually
+        consistent anyway."""
+        with self._lock:
+            pens = (len(self._adoptions) + len(self._handoff_backlog)
+                    + self._handoffs_in_flight)
+            return (len(self._queue), len(self._live),
+                    len(self._queue) + len(self._live) + pens)
+
+    def steal_queued(self, max_n: int) -> List[Request]:
+        """Remove up to ``max_n`` requests from the TAIL of the admission
+        queue for placement elsewhere (the region's heal-time rebalance
+        seam). Only QUEUED, cancel-free requests are taken — they hold
+        no engine state, so moving them is pure bookkeeping; the head of
+        the queue stays (it is closest to admission HERE, moving it
+        would add latency, not shed it). The stolen requests stay QUEUED
+        and MUST be re-routed by the caller: a steal without a matching
+        re-route is a lost request, exactly what the DST conservation
+        invariant exists to catch."""
+        out: List[Request] = []
+        with self._lock:
+            for req in reversed(list(self._queue)):
+                if len(out) >= max_n:
+                    break
+                if req._cancel_requested:
+                    continue      # must die here, where cancel() saw it
+                self._queue.remove(req)
+                self._requests.pop(req.uid, None)
+                end_request_segment(req, outcome="rebalanced")
+                out.append(req)
+            for req in out:
+                # a previously preempted uid's resume marker must not
+                # suppress telemetry when the uid re-prefills elsewhere
+                self._engine.clear_resume(req.uid)
+        return out
+
     def block_leaks(self) -> List[str]:
         """Allocator block-balance problems (empty = zero leak). Valid
         when idle (post-drain); mid-tick reads race the driver."""

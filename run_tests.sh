@@ -56,6 +56,21 @@ if [ "$#" -eq 0 ]; then
         smoke_rc=$dst_rc
     fi
 
+    # region soak (CPU evidence lane, docs/serving.md "Region & cells",
+    # docs/dst.md "Region-scale events"): >= 200 seeded REGION chaos
+    # schedules — whole-cell outages, inter-cell partitions + heals,
+    # autoscaler lag, plus every fleet-tier fault — through the real
+    # two-tier serving stack on virtual time. Gates: zero invariant
+    # violations (incl. heal convergence / single ownership and
+    # shed-span), bit-identical (trace_hash, span_hash) replay, every
+    # fault kind exercised, brownout shedding strictly priority-ordered.
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/region_soak.py
+    region_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$region_rc
+    fi
+
     # serving-scheduler smoke (CPU evidence lane, docs/serving.md): on
     # VIRTUAL time (SimClock; deterministic, no calibration or jitter
     # bands) the SLO-aware policy must serve every offered request

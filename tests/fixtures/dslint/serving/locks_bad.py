@@ -1,0 +1,42 @@
+"""dslint fixture: PLANTED region/cell lock-order violations.
+
+Class names deliberately shadow the real serving classes so the
+documented region -> cell -> fleet -> replica order applies here too
+(the rule matches lock keys by "Class.attr" suffix). One inversion per
+tier boundary; NO descending edges in this file, so the cycle detector
+stays quiet and only the planted order-violations fire.
+"""
+import threading
+
+
+class Region:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def admit(self, cell):
+        with self._lock:
+            pass
+
+
+class ServingCell:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def escalate(self, region):
+        with self._lock:
+            region.admit(self)            # PLANT: order-violation
+                                          # (cell lock -> region lock)
+
+    def note(self):
+        with self._lock:
+            pass
+
+
+class ServingFleet:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def publish(self, cell):
+        with self._lock:
+            cell.note()                   # PLANT: order-violation
+                                          # (fleet lock -> cell lock)

@@ -1,0 +1,40 @@
+"""dslint fixture: near-miss TRUE NEGATIVES for the region/cell lock
+order — every edge descends the documented region -> cell -> fleet ->
+replica order, and the upward callback runs OUTSIDE the lower lock
+(the real layer's discipline)."""
+import threading
+
+
+class ServingFleet:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._retire_hook = None
+
+    def tick(self):
+        with self._lock:
+            done = True
+        if done and self._retire_hook is not None:
+            # upward call OUTSIDE the fleet lock: no inversion
+            self._retire_hook(done)
+
+
+class ServingCell:
+    def __init__(self, fleet: ServingFleet):
+        self._lock = threading.RLock()
+        self.fleet = fleet
+
+    def publish(self):
+        with self._lock:
+            # documented order cell -> fleet: correct direction
+            self.fleet.tick()
+
+
+class Region:
+    def __init__(self, cell: ServingCell):
+        self._lock = threading.RLock()
+        self.cell = cell
+
+    def route(self):
+        with self._lock:
+            # documented order region -> cell: correct direction
+            self.cell.publish()

@@ -1,0 +1,286 @@
+"""Region-scale DST (resilience/dst.py): seeded schedules composing
+whole-cell outages, inter-cell partitions + heals, autoscaler lag and
+every fleet-tier fault, audited by the region invariants — plus the
+planted-bug proofs that each NEW invariant has teeth (double-ownership
+after heal, stranded requests, silent sheds) and ddmin shrinking of a
+planted double-ownership bug to a minimal repro. See docs/dst.md
+"Region-scale events".
+"""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.resilience.dst import (RegionSchedule, SimConfig,
+                                          SimEngine, dump_repro,
+                                          generate_region_schedule,
+                                          load_repro, run_region_schedule,
+                                          shrink_schedule)
+from deepspeed_tpu.serving.region import Region
+from deepspeed_tpu.serving.request import RequestState
+
+pytestmark = pytest.mark.fleet
+
+
+# ----------------------------------------------------------------------
+# determinism + corpus
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 3, 11])
+def test_same_seed_same_hashes(seed):
+    r1 = run_region_schedule(generate_region_schedule(seed))
+    r2 = run_region_schedule(generate_region_schedule(seed))
+    assert r1.trace_hash == r2.trace_hash
+    assert r1.span_hash == r2.span_hash
+    assert r1.tokens == r2.tokens
+    assert r1.ok and r2.ok
+
+
+def test_region_seed_stream_distinct_from_fleet_tier():
+    from deepspeed_tpu.resilience.dst import generate_schedule
+
+    assert generate_region_schedule(0).to_dict() \
+        != generate_schedule(0).to_dict()
+
+
+# Region-scale regression corpus (soak-found composition seeds — the
+# satellite's three named scenarios plus an everything-at-once seed):
+REGION_REGRESSION_SEEDS = [
+    30,   # cell outage in a DISAGGREGATED region under burst load —
+          # whole-cell death while prefill->decode hand-offs are in
+          # flight (outage mid-handoff)
+    32,   # partition + replica death in a disaggregated region with a
+          # later heal — cross-cell KV adoption attempted across the
+          # severed link (typed degrade to re-prefill), then heal +
+          # rebalance
+    45,   # heal-then-rebalance under brownout pressure: queued backlog
+          # built behind a partition is re-spread onto rejoined
+          # capacity while the shed ladder is active
+    51,   # everything at once: cell outage + partition + replica death
+          # + heal + rebalance in one disaggregated 3-cell schedule
+]
+
+
+@pytest.mark.parametrize("seed", REGION_REGRESSION_SEEDS)
+def test_region_regression_corpus_audits_clean(seed):
+    report = run_region_schedule(generate_region_schedule(seed))
+    assert report.ok, report.violations
+    assert report.submitted > 0
+    # terminal bins partition the submitted set (no-lost-request
+    # conservation across cell death and partition, end-state view)
+    assert (report.finished + report.cancelled + report.rejected
+            == report.submitted)
+
+
+def test_corpus_seeds_cover_the_named_scenarios():
+    """The corpus comments above must stay true if the generator
+    changes: re-derive each seed's features from its schedule."""
+    feats = {}
+    for seed in REGION_REGRESSION_SEEDS:
+        s = generate_region_schedule(seed)
+        kinds = {e.kind for e in s.events}
+        feats[seed] = (bool(s.fleet_cfg.get("disaggregated")), kinds,
+                       s.region_cfg.get("rebalance_threshold", 0))
+    disagg30, kinds30, _ = feats[30]
+    assert disagg30 and "cell_outage" in kinds30
+    disagg32, kinds32, rb32 = feats[32]
+    assert disagg32 and {"partition", "heal",
+                         "replica_death"} <= kinds32 and rb32 > 0
+    _, kinds45, rb45 = feats[45]
+    assert {"partition", "heal"} <= kinds45 and rb45 > 0
+    disagg51, kinds51, _ = feats[51]
+    assert disagg51 and {"cell_outage", "partition", "heal",
+                         "replica_death"} <= kinds51
+
+
+def test_region_mini_soak_window():
+    for seed in range(200, 215):
+        report = run_region_schedule(generate_region_schedule(seed))
+        assert report.ok, (seed, report.violations)
+
+
+def test_region_repro_json_roundtrip(tmp_path):
+    sched = generate_region_schedule(3)
+    path = str(tmp_path / "repro.json")
+    dump_repro(sched, ["demo"], path)
+    loaded, viol = load_repro(path)
+    assert isinstance(loaded, RegionSchedule)
+    assert viol == ["demo"]
+    assert json.dumps(loaded.to_dict(), sort_keys=True) == \
+        json.dumps(sched.to_dict(), sort_keys=True)
+    assert run_region_schedule(loaded).trace_hash == \
+        run_region_schedule(sched).trace_hash
+
+
+# ----------------------------------------------------------------------
+# the new invariants have teeth (one planted bug per invariant)
+# ----------------------------------------------------------------------
+
+class _DoubleOwnRegion(Region):
+    """PLANTED BUG: heal-time split-brain. The rebalance registers a
+    queued request with a SECOND cell's replica without fencing the
+    first — both sides of the healed partition now believe they own it
+    (the exact bug a fenceless cross-partition failover would mint)."""
+
+    def _rebalance(self):
+        cells = [c for c in self.cells if c.alive]
+        donor = None
+        for cell in cells:
+            for rep in cell.fleet.replicas:
+                with rep.serving._lock:
+                    if rep.serving._queue:
+                        donor = (cell, rep.serving._queue[0])
+                        break
+            if donor:
+                break
+        if donor is None:
+            return
+        cell, req = donor
+        for other in cells:
+            if other.name != cell.name and other.fleet.replicas:
+                tgt = other.fleet.replicas[0].serving
+                with tgt._lock:
+                    tgt._requests[req.uid] = req
+                return
+
+
+class _StrandRegion(Region):
+    """PLANTED BUG: heal-time loss. The rebalance steals a queued
+    request and drops it on the floor — non-terminal, owned by nobody,
+    tracked by nobody."""
+
+    def _rebalance(self):
+        for cell in self.cells:
+            if not cell.alive:
+                continue
+            stolen = cell.fleet.steal_queued(1)
+            if stolen:
+                with self._lock:
+                    for req in stolen:
+                        self._requests.pop(req.uid, None)
+                return
+
+
+class _StaleRowRegion(Region):
+    """PLANTED BUG: escalation bookkeeping leak. A retired request's
+    ownership row is left behind in a cell fleet's table — the shape of
+    an escalation path that hands ownership up to the region without
+    dropping the source fleet's row."""
+
+    def _on_fleet_retire(self, req):
+        super()._on_fleet_retire(req)
+        for cell in self.cells:
+            if cell.alive:
+                with cell.fleet._lock:
+                    cell.fleet._requests[req.uid] = (req, "replica-ghost")
+                return
+
+
+class _SilentShedRegion(Region):
+    """PLANTED BUG: silent drop. The brownout shed transitions the
+    request terminal with no span and no reason."""
+
+    def _shed_brownout(self, req, floor):
+        req.error = None
+        req.transition(RequestState.REJECTED)
+
+
+def test_auditor_catches_double_ownership_after_heal():
+    report = run_region_schedule(generate_region_schedule(48),
+                                 region_factory=_DoubleOwnRegion)
+    assert not report.ok
+    assert any("double ownership" in v or "expected exactly one owner"
+               in v for v in report.violations), report.violations
+
+
+def test_auditor_catches_stranded_request():
+    report = run_region_schedule(generate_region_schedule(30),
+                                 region_factory=_StrandRegion)
+    assert not report.ok
+    assert any("conservation" in v or "liveness" in v
+               for v in report.violations), report.violations
+
+
+def test_auditor_catches_stale_fleet_table_row():
+    report = run_region_schedule(generate_region_schedule(48),
+                                 region_factory=_StaleRowRegion)
+    assert not report.ok
+    assert any("stale ownership row" in v
+               for v in report.violations), report.violations
+
+
+def test_auditor_catches_silent_shed():
+    report = run_region_schedule(generate_region_schedule(17),
+                                 region_factory=_SilentShedRegion)
+    assert not report.ok
+    assert any("shed-span" in v or "span-ledger" in v
+               for v in report.violations), report.violations
+
+
+def test_clean_region_passes_where_bugs_fail():
+    """The planted-bug seeds are not self-failing: the SHIPPED region
+    audits clean on every one of them."""
+    for seed in (48, 30, 17):
+        report = run_region_schedule(generate_region_schedule(seed))
+        assert report.ok, (seed, report.violations)
+
+
+# ----------------------------------------------------------------------
+# ddmin on a planted double-ownership bug
+# ----------------------------------------------------------------------
+
+def test_shrink_planted_double_ownership_to_minimal_repro(tmp_path):
+    """The satellite gate: delta-debug a double-ownership failure down
+    to a 1-minimal event list that still reproduces, dump it, reload
+    it, and watch it fail again."""
+    sched = generate_region_schedule(48)
+
+    def fails(s):
+        return bool(run_region_schedule(
+            s, region_factory=_DoubleOwnRegion).violations)
+
+    assert fails(sched)
+    shrunk = shrink_schedule(sched, fails=fails)
+    assert isinstance(shrunk, RegionSchedule)
+    assert fails(shrunk)
+    assert len(shrunk.events) < len(sched.events)
+    # the bug needs a partition, its heal, and at least one request
+    # queued across the heal — the shrunk schedule keeps exactly that
+    # shape and nothing else survives 1-minimality
+    kinds = [e.kind for e in shrunk.events]
+    assert "heal" in kinds and "partition" in kinds and "submit" in kinds
+    for i in range(len(shrunk.events)):
+        remaining = shrunk.events[:i] + shrunk.events[i + 1:]
+        assert not fails(shrunk.replace_events(remaining)), \
+            "shrunk schedule is not 1-minimal"
+    path = dump_repro(shrunk, ["planted double ownership"],
+                      str(tmp_path / "r.json"))
+    loaded, _ = load_repro(path)
+    assert fails(loaded)
+
+
+# ----------------------------------------------------------------------
+# route-cost pin at the DST tier
+# ----------------------------------------------------------------------
+
+def test_routing_cost_flat_across_replica_scale():
+    """One schedule, two replica scales: per-submit route work is
+    identical (the engine count grows 4x, the routing work does not)."""
+    works = {}
+    for replicas in (1, 4):
+        sched = generate_region_schedule(5)
+        sched.fleet_cfg["replicas"] = replicas
+        sched.fleet_cfg.pop("disaggregated", None)
+        captured = []
+
+        class _Probe(Region):
+            def _route_request(self, req, requeue=False):
+                out = super()._route_request(req, requeue=requeue)
+                if not requeue:
+                    captured.append(self.route_work_last)
+                return out
+
+        report = run_region_schedule(sched, region_factory=_Probe)
+        assert report.ok, report.violations
+        works[replicas] = captured
+    assert works[1] == works[4]

@@ -1,0 +1,632 @@
+"""Region & cells: two-tier routing cost, whole-cell outage failover,
+partition semantics (typed errors, local service continuity, cross-cell
+adoption degrade), the brownout ladder, heal-time rebalance, the shared
+route-retry budget, and the region-event flight-recorder triggers
+(docs/serving.md "Region & cells").
+
+Everything runs on the host-only :class:`SimEngine` under a virtual
+clock — deterministic manual stepping, no threads in the assertions
+(the docs/dst.md drive discipline).
+"""
+
+import pytest
+
+from deepspeed_tpu.resilience.chaos import (FaultInjector,
+                                            install_fault_injector,
+                                            is_reachable)
+from deepspeed_tpu.resilience.clock import SimClock, use_clock
+from deepspeed_tpu.resilience.dst import SimConfig, SimEngine
+from deepspeed_tpu.serving import (CellUnreachable, Region, RequestState,
+                                   ServingFleet, check_reachable)
+from deepspeed_tpu.telemetry import get_telemetry
+from deepspeed_tpu.telemetry.tracing import Tracer, use_tracer
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    install_fault_injector(None)
+    yield
+    install_fault_injector(None)
+
+
+def _counter(name: str) -> float:
+    # the process-global telemetry stub latches its registry at
+    # construction; counters land THERE, so tests read deltas there too
+    return get_telemetry().registry.counter(name).value
+
+
+def _region(clock, cells=2, replicas=1, *, region_cfg=None, fleet_cfg=None,
+            serving_cfg=None, engine_cfg=None):
+    rc = {"cells": cells, "cell_ring_vnodes": 16}
+    rc.update(region_cfg or {})
+    fc = {"replicas": replicas, "router": "prefix_affinity",
+          "respawn": False}
+    fc.update(fleet_cfg or {})
+    sc = {"policy": "slo", "stuck_tick_timeout_s": 0.0,
+          "drain_timeout_s": 600.0, "poll_interval_s": 0.25}
+    sc.update(serving_cfg or {})
+    cfg = SimConfig(**(engine_cfg or {}))
+    return Region(lambda: SimEngine(cfg), rc, fc, sc, start=False,
+                  clock=clock)
+
+
+def _drive(region, clock, reqs, max_ticks=400):
+    for _ in range(max_ticks):
+        if all(r.is_terminal for r in reqs):
+            return
+        region.step()
+        clock.advance(1.0)
+    raise AssertionError(
+        f"requests not terminal after {max_ticks} ticks: "
+        f"{[r.state.name for r in reqs if not r.is_terminal]}")
+
+
+# ----------------------------------------------------------------------
+# digests + routing cost
+# ----------------------------------------------------------------------
+
+def test_digest_published_on_poll_not_on_route():
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=2, replicas=2)
+        cell = region.cells[0]
+        d = cell.digest
+        assert d is not None and d.healthy_replicas == 2
+        assert d.accepting and d.queue_depth == 0
+        # the route path must not trigger a replica scan: digest_fields
+        # is the ONLY scanning entry point, called on the poll cadence
+        calls = []
+        orig = ServingFleet.digest_fields
+
+        def counting(self):
+            calls.append(self.name)
+            return orig(self)
+
+        ServingFleet.digest_fields = counting
+        try:
+            region.submit([1, 2, 3], max_new_tokens=1)
+            assert calls == []          # route: digest READS only
+            region.poll()
+            assert len(calls) == 2      # poll: one scan per cell
+        finally:
+            ServingFleet.digest_fields = orig
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+
+
+def test_route_work_independent_of_replica_count():
+    """The acceptance pin: per-route work (digest lookups + cell-ring
+    steps) must not grow with the replica count — the region tier reads
+    published digests, the cell tier walks a bounded replica set."""
+    prompts = [[i, i + 1, i + 2, 7] for i in range(1, 9)]
+    works = {}
+    for replicas in (1, 4):
+        clock = SimClock()
+        with use_clock(clock):
+            region = _region(clock, cells=3, replicas=replicas)
+            per_route = []
+            reqs = []
+            for p in prompts:
+                reqs.append(region.submit(list(p), max_new_tokens=1))
+                per_route.append(region.route_work_last)
+            works[replicas] = per_route
+            _drive(region, clock, reqs)
+            clock.pump = region.step
+            region.close(timeout=30.0)
+            clock.pump = None
+    # identical prompts, identical cell ring => identical work, replica
+    # count nowhere in the equation
+    assert works[1] == works[4]
+    assert all(w >= 1 for w in works[1])
+
+
+def test_same_prefix_routes_to_same_cell():
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=3, replicas=1)
+        prefix = list(range(1, 9))
+        cells_seen = set()
+        reqs = []
+        for i in range(4):
+            r = region.submit(prefix + [40 + i], max_new_tokens=1)
+            reqs.append(r)
+            cells_seen.add(region._requests[r.uid][1])
+        assert len(cells_seen) == 1     # tier-one affinity
+        _drive(region, clock, reqs)
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+
+
+# ----------------------------------------------------------------------
+# whole-cell outage
+# ----------------------------------------------------------------------
+
+def test_cell_outage_loses_nothing_and_streams_stay_bit_exact():
+    """The acceptance gate: kill a whole cell under load — every
+    admitted request either finishes BIT-exactly elsewhere (the
+    deterministic next-token function is pure in the context, so any
+    divergence in the resumed stream would show) or retires with a
+    REJECTED span. Nothing is lost, nothing leaks."""
+    prompts = [[9, 8, 7, i] for i in range(1, 7)]
+    # reference: an undisturbed region, same prompts
+    clock = SimClock()
+    with use_clock(clock):
+        ref_region = _region(clock, cells=2, replicas=1)
+        ref = [ref_region.submit(list(p), max_new_tokens=6)
+               for p in prompts]
+        _drive(ref_region, clock, ref)
+        clock.pump = ref_region.step
+        ref_region.close(timeout=30.0)
+        clock.pump = None
+    expected = {tuple(p): list(r.tokens) for p, r in zip(prompts, ref)}
+    assert all(r.state is RequestState.FINISHED for r in ref)
+
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=2, replicas=1)
+        reqs = [region.submit(list(p), max_new_tokens=6) for p in prompts]
+        # let some work get admitted mid-flight, then take a cell down
+        region.step()
+        clock.advance(1.0)
+        assert region.kill_cell("cell-0", reason="test outage")
+        assert region.cells[0].state == "dead"
+        _drive(region, clock, reqs)
+        leaks = region.block_leaks()
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+    assert leaks == []
+    for p, r in zip(prompts, reqs):
+        assert r.state is RequestState.FINISHED, (r.state, r.error)
+        assert r.tokens == expected[tuple(p)]   # bit-exact elsewhere
+
+
+def test_dead_cell_detection_via_digest():
+    """A cell whose replicas all died (respawn off) is declared dead by
+    the region monitor and its work re-placed."""
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=2, replicas=1)
+        reqs = [region.submit([5, 6, 7, i], max_new_tokens=4)
+                for i in range(4)]
+        # kill every replica of cell-1 at the FLEET tier (driver death,
+        # not a region-level kill): the region must notice via digests
+        cell = region._cells["cell-1"]
+        for rep in list(cell.fleet.replicas):
+            cell.fleet.kill_replica(rep.name, reason="test")
+        _drive(region, clock, reqs)
+        assert not region._cells["cell-1"].alive
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+
+
+# ----------------------------------------------------------------------
+# partitions
+# ----------------------------------------------------------------------
+
+def test_cell_unreachable_is_typed():
+    inj = install_fault_injector(FaultInjector())
+    inj.sever({"cell-0"}, {"cell-1"})
+    assert not is_reachable("cell-0", "cell-1")
+    assert is_reachable("cell-0", "cell-2")     # unmentioned: unaffected
+    with pytest.raises(CellUnreachable) as ei:
+        check_reachable("cell-0", "cell-1", op="kv_adoption")
+    assert ei.value.src == "cell-0"
+    assert ei.value.dst == "cell-1"
+    assert ei.value.op == "kv_adoption"
+    inj.heal_partitions()
+    check_reachable("cell-0", "cell-1")          # healed: no raise
+
+
+def test_partitioned_cell_keeps_serving_admitted_work():
+    """Partition != death: a severed cell finishes what it owns locally
+    (no fenceless failover, no double ownership); the region just stops
+    routing new work there until the heal."""
+    inj = install_fault_injector(FaultInjector())
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=2, replicas=1)
+        reqs = [region.submit([3, 1, 4, i], max_new_tokens=5)
+                for i in range(1, 5)]
+        owners = {region._requests[r.uid][1] for r in reqs}
+        assert len(owners) >= 1
+        # sever the region front-end from EVERY cell that owns work
+        inj.sever({region.name}, set(owners))
+        region.poll()
+        # new work has nowhere reachable (when all cells are severed)
+        if owners == {c.name for c in region.cells}:
+            shed = region.submit([2, 2, 2], max_new_tokens=1)
+            assert shed.state is RequestState.REJECTED
+        _drive(region, clock, reqs)
+        assert all(r.state is RequestState.FINISHED for r in reqs)
+        inj.heal_partitions()
+        region.poll()
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+
+
+def _disagg_region(clock, cells=2):
+    return _region(
+        clock, cells=cells, replicas=1,
+        fleet_cfg={"disaggregated": True, "prefill_replicas": 1,
+                   "replicas": 1, "respawn": False,
+                   "router": "prefix_affinity"})
+
+
+def test_cross_cell_handoff_adoption():
+    """A cell that lost its decode pool escalates the prefilled hand-off
+    to another cell's decode pool — cross-cell KV adoption."""
+    clock = SimClock()
+    with use_clock(clock):
+        region = _disagg_region(clock)
+        # kill cell-0's decode replica; its prefill replica survives
+        cell0 = region._cells["cell-0"]
+        decode = [r for r in cell0.fleet.replicas if r.role == "decode"]
+        cell0.fleet.kill_replica(decode[0].name, reason="test")
+        before = _counter("serving/region/handoff_escalations")
+        reqs = []
+        for i in range(1, 5):
+            r = region.submit([11, 12, 13, i], max_new_tokens=4)
+            if region._requests.get(r.uid, (None, None))[1] == "cell-0":
+                reqs.append(r)
+        assert reqs, "no request routed to the degraded cell"
+        _drive(region, clock, reqs)
+        assert all(r.state is RequestState.FINISHED for r in reqs)
+        assert _counter("serving/region/handoff_escalations") - before >= 1
+        # the escalation moved ownership across cells: no fleet's table
+        # may retain a row for the retired requests (stale rows leak for
+        # the fleet's lifetime and mis-route cancels)
+        for cell in region.cells:
+            for r in reqs:
+                assert r.uid not in cell.fleet._requests
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+    assert region.block_leaks() == []
+
+
+def test_partition_during_cross_cell_adoption_degrades_typed():
+    """The typed-degrade gate: with the inter-cell link severed, the KV
+    export cannot travel — the pair degrades to the local prefill pool
+    (degraded, never lost), and the block must be COUNTED as a
+    partition effect, not a generic failure."""
+    inj = install_fault_injector(FaultInjector())
+    clock = SimClock()
+    with use_clock(clock):
+        region = _disagg_region(clock)
+        cell0 = region._cells["cell-0"]
+        decode = [r for r in cell0.fleet.replicas if r.role == "decode"]
+        cell0.fleet.kill_replica(decode[0].name, reason="test")
+        inj.sever({"cell-0"}, {"cell-1"})   # inter-cell only
+        region.poll()
+        before = _counter("serving/region/partition_blocked_handoffs")
+        reqs = []
+        for i in range(1, 6):
+            r = region.submit([11, 12, 13, i], max_new_tokens=4)
+            if region._requests.get(r.uid, (None, None))[1] == "cell-0":
+                reqs.append(r)
+        assert reqs, "no request routed to the degraded cell"
+        _drive(region, clock, reqs)
+        assert all(r.state is RequestState.FINISHED for r in reqs)
+        assert _counter("serving/region/partition_blocked_handoffs") \
+            - before >= 1
+        # no stale table rows anywhere once the requests retired
+        for cell in region.cells:
+            for r in reqs:
+                assert r.uid not in cell.fleet._requests
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+    assert region.block_leaks() == []
+
+
+def test_fully_isolated_cell_decodes_handoff_on_prefill_pool():
+    """The degrade endgame terminates: decode pool dead AND every peer
+    unreachable (even from the region) — the prefilled hand-off must be
+    decoded by the LOCAL prefill replica in bounded ticks, not
+    ping-ponged through an endless re-prefill -> hand-off -> degrade
+    cycle (the region's no-adoptable-cell path hands the pair back to
+    the fleet instead of re-routing onto the same prefill pool)."""
+    inj = install_fault_injector(FaultInjector())
+    clock = SimClock()
+    with use_clock(clock):
+        region = _disagg_region(clock)
+        cell0 = region._cells["cell-0"]
+        decode = [r for r in cell0.fleet.replicas if r.role == "decode"]
+        cell0.fleet.kill_replica(decode[0].name, reason="test")
+        # sever BOTH links: cell-0 <-> cell-1 and region <-> cell-1, so
+        # neither adoption nor a cross-cell re-prefill is possible
+        inj.sever({"cell-0", region.name}, {"cell-1"})
+        region.poll()
+        reqs = [region.submit([11, 12, 13, i], max_new_tokens=4)
+                for i in range(1, 5)]
+        assert all(region._requests[r.uid][1] == "cell-0" for r in reqs)
+        before = _counter("serving/region/handoff_degrades")
+        _drive(region, clock, reqs)    # bounded: a livelock trips this
+        assert all(r.state is RequestState.FINISHED for r in reqs)
+        assert _counter("serving/region/handoff_degrades") - before >= 1
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+    assert region.block_leaks() == []
+
+
+def test_heal_rebalance_respreads_queued_work():
+    """After a heal, QUEUED (stateless) backlog from the cells that bore
+    the partition is re-spread onto rejoined capacity."""
+    inj = install_fault_injector(FaultInjector())
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=2, replicas=1,
+                         region_cfg={"rebalance_threshold": 1.0,
+                                     "brownout_queue_per_replica": 1e9})
+        # sever cell-1 so every submit lands on cell-0
+        inj.sever({region.name}, {"cell-1"})
+        region.poll()
+        reqs = [region.submit([6, 6, 6, i], max_new_tokens=2)
+                for i in range(1, 13)]
+        assert all(region._requests[r.uid][1] == "cell-0" for r in reqs
+                   if not r.is_terminal)
+        before = _counter("serving/region/rebalanced")
+        inj.heal_partitions()
+        region.poll()           # heal detected -> rebalance
+        assert _counter("serving/region/rebalanced") - before >= 1
+        on_cell1 = [r for r in reqs
+                    if not r.is_terminal
+                    and region._requests.get(r.uid, (None, None))[1]
+                    == "cell-1"]
+        assert len(on_cell1) >= 1
+        _drive(region, clock, reqs)
+        assert all(r.state is RequestState.FINISHED for r in reqs)
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+
+
+# ----------------------------------------------------------------------
+# brownout
+# ----------------------------------------------------------------------
+
+def test_brownout_ladder_sheds_by_priority_with_spans():
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=2, replicas=1,
+                         region_cfg={"brownout_queue_per_replica": 2.0,
+                                     "rebalance_threshold": 0.0})
+        # flood without stepping: queue pressure builds, poll walks the
+        # ladder up
+        flood = [region.submit([7, 7, 7, i], max_new_tokens=1, priority=2)
+                 for i in range(1, 13)]
+        region.poll()
+        floor = region.brownout_floor
+        assert floor >= 1
+        low = region.submit([1, 2, 3], max_new_tokens=1, priority=0)
+        assert low.state is RequestState.REJECTED
+        assert "brownout" in (low.error or "")
+        high = region.submit([1, 2, 4], max_new_tokens=1,
+                             priority=floor)
+        assert high.state is not RequestState.REJECTED
+        # the log is strictly priority-ordered: sheds below the floor,
+        # admits at/above it
+        for e in region.brownout_log:
+            if e["kind"] == "shed":
+                assert e["priority"] < e["floor"]
+            else:
+                assert e["priority"] >= e["floor"]
+        _drive(region, clock, flood + [high])
+        # pressure gone: the ladder steps back down through hysteresis
+        region.poll()
+        assert region.brownout_floor == 0
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+
+
+# ----------------------------------------------------------------------
+# shared route-retry budget
+# ----------------------------------------------------------------------
+
+def test_brownout_exits_at_zero_exit_ratio_when_drained():
+    """exit_ratio 0.0 passes config validation; a fully drained region
+    (pressure 0.0) must still descend the ladder — `<=` not `<` in the
+    hysteresis compare, or one transient burst sheds low-priority work
+    forever."""
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=2, replicas=1,
+                         region_cfg={"brownout_exit_ratio": 0.0})
+        with region._lock:
+            region._brownout_floor = 2      # as if a burst raised it
+        region.poll()                       # queues empty, pressure 0.0
+        assert region.brownout_floor == 0
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+
+
+def test_route_retry_budget_per_request_across_tiers():
+    """Refused picks draw from ONE budget per request LIFECYCLE, shared
+    by the fleet tier's replica loop and the region tier's cell loop;
+    when it runs dry the request retires with an explicit REJECTED span
+    instead of hammering the refusing replicas forever — and a FRESH
+    request always starts with a full budget (a process-lifetime pool
+    would let past refusals starve future, healthy work)."""
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=2, replicas=1,
+                         fleet_cfg={"route_retry_budget": 3,
+                                    "route_backoff_s": 0.01})
+        # force refusals: stop every replica driver WITHOUT marking the
+        # replicas dead, so routing keeps picking them and they keep
+        # refusing the continuation
+        req = region.submit([1, 2, 3, 4], max_new_tokens=4)
+        region.step()
+        clock.advance(1.0)
+        for cell in region.cells:
+            for rep in cell.fleet.replicas:
+                rep.serving._stop_evt.set()
+        owner = region._requests[req.uid][1]
+        orphan_cell = region._cells[owner]
+        # evacuate the owner and try to re-place: every pick refuses,
+        # BOTH tiers draw down the request's budget, then the explicit
+        # shed
+        orphans = orphan_cell.fleet.replicas[0].serving.evacuate()
+        assert req in orphans
+        orphan_cell.fleet._failover_orphans(orphans, source="test")
+        assert req.state is RequestState.REJECTED
+        assert "budget" in (req.error or "")
+        assert req._route_budget.remaining == 0
+        # no table retains the rejected request at either tier
+        assert req.uid not in region._requests
+        for cell in region.cells:
+            assert req.uid not in cell.fleet._requests
+        # the exhausted budget was the REQUEST's, not the region's: a
+        # new request routes fine on revived replicas with a fresh pool
+        for cell in region.cells:
+            for rep in cell.fleet.replicas:
+                rep.serving._stop_evt.clear()
+        req2 = region.submit([1, 2, 3, 4], max_new_tokens=4)
+        assert req2.state is not RequestState.REJECTED
+        assert getattr(req2, "_route_budget", None) is not req._route_budget
+        _drive(region, clock, [req2])
+        assert req2.state is RequestState.FINISHED
+    install_fault_injector(None)
+
+
+def test_autoscaler_lag_defers_decisions():
+    inj = install_fault_injector(FaultInjector())
+    clock = SimClock()
+    with use_clock(clock):
+        fleet = ServingFleet(
+            lambda: SimEngine(SimConfig()),
+            {"replicas": 1, "autoscale": True,
+             "autoscale_interval_s": 1.0, "respawn": False},
+            {"policy": "slo", "stuck_tick_timeout_s": 0.0},
+            start=False, clock=clock)
+        decisions = []
+        fleet.autoscale_once = lambda: decisions.append(clock.now()) or 1
+        clock.advance(2.0)
+        fleet.poll()
+        assert len(decisions) == 1          # no lag: due after 1s
+        inj.set_autoscaler_lag(10.0)
+        clock.advance(2.0)
+        fleet.poll()
+        assert len(decisions) == 1          # lagged: 1s + 10s not due
+        clock.advance(10.0)
+        fleet.poll()
+        assert len(decisions) == 2          # lag elapsed
+        fleet.close(timeout=1.0)
+
+
+def test_region_config_validation():
+    from deepspeed_tpu.config import ConfigError, RegionConfig
+
+    cfg = RegionConfig.from_dict({"cells": 3, "cell_spill_load": 6})
+    assert cfg.cells == 3 and cfg.cell_spill_load == 6
+    with pytest.raises(ConfigError):
+        RegionConfig.from_dict({"cells": 0})
+    with pytest.raises(ConfigError):
+        RegionConfig.from_dict({"brownout_exit_ratio": 1.5})
+    with pytest.raises(ConfigError):
+        RegionConfig.from_dict({"brownout_queue_per_replica": 0.0})
+    with pytest.raises(ConfigError):
+        from deepspeed_tpu.config import FleetConfig
+
+        FleetConfig.from_dict({"route_retry_budget": -1})
+
+
+def test_threaded_region_stream_end_to_end():
+    """Real threads, wall clock: the region's stream() surface over
+    replica driver threads + cell fleets + the region monitor."""
+    region = Region(lambda: SimEngine(SimConfig()),
+                    {"cells": 2, "cell_ring_vnodes": 8},
+                    {"replicas": 1, "respawn": False,
+                     "router": "prefix_affinity"},
+                    {"policy": "slo", "stuck_tick_timeout_s": 0.0,
+                     "poll_interval_s": 0.002},
+                    start=True)
+    try:
+        toks = list(region.stream([4, 5, 6, 7], max_new_tokens=5))
+        assert len(toks) == 5
+        req = region.submit([4, 5, 6, 8], max_new_tokens=8)
+        assert req.result(timeout=10.0) == req.tokens
+    finally:
+        region.close(timeout=10.0)
+    assert region.block_leaks() == []
+
+
+# ----------------------------------------------------------------------
+# flight-recorder triggers (one regression test per region-level event)
+# ----------------------------------------------------------------------
+
+def _dump_reasons(tracer):
+    return [r.get("reason") for r in [tracer.flight.last_dump or {}]]
+
+
+def test_flight_dump_on_cell_outage():
+    tracer = Tracer(enabled=True)
+    clock = SimClock()
+    with use_clock(clock), use_tracer(tracer):
+        region = _region(clock, cells=2, replicas=1)
+        region.submit([1, 2, 3], max_new_tokens=1)
+        region.kill_cell("cell-0", reason="test")
+        dump = tracer.flight.last_dump
+        assert dump is not None and dump["reason"] == "cell-outage"
+        kinds = [r.get("kind") for r in dump["records"]]
+        assert "cell_outage" in kinds
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+
+
+def test_flight_dump_on_partition_detected():
+    inj = install_fault_injector(FaultInjector())
+    tracer = Tracer(enabled=True)
+    clock = SimClock()
+    with use_clock(clock), use_tracer(tracer):
+        region = _region(clock, cells=2, replicas=1)
+        inj.sever({region.name}, {"cell-1"})
+        region.poll()
+        dump = tracer.flight.last_dump
+        assert dump is not None and dump["reason"] == "partition-detected"
+        kinds = [r.get("kind") for r in dump["records"]]
+        assert "partition_detected" in kinds
+        inj.heal_partitions()
+        region.poll()
+        # heal is a note (the fallout is over), visible in later rings
+        assert any(r.get("kind") == "partition_healed"
+                   for r in tracer.flight.snapshot())
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+
+
+def test_flight_dump_on_brownout_enter_and_exit():
+    tracer = Tracer(enabled=True)
+    clock = SimClock()
+    with use_clock(clock), use_tracer(tracer):
+        region = _region(clock, cells=2, replicas=1,
+                         region_cfg={"brownout_queue_per_replica": 2.0})
+        flood = [region.submit([7, 7, 7, i], max_new_tokens=1)
+                 for i in range(1, 13)]
+        region.poll()
+        dump = tracer.flight.last_dump
+        assert dump is not None and dump["reason"] == "brownout-entered"
+        assert any(r.get("kind") == "brownout_entered"
+                   for r in dump["records"])
+        _drive(region, clock, flood)
+        region.poll()
+        dump = tracer.flight.last_dump
+        assert dump is not None and dump["reason"] == "brownout-exited"
+        assert any(r.get("kind") == "brownout_exited"
+                   for r in dump["records"])
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None

@@ -58,6 +58,11 @@ def test_rule_catalog():
     ("trace-hygiene", "trace_hygiene_bad.py", "trace_hygiene_ok.py"),
     ("recompile-hazard", "recompile_bad.py", "recompile_ok.py"),
     ("lock-discipline", "locks_bad.py", "locks_ok.py"),
+    # region/cell tier of the documented lock order (region -> cell ->
+    # fleet -> replica): a cell-acquires-region and a fleet-acquires-
+    # cell inversion, with the descending near-misses in the ok twin
+    ("lock-discipline", os.path.join("serving", "locks_bad.py"),
+     os.path.join("serving", "locks_ok.py")),
     ("exception-discipline", "exceptions_bad.py", "exceptions_ok.py"),
     # wall-clock fixtures sit under a serving/ subdir: the rule is
     # scoped to the clocked layers by module path
