@@ -1098,7 +1098,19 @@ class ServingConfig:
     more aggressively and relies on mid-tick preemption to recover.
     ``tick_retry_limit`` is the per-request budget for re-queue-on-tick-
     fault before the request is failed.  ``stuck_tick_timeout_s`` arms
-    the watchdog (0 disables it)."""
+    the watchdog (0 disables it).
+
+    ``speculative`` turns on prompt-lookup speculative decoding inside
+    the serving tick (docs/serving.md "Speculative scheduling"): draft
+    chains verify in the one static SplitFuse shape, greedy output stays
+    token-identical, and drafting consumes only token-budget SLACK (an
+    acceptance-rate-aware credit per priority class — EMA smoothing
+    ``spec_ema`` — sizes chains, so drafting never starves prefill).
+    Per request, drafting falls back to plain decode when its rolling
+    acceptance EMA drops below ``spec_accept_floor`` after at least
+    ``spec_floor_min_proposed`` proposed tokens. ``kv_quant`` declares
+    the engines' KV-cache quantization mode; the serving layer validates
+    it against each engine's own config (one knob, fleet-wide)."""
 
     max_queue: int = 256
     policy: str = "slo"
@@ -1111,6 +1123,13 @@ class ServingConfig:
     drain_timeout_s: float = 120.0
     stuck_tick_timeout_s: float = 30.0
     tick_retry_limit: int = 1
+    speculative: bool = False
+    spec_lookahead: int = 4
+    spec_ngram: int = 3
+    spec_accept_floor: float = 0.25
+    spec_floor_min_proposed: int = 16
+    spec_ema: float = 0.25
+    kv_quant: str = "none"
     fleet: FleetConfig = field(default_factory=FleetConfig)
     region: RegionConfig = field(default_factory=RegionConfig)
 
@@ -1133,6 +1152,14 @@ class ServingConfig:
             drain_timeout_s=float(_take(d, "drain_timeout_s", 120.0)),
             stuck_tick_timeout_s=float(_take(d, "stuck_tick_timeout_s", 30.0)),
             tick_retry_limit=int(_take(d, "tick_retry_limit", 1)),
+            speculative=bool(_take(d, "speculative", False)),
+            spec_lookahead=int(_take(d, "spec_lookahead", 4)),
+            spec_ngram=int(_take(d, "spec_ngram", 3)),
+            spec_accept_floor=float(_take(d, "spec_accept_floor", 0.25)),
+            spec_floor_min_proposed=int(
+                _take(d, "spec_floor_min_proposed", 16)),
+            spec_ema=float(_take(d, "spec_ema", 0.25)),
+            kv_quant=str(_take(d, "kv_quant", "none")),
         )
         if out.policy not in ("slo", "fcfs"):
             raise ConfigError(
@@ -1151,6 +1178,21 @@ class ServingConfig:
             raise ConfigError(
                 f"serving.default_max_new_tokens must be >= 1, got "
                 f"{out.default_max_new_tokens}")
+        if out.spec_lookahead < 1 or out.spec_ngram < 1:
+            raise ConfigError(
+                f"serving.spec_lookahead/spec_ngram must be >= 1, got "
+                f"{out.spec_lookahead}/{out.spec_ngram}")
+        if not 0.0 <= out.spec_accept_floor <= 1.0:
+            raise ConfigError(
+                f"serving.spec_accept_floor must be in [0, 1], got "
+                f"{out.spec_accept_floor}")
+        if not 0.0 < out.spec_ema <= 1.0:
+            raise ConfigError(
+                f"serving.spec_ema must be in (0, 1], got {out.spec_ema}")
+        if out.kv_quant not in ("none", "int8", "int4"):
+            raise ConfigError(
+                f"serving.kv_quant must be 'none', 'int8' or 'int4', got "
+                f"'{out.kv_quant}'")
         _warn_unknown(d, "serving")
         return out
 

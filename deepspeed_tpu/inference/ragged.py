@@ -273,6 +273,35 @@ def assert_block_balance(engine, expect_free: Optional[int] = None) -> None:
             f"({rep['held']} pages still referenced)")
 
 
+def kv_page_bytes(model_config, ragged_config) -> int:
+    """Bytes ONE KV page (K + V, all layers) occupies in the pool under
+    ``ragged_config.kv_quant`` — payload plus per-row fp32 scales. The
+    capacity arithmetic behind "quantization roughly doubles concurrent
+    sequences per pool": size two pools to the same byte budget with
+    :func:`kv_blocks_for_bytes` and the int8 pool holds ~2x the pages."""
+    import jax.numpy as _jnp
+
+    c, cfg = model_config, ragged_config
+    rows = c.n_layers * c.n_kv_heads * cfg.kv_block_size      # per K or V
+    bits = {"none": 0, "int8": 8, "int4": 4}[cfg.kv_quant]
+    if bits == 0:
+        return 2 * rows * c.head_dim * _jnp.dtype(cfg.dtype).itemsize
+    # payload + per-head-vector scale bytes: the ONE audited byte
+    # arithmetic (ops/quantizer.quantized_nbytes, block = head_dim)
+    from ..ops.quantizer import quantized_nbytes
+
+    return 2 * quantized_nbytes(rows * c.head_dim, bits, c.head_dim)
+
+
+def kv_blocks_for_bytes(budget_bytes: int, model_config,
+                        ragged_config) -> int:
+    """Pages a ``budget_bytes`` KV pool holds under the config's
+    ``kv_quant`` mode (the fixed-byte-budget sizing the serve bench's
+    kv-quant leg and capacity tests use)."""
+    return max(1, int(budget_bytes)
+               // kv_page_bytes(model_config, ragged_config))
+
+
 def _prompt_lookup(ctx: Sequence[int], ngram: int, k: int) -> List[int]:
     """Prompt-lookup drafting: if the trailing ``ngram`` of ``ctx`` occurred
     earlier, propose the (up to ``k``) tokens that followed its most recent
@@ -295,6 +324,88 @@ def _prompt_lookup(ctx: Sequence[int], ngram: int, k: int) -> List[int]:
     return arr[j + ngram: j + ngram + k].tolist()
 
 
+class NgramIndex:
+    """Incremental n-gram position index over one sequence's token stream
+    — the memoized form of :func:`_prompt_lookup`, bit-identical in what
+    it proposes but O(new tokens) per draft round instead of O(context):
+    every fully-formed window's start position is recorded once (dict
+    key -> ascending position list) as the stream grows, and a trim of
+    the stream's tail pops exactly the invalidated entries off an
+    append-ordered stack. ``lookup`` then answers "most recent earlier
+    occurrence of the trailing n-gram with a k-token continuation, else
+    the earliest occurrence" with two bisects plus an O(ngram + extra)
+    scan of the windows that overlap the virtual ``extra`` suffix."""
+
+    def __init__(self, ngram: int):
+        self.ngram = int(ngram)
+        self._toks: List[int] = []
+        self._pos: Dict[Tuple[int, ...], List[int]] = {}
+        self._order: List[Tuple[int, Tuple[int, ...]]] = []  # (start, key)
+
+    def sync(self, tokens: Sequence[int]) -> None:
+        """Index tokens appended since the last call. The caller
+        guarantees the previously-indexed prefix is unchanged — the
+        engine's only tail mutation (``trim``) calls :meth:`truncate`."""
+        n = self.ngram
+        if len(tokens) < len(self._toks):        # untracked truncation
+            self.truncate(len(tokens))
+        self._toks.extend(int(t) for t in tokens[len(self._toks):])
+        start = self._order[-1][0] + 1 if self._order else 0
+        for h in range(start, len(self._toks) - n + 1):
+            key = tuple(self._toks[h:h + n])
+            self._pos.setdefault(key, []).append(h)
+            self._order.append((h, key))
+
+    def truncate(self, length: int) -> None:
+        """Drop the stream's tail: O(removed) — pops only entries whose
+        window extends past ``length``."""
+        del self._toks[length:]
+        n = self.ngram
+        while self._order and self._order[-1][0] + n > length:
+            h, key = self._order.pop()
+            lst = self._pos[key]
+            lst.pop()                            # ascending: h is last
+            if not lst:
+                del self._pos[key]
+
+    def lookup(self, extra: Sequence[int], k: int) -> List[int]:
+        """Draft proposal for the stream + virtual ``extra`` suffix —
+        exactly :func:`_prompt_lookup`'s answer for
+        ``ctx = tokens + extra`` without rescanning ``tokens``."""
+        import bisect
+
+        n = self.ngram
+        toks = self._toks
+        ctx_len = len(toks) + len(extra)
+        if k <= 0 or n <= 0 or ctx_len <= n:
+            return []
+
+        def at(i: int) -> int:
+            return toks[i] if i < len(toks) else int(extra[i - len(toks)])
+
+        pat = tuple(at(ctx_len - n + j) for j in range(n))
+        limit = ctx_len - 1 - n          # last admissible window start
+        base = self._pos.get(pat, [])
+        hi = bisect.bisect_right(base, min(limit, len(toks) - n))
+        # windows overlapping ``extra`` (or the trailing pattern region)
+        # are not in the index — check the handful directly
+        manual = [h for h in range(max(0, len(toks) - n + 1), limit + 1)
+                  if all(at(h + j) == pat[j] for j in range(n))]
+        if hi == 0 and not manual:
+            return []
+        # prefer the most recent start with a full k-token continuation;
+        # manual starts are all later than indexed ones
+        full_limit = ctx_len - n - k
+        j = next((h for h in reversed(manual) if h <= full_limit), None)
+        if j is None:
+            idx = bisect.bisect_right(base, full_limit, 0, hi)
+            if idx:
+                j = base[idx - 1]
+        if j is None:                    # no full hit: longest continuation
+            j = base[0] if hi else manual[0]
+        return [at(i) for i in range(j + n, min(j + n + k, ctx_len))]
+
+
 @dataclass
 class KVExport:
     """Host-side snapshot of one sequence's KV state, the unit of the
@@ -314,6 +425,14 @@ class KVExport:
     dtype: str
     k_pages: np.ndarray        # [n_layers, n_pages, hkv, block, hd]
     v_pages: np.ndarray
+    # quantized hand-off (kv_quant != "none"): k/v_pages hold the POOL's
+    # quantized payload (int8, or int4 nibble-packed uint8 [.., hd//2])
+    # and the per-row fp32 scales ride along — the wire moves ~half
+    # (int8) / ~quarter (int4) the fp bytes, and the importer adopts the
+    # payload bit-identically (no re-quantization, no extra error)
+    kv_quant: str = "none"
+    k_scales: Optional[np.ndarray] = None   # [n_layers, n_pages, hkv, block]
+    v_scales: Optional[np.ndarray] = None
 
     @property
     def n_pages(self) -> int:
@@ -321,7 +440,10 @@ class KVExport:
 
     @property
     def nbytes(self) -> int:
-        return int(self.k_pages.nbytes + self.v_pages.nbytes)
+        n = int(self.k_pages.nbytes + self.v_pages.nbytes)
+        if self.k_scales is not None:
+            n += int(self.k_scales.nbytes + self.v_scales.nbytes)
+        return n
 
 
 @dataclass
@@ -368,6 +490,14 @@ class RaggedConfig:
     # sharing a full-block prefix skip its prefill entirely. Shared pages
     # are refcounted; cache entries are evicted under pool pressure.
     enable_prefix_cache: bool = False
+    # quantized KV storage ("none" | "int8" | "int4"): pages hold
+    # blockwise-quantized payloads (one fp32 scale per K/V head-vector,
+    # ops/quantizer.quantize_kv) — quantize on page write, dequantize in
+    # the paged-attention read path. At a fixed pool BYTE budget this
+    # roughly doubles (int8) / quadruples (int4) the page count, i.e.
+    # concurrent sequences; export_kv/import_kv move the quantized
+    # payload + scales on the wire (docs/serving.md "KV quantization")
+    kv_quant: str = "none"
 
 
 class RaggedInferenceEngine:
@@ -412,6 +542,15 @@ class RaggedInferenceEngine:
             raise ValueError(
                 f"max_context {self.config.max_context} must be a multiple of "
                 f"kv_block_size {self.config.kv_block_size}")
+        if self.config.kv_quant not in ("none", "int8", "int4"):
+            raise ValueError(
+                f"kv_quant must be 'none', 'int8' or 'int4', got "
+                f"'{self.config.kv_quant}'")
+        self._kv_bits = {"none": 0, "int8": 8, "int4": 4}[self.config.kv_quant]
+        if self._kv_bits == 4 and c.head_dim % 2:
+            raise ValueError(
+                f"kv_quant='int4' packs two channels per byte and needs an "
+                f"even head_dim, got {c.head_dim}")
         self.params = params if params is not None else model.init(
             rng if rng is not None else jax.random.PRNGKey(0))
         self.params = jax.tree_util.tree_map(
@@ -456,31 +595,65 @@ class RaggedInferenceEngine:
         # OOM on a 4.3 GB pool). Per-layer leaves keep every scatter's
         # worst-case transient to one leaf. (block, hd) stay minor-most so
         # each page is a native VMEM tile for the Pallas kernel
-        leaf_shape = (cfg.n_kv_blocks + 1, c.n_kv_heads,
-                      cfg.kv_block_size, c.head_dim)
+        # kv_quant stores pages as blockwise payload + per-row fp32 scales
+        # (scale block = one K/V head-vector): int8 payload [.., hd] or
+        # int4 nibble-packed uint8 [.., hd//2], scale leaf [P+1, hkv, bs].
+        # The sink page's zeros dequantize to zeros, so masked-lane
+        # scatters stay harmless exactly as in the fp layout.
+        if self._kv_bits == 4:
+            leaf_shape = (cfg.n_kv_blocks + 1, c.n_kv_heads,
+                          cfg.kv_block_size, c.head_dim // 2)
+            leaf_dtype = jnp.uint8
+        elif self._kv_bits == 8:
+            leaf_shape = (cfg.n_kv_blocks + 1, c.n_kv_heads,
+                          cfg.kv_block_size, c.head_dim)
+            leaf_dtype = jnp.int8
+        else:
+            leaf_shape = (cfg.n_kv_blocks + 1, c.n_kv_heads,
+                          cfg.kv_block_size, c.head_dim)
+            leaf_dtype = cfg.dtype
+        scale_shape = (cfg.n_kv_blocks + 1, c.n_kv_heads, cfg.kv_block_size)
         if tp > 1:
             from jax.sharding import NamedSharding
 
             pool_sh = NamedSharding(topology.mesh,
                                     PartitionSpec(None, "model", None, None))
+            scale_sh = NamedSharding(topology.mesh,
+                                     PartitionSpec(None, "model", None))
 
             def _zeros(_):
-                return jax.device_put(jnp.zeros(leaf_shape, cfg.dtype),
+                return jax.device_put(jnp.zeros(leaf_shape, leaf_dtype),
                                       pool_sh)
+
+            def _zero_scales(_):
+                return jax.device_put(jnp.zeros(scale_shape, jnp.float32),
+                                      scale_sh)
         else:
             def _zeros(_):
-                return jnp.zeros(leaf_shape, cfg.dtype)
+                return jnp.zeros(leaf_shape, leaf_dtype)
+
+            def _zero_scales(_):
+                return jnp.zeros(scale_shape, jnp.float32)
         self.kv_pool = (
             tuple(_zeros(i) for i in range(c.n_layers)),
             tuple(_zeros(i) for i in range(c.n_layers)))
+        if self._kv_bits:
+            self.kv_pool = self.kv_pool + (
+                tuple(_zero_scales(i) for i in range(c.n_layers)),
+                tuple(_zero_scales(i) for i in range(c.n_layers)))
         self._step_fn = None
         self._core_fn = None
         self._decode_fn = None
         self._copy_page_fn = None
         self._import_fn = None
         self._verify_fn = None
-        # speculative-decoding acceptance stats (generate_speculative)
+        # speculative-decoding acceptance stats (generate_speculative and
+        # the serving tick's verify rounds; mirrored into the shared
+        # MetricsRegistry by record_spec)
         self.spec_stats = {"proposed": 0, "accepted": 0, "rounds": 0}
+        # per-uid memoized n-gram draft indices (draft_tokens): extended
+        # lazily on append, truncated by trim(), dropped on flush/discard
+        self._ngram_idx: Dict[int, NgramIndex] = {}
         # sampling streams: decode steps fold a GLOBAL step counter into the
         # decode key, so sampled output is invariant to how decode_steps
         # calls chunk the token budget; prefill first-tokens get their own
@@ -560,6 +733,7 @@ class RaggedInferenceEngine:
         now = time.perf_counter()
         for uid in uids:
             seq = self.seqs.pop(uid, None)
+            self._ngram_idx.pop(uid, None)
             if seq is not None:
                 if seq.t_created is not None:
                     # request retires here: end-to-end latency + tokens the
@@ -596,6 +770,7 @@ class RaggedInferenceEngine:
         step whose KV integrity is unknown (``seen`` may have advanced
         without the scatter landing). Zero-leak either way."""
         seq = self.seqs.pop(uid, None)
+        self._ngram_idx.pop(uid, None)
         if seq is None:
             return
         self.allocator.free(seq.blocks)
@@ -609,6 +784,47 @@ class RaggedInferenceEngine:
         would silently skip its TTFT/latency telemetry, and the marker
         set would grow without bound under preempt-then-cancel churn."""
         self._resume_uids.discard(uid)
+
+    # -- speculative drafting -------------------------------------------
+    def draft_tokens(self, uid: int, next_token: Optional[int],
+                     ngram: int, k: int) -> List[int]:
+        """Prompt-lookup draft for ``uid``'s next decode step: up to ``k``
+        proposal tokens continuing ``tokens + [next_token]`` (the not-yet-
+        fed pending token rides as a virtual suffix). Memoized per uid:
+        the n-gram index extends incrementally on append and truncates on
+        ``trim``, so a draft round costs O(new tokens), not O(context)."""
+        seq = self.seqs[uid]
+        idx = self._ngram_idx.get(uid)
+        if idx is None or idx.ngram != int(ngram):
+            idx = NgramIndex(ngram)
+            self._ngram_idx[uid] = idx
+        idx.sync(seq.tokens)
+        return idx.lookup([] if next_token is None else [int(next_token)], k)
+
+    def record_spec(self, proposed: int = 0, accepted: int = 0,
+                    rounds: int = 0) -> None:
+        """Fold one speculative verify outcome into ``spec_stats`` AND the
+        shared MetricsRegistry (inference/spec_* counters + acceptance
+        gauge) — the one place the stats dict and the registry stay in
+        sync. Host-side only; called by generate_speculative and the
+        serving tick's verify dispatch."""
+        s = self.spec_stats
+        s["proposed"] += int(proposed)
+        s["accepted"] += int(accepted)
+        s["rounds"] += int(rounds)
+        t = self._telemetry
+        if not t.enabled:
+            return
+        r = t.registry
+        if rounds:
+            r.counter("inference/spec_rounds").inc(rounds)
+        if proposed:
+            r.counter("inference/spec_proposed").inc(proposed)
+        if accepted:
+            r.counter("inference/spec_accepted").inc(accepted)
+        if s["proposed"]:
+            r.gauge("inference/spec_acceptance").set(
+                s["accepted"] / s["proposed"])
 
     # -- KV export/import (disaggregated prefill/decode hand-off) --------
     def export_kv(self, uid: int) -> "KVExport":
@@ -638,18 +854,38 @@ class RaggedInferenceEngine:
         # ``seen`` in the last page are never-read scratch and ride along
         k = np.stack([np.asarray(leaf[idx]) for leaf in self.kv_pool[0]])
         v = np.stack([np.asarray(leaf[idx]) for leaf in self.kv_pool[1]])
+        ks = vs = None
+        if self._kv_bits:
+            ks = np.stack([np.asarray(leaf[idx]) for leaf in self.kv_pool[2]])
+            vs = np.stack([np.asarray(leaf[idx]) for leaf in self.kv_pool[3]])
+        export = KVExport(uid=uid, tokens=list(seq.tokens), seen=seq.seen,
+                          prompt_len=seq.prompt_len,
+                          kv_block_size=self.config.kv_block_size,
+                          n_layers=c.n_layers, n_kv_heads=c.n_kv_heads,
+                          head_dim=c.head_dim,
+                          dtype=str(jnp.dtype(self.config.dtype)),
+                          k_pages=k, v_pages=v,
+                          kv_quant=self.config.kv_quant,
+                          k_scales=ks, v_scales=vs)
         t = self._telemetry
         if t.enabled:
             t.registry.counter("inference/kv_exports").inc()
             t.registry.counter("inference/kv_export_pages").inc(
                 len(seq.blocks))
-        return KVExport(uid=uid, tokens=list(seq.tokens), seen=seq.seen,
-                        prompt_len=seq.prompt_len,
-                        kv_block_size=self.config.kv_block_size,
-                        n_layers=c.n_layers, n_kv_heads=c.n_kv_heads,
-                        head_dim=c.head_dim,
-                        dtype=str(jnp.dtype(self.config.dtype)),
-                        k_pages=k, v_pages=v)
+            t.registry.counter("inference/kv_export_bytes").inc(
+                export.nbytes)
+        # bytes-on-wire ledger (comm/comm.py): the hand-off is a wire
+        # transfer like any collective — logical = what an fp export of
+        # the same pages would move, wire = the (quantized) payload +
+        # scales actually shipped, so the disaggregated hand-off's
+        # compression ratio is auditable next to the collective ops'
+        from ..comm.comm import record_collective
+
+        logical = (2 * len(seq.blocks) * c.n_layers * c.n_kv_heads
+                   * self.config.kv_block_size * c.head_dim
+                   * jnp.dtype(self.config.dtype).itemsize)
+        record_collective("kv_handoff", logical, export.nbytes)
+        return export
 
     def import_kv(self, uid: int, export: "KVExport") -> None:
         """Adopt an exported sequence: allocate pages from THIS engine's
@@ -668,13 +904,17 @@ class RaggedInferenceEngine:
         if uid in self.seqs:
             raise ValueError(f"uid {uid} already live in this engine")
         want = (cfg.kv_block_size, c.n_layers, c.n_kv_heads, c.head_dim,
-                str(jnp.dtype(cfg.dtype)))
+                str(jnp.dtype(cfg.dtype)), cfg.kv_quant)
         have = (export.kv_block_size, export.n_layers, export.n_kv_heads,
-                export.head_dim, export.dtype)
+                export.head_dim, export.dtype, export.kv_quant)
         if want != have:
             raise ValueError(
-                f"KV geometry mismatch: engine (block,layers,hkv,hd,dtype)="
-                f"{want} vs export {have}")
+                f"KV geometry mismatch: engine (block,layers,hkv,hd,dtype,"
+                f"kv_quant)={want} vs export {have}")
+        if self._kv_bits and (export.k_scales is None
+                              or export.v_scales is None):
+            raise ValueError(
+                f"export tagged kv_quant={export.kv_quant} carries no scales")
         if export.seen != len(export.tokens):
             raise ValueError(
                 f"export seen {export.seen} != tokens {len(export.tokens)}")
@@ -702,13 +942,24 @@ class RaggedInferenceEngine:
             dst = np.full((B,), cfg.n_kv_blocks, np.int32)
             dst[:need] = blocks
             k, v = export.k_pages, export.v_pages
+            ks, vs = export.k_scales, export.v_scales
             if B > need:
                 pad = np.zeros((k.shape[0], B - need) + k.shape[2:], k.dtype)
                 k = np.concatenate([k, pad], axis=1)
                 v = np.concatenate([v, pad], axis=1)
-            self.kv_pool = self._write_pages(
-                self.kv_pool, jnp.asarray(dst), jnp.asarray(k),
-                jnp.asarray(v))
+                if self._kv_bits:
+                    spad = np.zeros((ks.shape[0], B - need) + ks.shape[2:],
+                                    ks.dtype)
+                    ks = np.concatenate([ks, spad], axis=1)
+                    vs = np.concatenate([vs, spad], axis=1)
+            if self._kv_bits:
+                self.kv_pool = self._write_pages(
+                    self.kv_pool, jnp.asarray(dst), jnp.asarray(k),
+                    jnp.asarray(v), jnp.asarray(ks), jnp.asarray(vs))
+            else:
+                self.kv_pool = self._write_pages(
+                    self.kv_pool, jnp.asarray(dst), jnp.asarray(k),
+                    jnp.asarray(v))
         except BaseException:
             self.allocator.release(blocks)
             raise
@@ -724,19 +975,39 @@ class RaggedInferenceEngine:
         if t.enabled:
             t.registry.counter("inference/kv_imports").inc()
 
-    def _write_pages(self, pools, dst, k, v):
+    def _write_pages(self, pools, dst, k, v, ks=None, vs=None):
         """Scatter imported pages into every layer's K/V leaf (one jitted
-        donated program; the import-side half of the hand-off seam)."""
+        donated program; the import-side half of the hand-off seam). With
+        kv_quant on, the quantized payload AND its scale pages scatter in
+        the same program — the import is bit-identical pool state, never
+        a requantization."""
         if self._import_fn is None:
-            @functools.partial(jax.jit, donate_argnums=(0,))
-            def imp(pools, dst, k, v):
-                kp = tuple(leaf.at[dst].set(k[i].astype(leaf.dtype))
-                           for i, leaf in enumerate(pools[0]))
-                vp = tuple(leaf.at[dst].set(v[i].astype(leaf.dtype))
-                           for i, leaf in enumerate(pools[1]))
-                return (kp, vp)
+            if self._kv_bits:
+                @functools.partial(jax.jit, donate_argnums=(0,))
+                def imp_q(pools, dst, k, v, ks, vs):
+                    kp = tuple(leaf.at[dst].set(k[i].astype(leaf.dtype))
+                               for i, leaf in enumerate(pools[0]))
+                    vp = tuple(leaf.at[dst].set(v[i].astype(leaf.dtype))
+                               for i, leaf in enumerate(pools[1]))
+                    ksp = tuple(leaf.at[dst].set(ks[i])
+                                for i, leaf in enumerate(pools[2]))
+                    vsp = tuple(leaf.at[dst].set(vs[i])
+                                for i, leaf in enumerate(pools[3]))
+                    return (kp, vp, ksp, vsp)
 
-            self._import_fn = imp
+                self._import_fn = imp_q
+            else:
+                @functools.partial(jax.jit, donate_argnums=(0,))
+                def imp(pools, dst, k, v):
+                    kp = tuple(leaf.at[dst].set(k[i].astype(leaf.dtype))
+                               for i, leaf in enumerate(pools[0]))
+                    vp = tuple(leaf.at[dst].set(v[i].astype(leaf.dtype))
+                               for i, leaf in enumerate(pools[1]))
+                    return (kp, vp)
+
+                self._import_fn = imp
+        if self._kv_bits:
+            return self._import_fn(pools, dst, k, v, ks, vs)
         return self._import_fn(pools, dst, k, v)
 
     def trim(self, uid: int, length: int) -> None:
@@ -772,6 +1043,9 @@ class RaggedInferenceEngine:
                 # untouched so far
         seq.tokens = seq.tokens[:length]
         seq.seen = length
+        ngi = self._ngram_idx.get(uid)
+        if ngi is not None:
+            ngi.truncate(length)
         if keep < len(seq.blocks):
             self.allocator.free(seq.blocks[keep:])
             del seq.blocks[keep:]
@@ -794,14 +1068,12 @@ class RaggedInferenceEngine:
         return self._copy_page_fn(pools, jnp.int32(src), jnp.int32(dst))
 
     # -- step ------------------------------------------------------------
-    def put(self, uids: Sequence[int], tokens: Sequence[Sequence[int]]) -> np.ndarray:
-        """Admit new tokens for ``uids`` and run one ragged step.
-
-        Returns [len(uids), vocab] fp32 logits of each sequence's latest
-        processed token; rows are NaN while a long prompt is still
-        mid-prefill (call put(uid, []) again to continue it).
-        """
-        cfg = self.config
+    def _admit_tokens(self, uids: Sequence[int],
+                      tokens: Sequence[Sequence[int]]) -> None:
+        """Admit new tokens into sequence descriptors — put()'s first
+        phase, shared with :meth:`put_spec`: fresh uids get a slot (and
+        adopt the longest cached full-block prefix), existing ones append
+        their chunk."""
         for uid, toks in zip(uids, tokens):
             new = uid not in self.seqs
             if new:
@@ -827,10 +1099,12 @@ class RaggedInferenceEngine:
                     seq.blocks = list(blocks)
                     seq.seen = shared
 
-        # ---- Dynamic SplitFuse packing: decodes (and short prompt tails)
-        # first, then the longest-pending prefill fills the leftover budget
+    def _pack_splitfuse(self) -> List[Tuple[SequenceDescriptor, int]]:
+        """Dynamic SplitFuse packing: decodes (and short prompt tails)
+        first, then the longest-pending prefill fills the leftover
+        budget."""
         sched: List[Tuple[SequenceDescriptor, int]] = []
-        budget = cfg.token_budget
+        budget = self.config.token_budget
         pending = sorted((s for s in self.seqs.values() if s.pending > 0),
                          key=lambda s: s.pending)
         for seq in pending:
@@ -839,6 +1113,18 @@ class RaggedInferenceEngine:
                 break
             sched.append((seq, take))
             budget -= take
+        return sched
+
+    def put(self, uids: Sequence[int], tokens: Sequence[Sequence[int]]) -> np.ndarray:
+        """Admit new tokens for ``uids`` and run one ragged step.
+
+        Returns [len(uids), vocab] fp32 logits of each sequence's latest
+        processed token; rows are NaN while a long prompt is still
+        mid-prefill (call put(uid, []) again to continue it).
+        """
+        cfg = self.config
+        self._admit_tokens(uids, tokens)
+        sched = self._pack_splitfuse()
         if not sched:
             raise ValueError("put() called with no pending tokens")
 
@@ -885,6 +1171,120 @@ class RaggedInferenceEngine:
                     seq.t_admitted = None
         self._record_step_telemetry(sched)
         return out
+
+    def put_spec(self, uids: Sequence[int], tokens: Sequence[Sequence[int]],
+                 drafts: Sequence[Sequence[int]]
+                 ) -> Tuple[np.ndarray, Dict[int, Tuple[List[int], np.ndarray]]]:
+        """One ragged step that ALSO verifies speculative draft chains —
+        the serving tick's spec-decode entry point: prefill chunks,
+        plain decodes and draft-extended decodes all pack into the ONE
+        static verify shape (a superset of put()'s program returning
+        per-chain-row logits).
+
+        ``drafts[i]`` proposes continuation tokens AFTER ``tokens[i]``
+        (which must then be exactly one pending decode token). Returns
+        ``(out, verified)``: ``out`` is put()'s [len(uids), vocab]
+        last-row logits (NaN mid-prefill rows unchanged); ``verified``
+        maps each drafted uid to ``(chain, rows)`` — the chain actually
+        scheduled (first element = the fed next token) and fp32 logits
+        [len(chain), vocab] for every chain position. The caller accepts
+        the longest greedy-matching prefix and MUST ``trim`` the
+        rejected tail before the uid's next step.
+
+        Chains are all-or-strip under the token budget: a chain the
+        budget cannot hold whole is SHORTENED (unscheduled proposals are
+        stripped from the stream), never split into fake pending
+        context. On PoolExhausted every remaining draft token is
+        stripped before the raise, so the recovery retry (plain ``put``
+        with empty chunks) sees exactly put()'s admitted state."""
+        cfg = self.config
+        self._admit_tokens(uids, tokens)
+        # validate EVERY chain before appending ANY draft token: a raise
+        # mid-append would leave earlier uids' unverified drafts in their
+        # streams, and the next plain put() would schedule them as real
+        # context
+        for uid, d in zip(uids, drafts):
+            if d and self.seqs[uid].pending != 1:
+                raise ValueError(
+                    f"uid {uid}: a draft chain continues exactly one "
+                    f"pending decode token, found "
+                    f"pending={self.seqs[uid].pending}")
+        appended: Dict[int, int] = {}     # uid -> draft tokens on the stream
+        for uid, d in zip(uids, drafts):
+            if not d:
+                continue
+            self.seqs[uid].tokens.extend(int(t) for t in d)
+            appended[uid] = len(d)
+        try:
+            sched = self._pack_splitfuse()
+            if not sched:
+                raise ValueError("put_spec() called with no pending tokens")
+            # all-or-strip: drop draft proposals the budget left behind
+            take_of = {seq.uid: take for seq, take in sched}
+            for uid in list(appended):
+                seq = self.seqs[uid]
+                chain_len = 1 + appended[uid]
+                take = take_of.get(uid, 0)
+                if take < chain_len:
+                    strip = chain_len - max(take, 1)
+                    if strip:
+                        del seq.tokens[len(seq.tokens) - strip:]
+                        appended[uid] -= strip
+                    if appended[uid] <= 0:
+                        appended.pop(uid)
+            sched = [(seq, min(take, seq.pending))
+                     for seq, take in sched if seq.pending > 0]
+            needs = self._validate_sched(sched)
+        except BaseException:
+            for uid, n in appended.items():
+                seq = self.seqs[uid]
+                del seq.tokens[len(seq.tokens) - n:]
+            raise
+        flat_tokens, flat_slot, flat_pos, last_idx = \
+            self._allocate_and_build(sched, needs)
+        k_max = 1
+        for seq, take in sched:
+            if seq.uid in appended:
+                while k_max < take:
+                    k_max *= 2
+        sel_rows = np.zeros((cfg.max_seqs, k_max), np.int32)
+        last_index: Dict[int, int] = {}
+        for (seq, take), li in zip(sched, last_idx):
+            li = int(li)
+            sel_rows[seq.slot, :] = li        # padding rows: never read
+            if seq.uid in appended:
+                sel_rows[seq.slot, :take] = np.arange(li - take + 1, li + 1)
+            seq.seen += take
+            last_index[seq.uid] = li
+        if self._verify_fn is None:
+            self._verify_fn = self._build_verify()
+        logits, self.kv_pool = self._verify_fn(
+            self.params, self.kv_pool, jnp.asarray(flat_tokens),
+            jnp.asarray(flat_slot), jnp.asarray(flat_pos),
+            jnp.asarray(self._host_tables()), jnp.asarray(sel_rows),
+            self._live_pages_bucket())
+        logits = np.asarray(logits)           # [max_seqs, k_max, vocab]
+
+        out = np.full((len(uids), logits.shape[-1]), np.nan, np.float32)
+        now = time.perf_counter()
+        for i, uid in enumerate(uids):
+            seq = self.seqs[uid]
+            if seq.pending == 0 and uid in last_index:
+                # sel_rows[slot, -1] is the last scheduled row whether or
+                # not the slot carried a chain — put()'s contract holds
+                out[i] = logits[seq.slot, -1]
+                if seq.t_admitted is not None:
+                    self._telemetry.record_request(
+                        ttft_s=now - seq.t_admitted)
+                    seq.t_admitted = None
+        verified: Dict[int, Tuple[List[int], np.ndarray]] = {}
+        for seq, take in sched:
+            if seq.uid in appended:
+                chain = [int(t) for t in seq.tokens[seq.seen - take:
+                                                    seq.seen]]
+                verified[seq.uid] = (chain, logits[seq.slot, :take])
+        self._record_step_telemetry(sched)
+        return out, verified
 
     def kv_occupancy(self) -> float:
         """Fraction of the paged KV pool currently held by live sequences
@@ -1273,13 +1673,15 @@ class RaggedInferenceEngine:
                     continue
                 k = max(0, min(lookahead, room - 1, share - 1,
                                max_new_tokens - len(done[u]) - 1))
-                guesses = _prompt_lookup(seq.tokens + [t0], ngram, k)
+                # memoized n-gram draft (NgramIndex): O(new tokens) per
+                # round instead of rescanning the whole context
+                guesses = self.draft_tokens(u, t0, ngram, k)
                 v_uids.append(u)
                 v_chains.append([t0] + guesses)
             if not v_uids:
                 break
             rows = self._put_verify(v_uids, v_chains)
-            self.spec_stats["rounds"] += 1
+            round_proposed = round_accepted = 0
             nxt: Dict[int, int] = {}
             for u, chain, lr in zip(v_uids, v_chains, rows):
                 a = np.argmax(lr, axis=-1)            # [len(chain)]
@@ -1287,8 +1689,8 @@ class RaggedInferenceEngine:
                 while (matched < len(chain) - 1
                        and int(a[matched]) == chain[matched + 1]):
                     matched += 1
-                self.spec_stats["proposed"] += len(chain) - 1
-                self.spec_stats["accepted"] += matched
+                round_proposed += len(chain) - 1
+                round_accepted += matched
                 emitted = [int(x) for x in a[:matched + 1]]
                 seq = self.seqs[u]
                 seen0 = seq.seen - len(chain)
@@ -1307,6 +1709,8 @@ class RaggedInferenceEngine:
                 if (stop_at is None and len(done[u]) < max_new_tokens
                         and seq.seen < self.config.max_context):
                     nxt[u] = emitted[-1]
+            self.record_spec(proposed=round_proposed,
+                             accepted=round_accepted, rounds=1)
             live = nxt
         for u in done:
             done[u] = done[u][:max_new_tokens]
@@ -1358,14 +1762,35 @@ class RaggedInferenceEngine:
             c.head_dim, bs, self.config.dtype,
             scalar_ints=cfg.max_seqs * self.max_pages + 2 * cfg.token_budget)
 
+        kv_bits = self._kv_bits
+
         def _paged_attn_sharded(q, kp, vp, tables, positions, slots,
-                                live_pages, window):
+                                live_pages, window, ks=None, vs=None):
             """shard_map the paged kernel over the bound mesh: heads and
-            pool sharded on 'model', scalars replicated."""
+            pool (payload AND scale leaves) sharded on 'model', scalars
+            replicated."""
             from jax.sharding import PartitionSpec as P_
 
             hspec = P_(None, "model", None)
             pspec = P_(None, "model", None, None)
+            sspec = P_(None, "model", None)
+
+            from ..parallel.mesh import shard_map_compat
+
+            if ks is not None:
+                def local_q(q, kp, vp, tb, pos, sl, ks, vs):
+                    return paged_attention(q, kp, vp, tb, pos, seq_slots=sl,
+                                           live_pages=live_pages,
+                                           window=window, k_scale=ks,
+                                           v_scale=vs, kv_bits=kv_bits,
+                                           interpret=interp)
+
+                mapped = shard_map_compat(
+                    local_q, mesh=self.topo.mesh, axis_names={"model"},
+                    in_specs=(hspec, pspec, pspec, P_(None, None), P_(None),
+                              P_(None), sspec, sspec),
+                    out_specs=hspec, check_vma=False)
+                return mapped(q, kp, vp, tables, positions, slots, ks, vs)
 
             def local(q, kp, vp, tb, pos, sl):
                 return paged_attention(q, kp, vp, tb, pos, seq_slots=sl,
@@ -1374,8 +1799,6 @@ class RaggedInferenceEngine:
 
             in_specs = (hspec, pspec, pspec, P_(None, None), P_(None),
                         P_(None))
-            from ..parallel.mesh import shard_map_compat
-
             mapped = shard_map_compat(
                 local, mesh=self.topo.mesh, axis_names={"model"},
                 in_specs=in_specs, out_specs=hspec, check_vma=False)
@@ -1401,6 +1824,8 @@ class RaggedInferenceEngine:
             tables = None if use_pallas else block_tables[safe_slot]
 
             k_list, v_list = list(pools[0]), list(pools[1])
+            ks_list = list(pools[2]) if kv_bits else None
+            vs_list = list(pools[3]) if kv_bits else None
 
             def block(x, li, lp):
                 kp, vp = k_list[li], v_list[li]
@@ -1429,10 +1854,28 @@ class RaggedInferenceEngine:
                 # into the scratch sink page, never a live one
                 page = jnp.where(active & (positions < cfg.max_context),
                                  page, cfg.n_kv_blocks)
-                # pool layout [pages, hkv, block, hd]; kk [T, hkv, hd]
-                kp = kp.at[page, :, row].set(kk.astype(kp.dtype))
-                vp = vp.at[page, :, row].set(vv.astype(vp.dtype))
-                k_list[li], v_list[li] = kp, vp
+                # pool layout [pages, hkv, block, hd]; kk [T, hkv, hd].
+                # kv_quant: quantize each head-vector on the way in (one
+                # fp32 scale per row, ops/quantizer.quantize_kv) and
+                # scatter payload + scale; reads below dequantize inside
+                # the paged-attention path, so fp K/V never round-trips
+                # through HBM at full width
+                if kv_bits:
+                    from ..ops.quantizer import quantize_kv
+
+                    qk, sk = quantize_kv(kk, kv_bits)
+                    qv, sv = quantize_kv(vv, kv_bits)
+                    kp = kp.at[page, :, row].set(qk)
+                    vp = vp.at[page, :, row].set(qv)
+                    ksl = ks_list[li].at[page, :, row].set(sk)
+                    vsl = vs_list[li].at[page, :, row].set(sv)
+                    k_list[li], v_list[li] = kp, vp
+                    ks_list[li], vs_list[li] = ksl, vsl
+                else:
+                    ksl = vsl = None
+                    kp = kp.at[page, :, row].set(kk.astype(kp.dtype))
+                    vp = vp.at[page, :, row].set(vv.astype(vp.dtype))
+                    k_list[li], v_list[li] = kp, vp
                 # paged attention: Pallas kernel on TPU (scalar-prefetched
                 # block tables, zero gather); jnp gather path elsewhere.
                 # (positions <= ctx-1 always, so the causal mask subsumes the
@@ -1440,17 +1883,23 @@ class RaggedInferenceEngine:
                 if use_pallas and self._tp_size > 1:
                     attn = _paged_attn_sharded(q, kp, vp, block_tables,
                                                positions, safe_slot,
-                                               live_pages, windows[li])
+                                               live_pages, windows[li],
+                                               ks=ksl, vs=vsl)
                 elif use_pallas:
                     attn = paged_attention(q, kp, vp, block_tables,
                                            positions, seq_slots=safe_slot,
                                            live_pages=live_pages,
                                            window=windows[li],
+                                           k_scale=ksl, v_scale=vsl,
+                                           kv_bits=kv_bits,
                                            interpret=interp)
                 else:
                     attn = paged_attention_reference(q, kp, vp, tables,
                                                      positions,
-                                                     window=windows[li])
+                                                     window=windows[li],
+                                                     k_scale=ksl,
+                                                     v_scale=vsl,
+                                                     kv_bits=kv_bits)
                 attn = attn.astype(x.dtype)
                 attn = attn.reshape(-1, c.n_heads * c.head_dim) @ lp["wo"]
                 # attn_o_bias, not use_bias: InternLM has use_bias=False
@@ -1471,7 +1920,10 @@ class RaggedInferenceEngine:
             for li in range(c.n_layers):
                 lp = jax.tree_util.tree_map(lambda a: a[li], params["layers"])
                 x = block(x, li, lp)
-            return x, (tuple(k_list), tuple(v_list))
+            out_pools = (tuple(k_list), tuple(v_list))
+            if kv_bits:
+                out_pools += (tuple(ks_list), tuple(vs_list))
+            return x, out_pools
 
         return core
 

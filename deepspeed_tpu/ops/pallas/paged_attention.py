@@ -53,13 +53,19 @@ LANES = 128
 
 def _kernel(*refs,
             scale: float, block: int, hkv: int, group: int, ppc: int,
-            num_scalars: int, window: int = 0):
-    # scalar-prefetch refs lead; positions is always the last of them
+            num_scalars: int, window: int = 0, kv_bits: int = 0):
+    # scalar-prefetch refs lead; positions is always the last of them.
+    # kv_bits > 0 = quantized pool: 2*ppc extra per-page SCALE inputs
+    # follow the payload pages, and the payload dequantizes in VMEM
+    # right after the concat (the "dequant inside the kernel read path")
     pos_ref = refs[num_scalars - 1]
     q_ref, *rest = refs[num_scalars:]
     krefs, vrefs = rest[:ppc], rest[ppc:2 * ppc]
-    o_ref = rest[2 * ppc]
-    m_scr, l_scr, acc_scr = rest[2 * ppc + 1:]
+    n_in = 2 * ppc + (2 * ppc if kv_bits else 0)
+    ksrefs = rest[2 * ppc:3 * ppc] if kv_bits else ()
+    vsrefs = rest[3 * ppc:4 * ppc] if kv_bits else ()
+    o_ref = rest[n_in]
+    m_scr, l_scr, acc_scr = rest[n_in + 1:]
     t, c = pl.program_id(0), pl.program_id(1)
     nchunks = pl.num_programs(1)
     span = ppc * block
@@ -82,6 +88,21 @@ def _kernel(*refs,
         q = q_ref[0]                                 # [hkv, group, hd] bf16
         k = jnp.concatenate([kr[0] for kr in krefs], axis=1)
         v = jnp.concatenate([vr[0] for vr in vrefs], axis=1)
+        if kv_bits:
+            # quantized pages: unpack (int4) + per-row scale in VMEM; the
+            # matmuls below then run in fp32 (q is cast to match). The
+            # nibble layout lives in ONE place (ops/quantizer) — pure
+            # jnp, so it traces inside the kernel body too
+            from ...ops.quantizer import unpack_kv_int4
+
+            ks = jnp.concatenate([r[0] for r in ksrefs], axis=1)  # [hkv, span]
+            vs = jnp.concatenate([r[0] for r in vsrefs], axis=1)
+            if kv_bits == 4:
+                k = unpack_kv_int4(k)
+                v = unpack_kv_int4(v)
+            k = k.astype(jnp.float32) * ks[..., None]
+            v = v.astype(jnp.float32) * vs[..., None]
+            q = q.astype(jnp.float32)
         # batched-over-heads MXU matmul: [hkv, group, span]
         s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
                                 preferred_element_type=jnp.float32) * scale
@@ -113,11 +134,34 @@ def _kernel(*refs,
             .astype(o_ref.dtype)
 
 
+def _check_quant_geometry(k_pool, hd: int, kv_bits: int) -> None:
+    """Fail loudly on a kv_bits/payload mismatch: an int4 nibble-packed
+    pool read with the default ``kv_bits=8`` would dequantize to
+    shape-valid garbage (hd//2 channels silently re-folded by the
+    downstream reshape), not an error."""
+    if kv_bits == 4:
+        if k_pool.dtype != jnp.uint8 or k_pool.shape[-1] * 2 != hd:
+            raise ValueError(
+                f"kv_bits=4 expects a nibble-packed uint8 pool "
+                f"[..., hd//2={hd // 2}], got {k_pool.dtype} "
+                f"[..., {k_pool.shape[-1]}] — pass the kv_bits the pool "
+                f"was quantized with")
+    elif kv_bits == 8:
+        if k_pool.dtype != jnp.int8 or k_pool.shape[-1] != hd:
+            raise ValueError(
+                f"kv_bits=8 expects an int8 pool [..., hd={hd}], got "
+                f"{k_pool.dtype} [..., {k_pool.shape[-1]}] — pass the "
+                f"kv_bits the pool was quantized with")
+    else:
+        raise ValueError(f"kv_bits must be 4 or 8 with scales, got {kv_bits}")
+
+
 def paged_attention(q, k_pool, v_pool, tables, positions, *,
                     seq_slots=None, scale=None,
                     pages_per_chunk: int | None = None,
                     live_pages: int | None = None,
                     window: int = 0,
+                    k_scale=None, v_scale=None, kv_bits: int = 8,
                     interpret: bool = False):
     """Decode attention over a paged KV pool. See module docstring for the
     layout contract. Causal by construction: token t sees pool rows with
@@ -140,9 +184,23 @@ def paged_attention(q, k_pool, v_pool, tables, positions, *,
     positions (Mistral/Qwen2 sliding-window serving): chunks wholly below
     the band are pl.when-skipped AND their page DMA indices clamp to the
     band's first live page, so repeated block indices dedup the copies —
-    compute and traffic are O(window), not O(context)."""
+    compute and traffic are O(window), not O(context).
+
+    ``k_scale``/``v_scale`` [n_pages, hkv, block] switch the pools to
+    quantized storage (``ops/quantizer.quantize_kv``; int8 payload, or
+    nibble-packed uint8 [..., hd//2] at ``kv_bits=4``): scales ride the
+    same per-page BlockSpec pipeline as the payloads (half/quarter the
+    page DMA bytes vs an fp pool) and the payload dequantizes in VMEM
+    right before the QK^T matmul. NB: the f32 scale tile's lane dim is
+    ``block`` (< 128 for typical pools) — fine in interpret mode and on
+    current Mosaic via padding, but on-TPU validation of the quantized
+    kernel outside interpret mode is a follow-up (same status the fused
+    collective kernels shipped with)."""
     T, hq, hd = q.shape
     n_pages, hkv, block, _ = k_pool.shape
+    quant = k_scale is not None
+    if quant:
+        _check_quant_geometry(k_pool, hd, kv_bits)
     max_pages = tables.shape[1]
     group = hq // hkv
     assert hq % hkv == 0
@@ -184,12 +242,28 @@ def paged_attention(q, k_pool, v_pool, tables, positions, *,
             return (tbl[row_of(t, s), j], 0, 0, 0)
         return index
 
-    page_spec = lambda i: pl.BlockSpec((1, hkv, block, hd), page_index(i))
+    def page_index3(i):
+        # the scale leaves are [n_pages, hkv, block] (no channel dim):
+        # same page pick as the payload, one fewer trailing zero
+        idx4 = page_index(i)
+
+        def index(t, c, *s):
+            return idx4(t, c, *s)[:3]
+        return index
+
+    hd_p = k_pool.shape[-1]               # packed channel dim (= hd unless int4)
+    page_spec = lambda i: pl.BlockSpec((1, hkv, block, hd_p), page_index(i))
+    scale_spec = lambda i: pl.BlockSpec((1, hkv, block), page_index3(i))
+    in_specs = [pl.BlockSpec((1, hkv, group, hd), q_index)] \
+        + [page_spec(i) for i in range(ppc)] * 2
+    operands = [qg, *([k_pool] * ppc), *([v_pool] * ppc)]
+    if quant:
+        in_specs += [scale_spec(i) for i in range(ppc)] * 2
+        operands += [*([k_scale] * ppc), *([v_scale] * ppc)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=len(scalars),
         grid=(T, nchunks),
-        in_specs=[pl.BlockSpec((1, hkv, group, hd), q_index)]
-        + [page_spec(i) for i in range(ppc)] * 2,
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, hkv, group, hd), q_index),
         scratch_shapes=[
             pltpu.VMEM((hkv * group, LANES), jnp.float32),
@@ -200,24 +274,58 @@ def paged_attention(q, k_pool, v_pool, tables, positions, *,
     out = pl.pallas_call(
         functools.partial(_kernel, scale=scale, block=block, hkv=hkv,
                           group=group, ppc=ppc, num_scalars=len(scalars),
-                          window=int(window)),  # dslint: disable=host-sync -- window is a static Python int kernel parameter, never a tracer
+                          window=int(window),  # dslint: disable=host-sync -- window is a static Python int kernel parameter, never a tracer
+                          kv_bits=int(kv_bits) if quant else 0),  # dslint: disable=host-sync -- kv_bits is a static Python int kernel parameter, never a tracer
         out_shape=jax.ShapeDtypeStruct((T, hkv, group, hd), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(*scalars, qg, *([k_pool] * ppc), *([v_pool] * ppc))
+    )(*scalars, *operands)
     return out.reshape(T, hq, hd)
 
 
 def paged_attention_reference(q, k_pool, v_pool, tables, positions, *,
-                              scale=None, window: int = 0):
+                              scale=None, window: int = 0,
+                              k_scale=None, v_scale=None, kv_bits: int = 8):
     """jnp reference (gather-based) with identical semantics — the numerics
     oracle for the kernel and the off-TPU fallback formulation.
     ``window`` > 0 bands attention to the trailing ``window`` positions
-    (sliding-window serving: k > pos - window)."""
+    (sliding-window serving: k > pos - window).
+
+    ``k_scale``/``v_scale`` [n_pages, hkv, block] switch the pools to
+    quantized storage (``ops/quantizer.quantize_kv``): int8 payloads —
+    or, at ``kv_bits=4``, nibble-packed uint8 [..., hd//2] — are
+    dequantized AFTER the per-token page gather (only pages actually
+    read pay the dequant, mirroring the kernel's in-VMEM dequant)."""
+    from ..quantizer import dequantize_kv
+
     T, hq, hd = q.shape
     n_pages, hkv, block, _ = k_pool.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(hd)
     group = hq // hkv
+    if k_scale is not None:
+        _check_quant_geometry(k_pool, hd, kv_bits)
+        # gather first ([T, max_pages, hkv, block, hd_p]), then dequant
+        # page payloads with their per-row scales ([T, max_pages, hkv,
+        # block] broadcast over hd)
+        k_pages = dequantize_kv(k_pool[tables], k_scale[tables],
+                                bits=kv_bits)
+        v_pages = dequantize_kv(v_pool[tables], v_scale[tables],
+                                bits=kv_bits)
+        keys = k_pages.transpose(0, 2, 1, 3, 4).reshape(
+            T, hkv, -1, hd).transpose(0, 2, 1, 3)
+        vals = v_pages.transpose(0, 2, 1, 3, 4).reshape(
+            T, hkv, -1, hd).transpose(0, 2, 1, 3)
+        keys = jnp.repeat(keys, group, axis=2)
+        vals = jnp.repeat(vals, group, axis=2)
+        logits = jnp.einsum("thd,tkhd->thk", q.astype(jnp.float32),
+                            keys) * scale
+        kv_pos = jnp.arange(keys.shape[1])[None, :]
+        visible = kv_pos <= positions[:, None]
+        if window > 0:
+            visible = visible & (kv_pos > positions[:, None] - window)
+        logits = jnp.where(visible[:, None, :], logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("thk,tkhd->thd", probs, vals).astype(q.dtype)
     # [T, max_pages, hkv, block, hd] -> [T, ctx, hkv, hd]
     keys = k_pool[tables].transpose(0, 2, 1, 3, 4).reshape(
         T, hkv, -1, hd).transpose(0, 2, 1, 3)

@@ -107,6 +107,53 @@ def quantized_nbytes(numel: int, bits: int, block: int) -> int:
     return payload + scales
 
 
+def quantize_kv(x: jnp.ndarray, bits: int = 8):
+    """Per-vector symmetric quantization for KV-cache rows: ``x``
+    [..., hd] -> (payload, scale [...]) with one fp32 scale per trailing
+    vector (block = head_dim — a K or V head-vector is the natural
+    quantization block for paged KV storage: the scatter/gather unit).
+
+    int8: payload int8 [..., hd]. int4: values clamp to [-8, 7] and PACK
+    two adjacent channels per byte -> uint8 [..., hd//2] (channel 2c in
+    the low nibble, 2c+1 in the high — the layout :func:`unpack_kv`
+    inverts), so a quantized pool leaf really is a quarter the fp32
+    bytes. Error bound (the contract the serving docs state): each
+    dequantized element is within ``scale/2`` of the input, where
+    ``scale = absmax(vector)/qmax``. Traced-code safe (pure jnp)."""
+    assert bits in (4, 8)
+    qmax = 2.0 ** (bits - 1) - 1
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -qmax - 1, qmax)
+    if bits == 8:
+        return q.astype(jnp.int8), scale
+    qi = q.astype(jnp.int32)
+    lo = qi[..., 0::2] & 0x0F
+    hi = (qi[..., 1::2] & 0x0F) << 4
+    return (lo | hi).astype(jnp.uint8), scale
+
+
+def unpack_kv_int4(packed: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of the int4 packing in :func:`quantize_kv`: uint8
+    [..., hd//2] -> int32 [..., hd] in [-8, 7] (int32 out: the consumer
+    multiplies by an fp scale immediately)."""
+    p = packed.astype(jnp.int32)
+    lo = p & 0x0F
+    hi = (p >> 4) & 0x0F
+    both = jnp.stack([lo, hi], axis=-1).reshape(p.shape[:-1] + (-1,))
+    return jnp.where(both >= 8, both - 16, both)
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, bits: int = 8,
+                  dtype=jnp.float32) -> jnp.ndarray:
+    """Dequantize a :func:`quantize_kv` payload back to ``dtype``:
+    payload [..., hd or hd//2] * scale [...] -> [..., hd]."""
+    assert bits in (4, 8)
+    vals = unpack_kv_int4(q) if bits == 4 else q.astype(jnp.int32)
+    return (vals.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
     """Pack int4 values (int8 storage in [-8, 7], even length) two nibbles
     per byte, so an inter-host int4 collective really moves half the

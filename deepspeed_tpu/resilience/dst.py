@@ -51,8 +51,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..inference.ragged import (BlockedAllocator, PoolExhausted, PrefixCache,
-                                SequenceDescriptor, block_balance_report)
+from ..inference.ragged import (BlockedAllocator, NgramIndex, PoolExhausted,
+                                PrefixCache, SequenceDescriptor,
+                                block_balance_report)
 from ..telemetry.registry import MetricsRegistry
 from ..telemetry.telemetry import Telemetry, set_telemetry
 from ..telemetry.tracing import Tracer, trace_tree_problems, use_tracer
@@ -64,7 +65,7 @@ __all__ = ["SimConfig", "SimEngine", "SimKVExport", "SimEvent", "Schedule",
            "RegionSchedule", "SimReport", "generate_schedule",
            "generate_region_schedule", "run_schedule",
            "run_region_schedule", "shrink_schedule", "dump_repro",
-           "load_repro"]
+           "load_repro", "spec_identity_problems"]
 
 
 # ----------------------------------------------------------------------
@@ -84,6 +85,13 @@ class SimConfig:
     max_context: int = 96
     enable_prefix_cache: bool = True
     vocab: int = 48
+    # declared KV storage mode: the sim has no payload to quantize —
+    # carrying the knob keeps the serving-layer validation and the
+    # export/import geometry contract (mode must match across the
+    # disaggregated hand-off) exercised at fleet scale, and the
+    # token-identity audit witnesses that quantized runs stay
+    # greedy-bit-exact (tokens are a pure function of context)
+    kv_quant: str = "none"
 
     def to_dict(self) -> Dict[str, Any]:
         return dict(self.__dict__)
@@ -103,6 +111,7 @@ class SimKVExport:
     prompt_len: int
     kv_block_size: int
     n_pages: int
+    kv_quant: str = "none"      # must match the importer's declared mode
 
 
 def _next_token(ctx: Sequence[int], vocab: int) -> int:
@@ -136,6 +145,10 @@ class SimEngine:
         self._free_slots: List[int] = list(range(cfg.max_seqs))
         self._resume_uids: set = set()
         self.tick_count = 0
+        # speculative-decoding surface (mirrors the ragged engine):
+        # per-uid memoized n-gram indices + the acceptance-stats dict
+        self._ngram_idx: Dict[int, NgramIndex] = {}
+        self.spec_stats = {"proposed": 0, "accepted": 0, "rounds": 0}
 
     # -- capacity queries (formulas identical to the ragged engine) -----
     def _available_blocks(self) -> int:
@@ -172,6 +185,7 @@ class SimEngine:
     def flush(self, uids: Sequence[int]) -> None:
         for uid in uids:
             seq = self.seqs.pop(uid, None)
+            self._ngram_idx.pop(uid, None)
             if seq is not None:
                 if self.prefix_cache is not None:
                     self.prefix_cache.publish(seq.tokens, seq.blocks,
@@ -190,11 +204,71 @@ class SimEngine:
 
     def discard(self, uid: int) -> None:
         seq = self.seqs.pop(uid, None)
+        self._ngram_idx.pop(uid, None)
         if seq is None:
             return
         self.allocator.free(seq.blocks)
         self._free_slots.append(seq.slot)
         self._resume_uids.add(uid)
+
+    def trim(self, uid: int, length: int) -> None:
+        """Mirror of the ragged engine's ``trim`` minus the device page
+        copy: rewind to ``length`` tokens, free now-unused blocks, and —
+        refcount parity with the real copy-on-write — swap the boundary
+        block for a private one when it is shared, so the block-balance
+        audit exercises identical accounting on the spec-decode rewind
+        path."""
+        seq = self.seqs[uid]
+        if not 0 <= length <= seq.seen:
+            raise ValueError(
+                f"uid {uid}: trim length {length} outside [0, "
+                f"seen={seq.seen}]")
+        bs = self.config.kv_block_size
+        keep = -(-length // bs) if length else 0
+        cow_new = None
+        if (length % bs and keep <= len(seq.blocks)
+                and self.allocator.refcount(seq.blocks[keep - 1]) > 1):
+            if (self.allocator.free_blocks < 1
+                    and self.prefix_cache is not None):
+                self.prefix_cache.evict_for(self.allocator, 1)
+            if self.allocator.refcount(seq.blocks[keep - 1]) > 1:
+                cow_new = self.allocator.allocate(1)[0]
+        seq.tokens = seq.tokens[:length]
+        seq.seen = length
+        ngi = self._ngram_idx.get(uid)
+        if ngi is not None:
+            ngi.truncate(length)
+        if keep < len(seq.blocks):
+            self.allocator.free(seq.blocks[keep:])
+            del seq.blocks[keep:]
+        if cow_new is not None:
+            old = seq.blocks[keep - 1]
+            self.allocator.release([old])
+            seq.blocks[keep - 1] = cow_new
+
+    # -- speculative drafting (same surface as the ragged engine) -------
+    def draft_tokens(self, uid: int, next_token: Optional[int],
+                     ngram: int, k: int) -> List[int]:
+        seq = self.seqs[uid]
+        idx = self._ngram_idx.get(uid)
+        if idx is None or idx.ngram != int(ngram):
+            idx = NgramIndex(ngram)
+            self._ngram_idx[uid] = idx
+        idx.sync(seq.tokens)
+        return idx.lookup([] if next_token is None else [int(next_token)], k)
+
+    def record_spec(self, proposed: int = 0, accepted: int = 0,
+                    rounds: int = 0) -> None:
+        from ..telemetry import get_telemetry
+
+        s = self.spec_stats
+        s["proposed"] += int(proposed)
+        s["accepted"] += int(accepted)
+        s["rounds"] += int(rounds)
+        t = get_telemetry()
+        if t.enabled and s["proposed"]:
+            t.registry.gauge("inference/spec_acceptance").set(
+                s["accepted"] / s["proposed"])
 
     def clear_resume(self, uid: int) -> None:
         self._resume_uids.discard(uid)
@@ -212,7 +286,8 @@ class SimEngine:
         return SimKVExport(uid=uid, tokens=list(seq.tokens), seen=seq.seen,
                            prompt_len=seq.prompt_len,
                            kv_block_size=self.config.kv_block_size,
-                           n_pages=len(seq.blocks))
+                           n_pages=len(seq.blocks),
+                           kv_quant=self.config.kv_quant)
 
     def import_kv(self, uid: int, export: SimKVExport) -> None:
         cfg = self.config
@@ -220,6 +295,10 @@ class SimEngine:
             raise ValueError(f"uid {uid} already live in this engine")
         if export.kv_block_size != cfg.kv_block_size:
             raise ValueError("KV geometry mismatch")
+        if getattr(export, "kv_quant", "none") != cfg.kv_quant:
+            raise ValueError(
+                f"KV quant-mode mismatch: engine '{cfg.kv_quant}' vs "
+                f"export '{getattr(export, 'kv_quant', 'none')}'")
         if export.seen != len(export.tokens):
             raise ValueError(
                 f"export seen {export.seen} != tokens {len(export.tokens)}")
@@ -243,9 +322,11 @@ class SimEngine:
         self._resume_uids.discard(uid)
 
     # -- the step --------------------------------------------------------
-    def put(self, uids: Sequence[int],
-            tokens: Sequence[Sequence[int]]) -> np.ndarray:
-        cfg = self.config
+    def _admit_tokens(self, uids: Sequence[int],
+                      tokens: Sequence[Sequence[int]]) -> None:
+        """Admission shared by put()/put_spec() (the mirror of the real
+        engine's same-named helper): fresh uids get a slot + cached
+        prefix adoption, existing ones append their chunk."""
         for uid, toks in zip(uids, tokens):
             new = uid not in self.seqs
             if new:
@@ -264,10 +345,12 @@ class SimEngine:
                         self.allocator.retain(blocks)
                         seq.blocks = list(blocks)
                         seq.seen = shared
-        # Dynamic SplitFuse packing: shortest-pending first into the one
-        # token budget (same policy as the device engine)
+
+    def _pack_splitfuse(self) -> List[Tuple[SequenceDescriptor, int]]:
+        """Dynamic SplitFuse packing: shortest-pending first into the one
+        token budget (same policy as the device engine)."""
         sched: List[Tuple[SequenceDescriptor, int]] = []
-        budget = cfg.token_budget
+        budget = self.config.token_budget
         pending = sorted((s for s in self.seqs.values() if s.pending > 0),
                          key=lambda s: s.pending)
         for seq in pending:
@@ -276,8 +359,14 @@ class SimEngine:
                 break
             sched.append((seq, take))
             budget -= take
-        if not sched:
-            raise ValueError("put() called with no pending tokens")
+        return sched
+
+    def _validate_sched(self, sched) -> List[int]:
+        """Context bound + whole-schedule pool check BEFORE any
+        allocation, evicting cached prefixes first — an exhausted pool
+        must leave every descriptor consistent (tokens admitted, seen
+        unchanged) for the retry path. Returns per-entry block needs."""
+        cfg = self.config
         needs = []
         for seq, take in sched:
             total = seq.seen + take
@@ -287,9 +376,6 @@ class SimEngine:
             needs.append(max(0, -(-total // cfg.kv_block_size)
                              - len(seq.blocks)))
         need_total = sum(needs)
-        # whole-schedule pool check BEFORE any allocation, evicting cached
-        # prefixes first — an exhausted pool must leave every descriptor
-        # consistent (tokens admitted, seen unchanged) for the retry path
         if (need_total > self.allocator.free_blocks
                 and self.prefix_cache is not None):
             self.prefix_cache.evict_for(self.allocator, need_total)
@@ -297,6 +383,16 @@ class SimEngine:
             raise PoolExhausted(
                 f"KV pool exhausted: need {need_total}, have "
                 f"{self.allocator.free_blocks}")
+        return needs
+
+    def put(self, uids: Sequence[int],
+            tokens: Sequence[Sequence[int]]) -> np.ndarray:
+        cfg = self.config
+        self._admit_tokens(uids, tokens)
+        sched = self._pack_splitfuse()
+        if not sched:
+            raise ValueError("put() called with no pending tokens")
+        needs = self._validate_sched(sched)
         for (seq, take), n in zip(sched, needs):
             if n:
                 seq.blocks.extend(self.allocator.allocate(n))
@@ -310,6 +406,85 @@ class SimEngine:
                 out[i] = 0.0
                 out[i, _next_token(seq.tokens, cfg.vocab)] = 1.0
         return out
+
+    def put_spec(self, uids: Sequence[int],
+                 tokens: Sequence[Sequence[int]],
+                 drafts: Sequence[Sequence[int]]):
+        """Mirror of the ragged engine's ``put_spec``: one step verifying
+        draft chains alongside prefill/decode traffic, same all-or-strip
+        budget semantics and the same strip-on-PoolExhausted contract.
+        Rows are the sim's one-hot "logits": row ``j`` is
+        ``onehot(next(context through chain[j]))``, so greedy acceptance
+        in the serving layer reproduces EXACTLY the plain tick-by-tick
+        stream — the token-identity invariant's witness at fleet scale."""
+        cfg = self.config
+        self._admit_tokens(uids, tokens)
+        # validate EVERY chain before appending ANY draft token (the
+        # real engine's discipline: a raise mid-append would leave
+        # earlier uids' unverified drafts in their streams)
+        for uid, d in zip(uids, drafts):
+            if d and self.seqs[uid].pending != 1:
+                raise ValueError(
+                    f"uid {uid}: a draft chain continues exactly one "
+                    f"pending decode token, found "
+                    f"pending={self.seqs[uid].pending}")
+        appended: Dict[int, int] = {}
+        for uid, d in zip(uids, drafts):
+            if not d:
+                continue
+            self.seqs[uid].tokens.extend(int(t) for t in d)
+            appended[uid] = len(d)
+        try:
+            sched = self._pack_splitfuse()
+            if not sched:
+                raise ValueError("put_spec() called with no pending tokens")
+            take_of = {seq.uid: take for seq, take in sched}
+            for uid in list(appended):       # all-or-strip under budget
+                seq = self.seqs[uid]
+                chain_len = 1 + appended[uid]
+                take = take_of.get(uid, 0)
+                if take < chain_len:
+                    strip = chain_len - max(take, 1)
+                    if strip:
+                        del seq.tokens[len(seq.tokens) - strip:]
+                        appended[uid] -= strip
+                    if appended[uid] <= 0:
+                        appended.pop(uid)
+            sched = [(seq, min(take, seq.pending))
+                     for seq, take in sched if seq.pending > 0]
+            needs = self._validate_sched(sched)
+        except BaseException:
+            # strip every remaining draft token: the recovery retry is a
+            # PLAIN put of the admitted feed, exactly as the real engine
+            for uid, n in appended.items():
+                seq = self.seqs[uid]
+                del seq.tokens[len(seq.tokens) - n:]
+            raise
+        seen0: Dict[int, int] = {}
+        for (seq, take), n in zip(sched, needs):
+            if n:
+                seq.blocks.extend(self.allocator.allocate(n))
+            seen0[seq.uid] = seq.seen
+            seq.seen += take
+        self.tick_count += 1
+        scheduled = {seq.uid for seq, _ in sched}
+        out = np.full((len(uids), cfg.vocab), np.nan, np.float32)
+        for i, uid in enumerate(uids):
+            seq = self.seqs[uid]
+            if seq.pending == 0 and uid in scheduled:
+                out[i] = 0.0
+                out[i, _next_token(seq.tokens, cfg.vocab)] = 1.0
+        verified: Dict[int, Tuple[List[int], np.ndarray]] = {}
+        for seq, take in sched:
+            if seq.uid in appended:
+                s0 = seen0[seq.uid]
+                chain = [int(t) for t in seq.tokens[s0:s0 + take]]
+                rows = np.zeros((take, cfg.vocab), np.float32)
+                for j in range(take):
+                    rows[j, _next_token(seq.tokens[:s0 + j + 1],
+                                        cfg.vocab)] = 1.0
+                verified[seq.uid] = (chain, rows)
+        return out, verified
 
 
 # ----------------------------------------------------------------------
@@ -515,6 +690,23 @@ def generate_schedule(seed: int) -> Schedule:
                                payload={"dt": round(rng.uniform(3.0,
                                                                 20.0), 3)}))
     events.sort(key=_event_order)
+    # speculative serving + quantized-KV draws — appended AFTER the event
+    # stream so pre-existing seeds keep their exact event sequences (the
+    # regression-seed corpus stays meaningful). The invariants must hold
+    # with drafts verifying inside the tick (multiple tokens per request
+    # per tick) and with the quantized pool/wire mode declared end to
+    # end; invariant #10 (token identity) witnesses that neither changes
+    # WHICH tokens any request emits.
+    if rng.random() < 0.35:
+        serving_cfg.update(
+            speculative=True,
+            spec_lookahead=rng.choice([2, 4]),
+            spec_ngram=2,
+            spec_accept_floor=rng.choice([0.0, 0.3]),
+            spec_floor_min_proposed=8)
+    kvq = rng.choice(["none", "none", "int8", "int4"])
+    engine_cfg["kv_quant"] = kvq
+    serving_cfg["kv_quant"] = kvq
     return Schedule(seed=seed, horizon=horizon, engine_cfg=engine_cfg,
                     fleet_cfg=fleet_cfg, serving_cfg=serving_cfg,
                     events=events)
@@ -654,6 +846,18 @@ def generate_region_schedule(seed: int) -> RegionSchedule:
                                payload={"dt": round(rng.uniform(3.0,
                                                                 15.0), 3)}))
     events.sort(key=_event_order)
+    # speculative + kv-quant draws appended after the event stream (same
+    # rationale as generate_schedule): region chaos — cell outages,
+    # partitions, cross-cell adoption — must preserve token identity
+    # with drafts and quantized hand-offs in play
+    if rng.random() < 0.3:
+        serving_cfg.update(
+            speculative=True, spec_lookahead=rng.choice([2, 4]),
+            spec_ngram=2, spec_accept_floor=rng.choice([0.0, 0.3]),
+            spec_floor_min_proposed=8)
+    kvq = rng.choice(["none", "none", "int8", "int4"])
+    engine_cfg["kv_quant"] = kvq
+    serving_cfg["kv_quant"] = kvq
     return RegionSchedule(seed=seed, horizon=horizon,
                           engine_cfg=engine_cfg, fleet_cfg=fleet_cfg,
                           serving_cfg=serving_cfg, region_cfg=region_cfg,
@@ -778,11 +982,17 @@ class InvariantAuditor:
     pass condition."""
 
     def __init__(self, fleet, clock, capture: _CaptureTelemetry,
-                 tracer: Optional[Tracer] = None) -> None:
+                 tracer: Optional[Tracer] = None,
+                 vocab: Optional[int] = None) -> None:
         self.fleet = fleet
         self.clock = clock
         self.capture = capture
         self.tracer = tracer
+        # sim vocab arms invariant #10 (greedy token-identity): the
+        # expected stream is recomputable from the prompt alone because
+        # the sim model is a pure function of context
+        self.vocab = vocab
+        self._expected: Dict[int, List[int]] = {}
         # trace_ids whose tree was already audited: each request's tree
         # is checked ONCE, when it first turns terminal — re-scanning
         # the whole span ring per terminal request per tick would make
@@ -870,6 +1080,22 @@ class InvariantAuditor:
             if t.delivered != list(t.req.tokens):
                 v.append(f"[delivery] r{t.ix}: delivered {t.delivered} != "
                          f"emitted {list(t.req.tokens)}")
+        # 10. greedy token-identity: every emitted stream is a PREFIX of
+        # the pure-function greedy expectation recomputed from the
+        # prompt alone — speculative decoding, quantized KV, preemption,
+        # failover and disaggregated hand-off may change WHEN tokens
+        # emit, never WHICH (docs/serving.md's token-identity contract,
+        # witnessed at fleet scale on every audit)
+        if self.vocab:
+            for t in tracked:
+                n = len(t.req.tokens)
+                if not n:
+                    continue
+                want = self._expected_stream(t.req, n)
+                if list(t.req.tokens) != want:
+                    v.append(f"[token-identity] r{t.ix}: emitted "
+                             f"{list(t.req.tokens)} != greedy expectation "
+                             f"{want}")
         # 7. trace-tree connectivity: a terminal request's spans — across
         # however many replicas served it (failover, disagg hand-off) —
         # must form ONE closed connected tree: exactly one root, no
@@ -885,6 +1111,20 @@ class InvariantAuditor:
                         self.tracer.spans_for_trace(root.trace_id)):
                     v.append(f"[trace-tree] r{t.ix}: {p}")
         return v
+
+    def _expected_stream(self, req, n: int) -> List[int]:
+        """First ``n`` tokens of the sim model's greedy stream for
+        ``req`` — grown lazily and memoized per uid (the audit runs
+        after every event; recomputing the FNV chain from scratch each
+        time would be quadratic in run length)."""
+        exp = self._expected.setdefault(req.uid, [])
+        if len(exp) < n:
+            ctx = list(req.prompt) + exp
+            while len(exp) < n:
+                t = _next_token(ctx, self.vocab)
+                exp.append(t)
+                ctx.append(t)
+        return exp[:n]
 
     def final(self, tracked: List[_Tracked], engines: List[SimEngine]
               ) -> List[str]:
@@ -932,9 +1172,10 @@ class RegionInvariantAuditor(InvariantAuditor):
     """
 
     def __init__(self, region, clock, capture: _CaptureTelemetry,
-                 tracer: Optional[Tracer] = None) -> None:
+                 tracer: Optional[Tracer] = None,
+                 vocab: Optional[int] = None) -> None:
         super().__init__(fleet=None, clock=clock, capture=capture,
-                         tracer=tracer)
+                         tracer=tracer, vocab=vocab)
         self.region = region
 
     def _replicas(self):
@@ -1029,6 +1270,12 @@ class SimReport:
     cancelled: int
     rejected: int
     tokens: Dict[int, List[int]]          # logical ix -> emitted stream
+    # logical ix -> terminal state value ("finished"/"cancelled"/...) —
+    # the spec-on/off identity gate compares streams exactly for
+    # requests finished in BOTH runs and prefix-wise otherwise (spec
+    # changes WHEN a timing-dependent cancel/fault lands, never WHICH
+    # tokens precede it)
+    states: Dict[int, str] = field(default_factory=dict)
     # canonical hash of the run's span tree (telemetry/tracing.py): the
     # second determinism witness — same seed, same request timelines
     span_hash: str = ""
@@ -1106,7 +1353,7 @@ def run_schedule(schedule: Schedule,
                                  dict(schedule.serving_cfg),
                                  preemption_guard=guard, start=False)
             auditor = InvariantAuditor(fleet, clock, capture,
-                                       tracer=tracer)
+                                       tracer=tracer, vocab=sim_cfg.vocab)
             events = sorted(schedule.events, key=_event_order)
             i = 0
             while True:
@@ -1170,6 +1417,7 @@ def run_schedule(schedule: Schedule,
         cancelled=sum(s is RequestState.CANCELLED for s in states),
         rejected=sum(s is RequestState.REJECTED for s in states),
         tokens={t.ix: list(t.req.tokens) for t in tracked},
+        states={t.ix: t.req.state.value for t in tracked},
         span_hash=tracer.canonical_hash(), n_spans=len(tracer.spans()),
         spans=([s.to_dict() for s in tracer.spans()]
                if violations else None))
@@ -1256,7 +1504,8 @@ def run_region_schedule(schedule: RegionSchedule,
                              dict(schedule.serving_cfg),
                              preemption_guard=guard, start=False)
             auditor = RegionInvariantAuditor(region, clock, capture,
-                                             tracer=tracer)
+                                             tracer=tracer,
+                                             vocab=sim_cfg.vocab)
             events = sorted(schedule.events, key=_event_order)
             i = 0
             while True:
@@ -1317,6 +1566,7 @@ def run_region_schedule(schedule: RegionSchedule,
         cancelled=sum(s is RequestState.CANCELLED for s in states),
         rejected=sum(s is RequestState.REJECTED for s in states),
         tokens={t.ix: list(t.req.tokens) for t in tracked},
+        states={t.ix: t.req.state.value for t in tracked},
         span_hash=tracer.canonical_hash(), n_spans=len(tracer.spans()),
         spans=([s.to_dict() for s in tracer.spans()]
                if violations else None),
@@ -1388,6 +1638,29 @@ def _apply_region_event(region, ev: SimEvent, tracked: List[_Tracked],
 # ----------------------------------------------------------------------
 # shrinking + regression artifacts
 # ----------------------------------------------------------------------
+
+def spec_identity_problems(rep_on: "SimReport",
+                           rep_off: "SimReport") -> List[str]:
+    """Token-identity comparison of one schedule run spec-on vs spec-off
+    (the satellite gate dst_soak and the regression seeds share): every
+    request's two streams must agree on their common prefix (speculation
+    may move WHEN a timing-dependent cancel/fault/deadline lands, never
+    WHICH tokens precede it), and a request FINISHED in both runs must
+    emit the exact same stream."""
+    problems: List[str] = []
+    for ix in sorted(set(rep_on.tokens) | set(rep_off.tokens)):
+        a = rep_on.tokens.get(ix, [])
+        b = rep_off.tokens.get(ix, [])
+        n = min(len(a), len(b))
+        if a[:n] != b[:n]:
+            problems.append(f"r{ix}: spec-on prefix {a[:n]} != spec-off "
+                            f"{b[:n]}")
+        elif (rep_on.states.get(ix) == "finished"
+                and rep_off.states.get(ix) == "finished" and a != b):
+            problems.append(f"r{ix}: finished in both runs but spec-on "
+                            f"emitted {a} vs spec-off {b}")
+    return problems
+
 
 def shrink_schedule(schedule: Schedule,
                     fails: Optional[Callable[[Schedule], bool]] = None,

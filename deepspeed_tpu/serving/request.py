@@ -99,6 +99,12 @@ class Request:
     error: Optional[str] = None
     preemptions: int = 0
     retries: int = 0          # tick-fault re-queues (distinct from preempts)
+    # speculative-decoding ledger (serving tick, docs/serving.md):
+    # draft tokens proposed/accepted for THIS request across its whole
+    # life (they travel with it through failover/hand-off) — stamped
+    # into the terminal RequestStats record
+    spec_proposed: int = 0
+    spec_accepted: int = 0
     t_submit: Optional[float] = None     # clock.now() stamps
     t_admit: Optional[float] = None      # last admission (re-set on resume)
     t_first_admit: Optional[float] = None
@@ -130,6 +136,13 @@ class Request:
         # fleet-internal: hand this request from its prefill replica to a
         # decode replica once its first token resolves (disaggregated mode)
         self._handoff_requested = False
+        # speculative-decoding driver state: rolling per-request
+        # acceptance EMA (optimistic start — a fresh request gets full
+        # drafts until it proves unpredictable) and the per-request
+        # fallback latch (below the configured floor drafting stops for
+        # good; the stream stays token-identical either way)
+        self._spec_ema = 1.0
+        self._spec_disabled = False
         # distributed tracing (telemetry/tracing.py): the request's open
         # root span and its current lifecycle segment. Both stay None
         # with tracing off; the tree travels WITH the request across

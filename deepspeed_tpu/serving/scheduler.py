@@ -106,6 +106,36 @@ class CapacityView:
         need += sum(self._live_reserved.values())
         return max(0, need - self._engine._available_blocks())
 
+    # -- speculative token-credit math (docs/serving.md "Speculative
+    # scheduling"): drafting consumes only token-budget SLACK, sized by
+    # the class acceptance-rate EMA — the feed builder's one arithmetic,
+    # tested directly.
+    def draft_budget(self, n_decodes: int, prefill_tokens: int) -> int:
+        """Token-budget slack draft chains may add this tick: the engine
+        budget minus one guaranteed token per live decode minus the
+        prefill backlog's claim (pending prompt tokens, capped at the
+        budget — SplitFuse spreads longer prompts over later ticks, and
+        every such tick re-runs this arithmetic). Prefill's claim comes
+        off the top, so drafting can never starve prefill admission or
+        progress; with zero slack the tick degrades to plain decode."""
+        budget = self._engine.config.token_budget
+        claim = min(max(0, int(prefill_tokens)), budget)
+        return max(0, budget - max(0, int(n_decodes)) - claim)
+
+    @staticmethod
+    def chain_len_for(accept_ema: float, lookahead: int) -> int:
+        """Per-request draft length under the class acceptance EMA:
+        scale the configured lookahead by the EMA (rounded) — the class
+        CREDIT, in tokens. A cold class keeps a ONE-token probe rather
+        than freezing at zero: with no proposals the EMA could never
+        update and the class would lose drafting for the server's whole
+        lifetime — per-REQUEST hopelessness is the fallback latch's job
+        (`spec_accept_floor`), the class credit only sizes chains."""
+        if lookahead < 1:
+            return 0
+        c = min(1.0, max(0.0, float(accept_ema)))
+        return max(1, min(int(lookahead), int(c * lookahead + 0.5)))
+
     def evictable_blocks(self, seq) -> int:
         """Pages that actually become schedulable if ``seq`` is evicted:
         those whose every non-cache reference is this sequence's own

@@ -107,6 +107,8 @@ def emit_request_span(telemetry, req: Request) -> None:
         # for single-token requests
         tokens_per_s=((n - 1) / decode_s if decode_s and n > 1 else None),
         preemptions=req.preemptions, retries=req.retries,
+        spec_proposed=(req.spec_proposed if req.spec_proposed else None),
+        spec_accepted=(req.spec_accepted if req.spec_proposed else None),
         in_slo=in_slo, error=req.error,
         trace_id=(root.trace_id if root is not None and not root.is_noop
                   else None),
@@ -189,6 +191,22 @@ class ServingEngine:
         # clock; a SimClock here makes the whole driver virtual-time
         # (docs/dst.md)
         self._clock = clock if clock is not None else get_clock()
+        # speculative decoding (docs/serving.md "Speculative scheduling"):
+        # drafting needs the engine's draft/verify surface; per-PRIORITY
+        # acceptance EMAs drive the token credit that sizes chains.
+        # Declared kv_quant must match the engine's own mode — a fleet
+        # whose replicas disagree on pool storage would corrupt every
+        # disaggregated hand-off at import time, so fail at construction.
+        self._spec_on = bool(getattr(config, "speculative", False)) and \
+            hasattr(engine, "put_spec") and hasattr(engine, "draft_tokens")
+        self._spec_ema_by_class: Dict[int, float] = {}
+        want_quant = str(getattr(config, "kv_quant", "none"))
+        have_quant = str(getattr(engine.config, "kv_quant", "none"))
+        if want_quant != "none" and want_quant != have_quant:
+            raise ValueError(
+                f"serving.kv_quant='{want_quant}' but the engine stores "
+                f"KV as '{have_quant}' — configure both from one source")
+        self._kv_quant = have_quant
         self._lock = threading.RLock()
         self._queue: List[Request] = []
         self._live: Dict[int, Request] = {}
@@ -206,10 +224,19 @@ class ServingEngine:
         self._stuck_reported = False
         self._driver: Optional[threading.Thread] = None
         self._watchdog: Optional[threading.Thread] = None
+        if getattr(config, "speculative", False) and not self._spec_on:
+            logger.warning(
+                "ServingEngine: serving.speculative requested but the "
+                "engine has no put_spec/draft_tokens surface — serving "
+                "plain decode")
         log_dist(f"ServingEngine{f'[{replica_id}]' if replica_id else ''}: "
                  f"policy={self.policy.name} "
                  f"max_queue={config.max_queue} "
-                 f"preemption={getattr(self.policy, 'preemption', False)}")
+                 f"preemption={getattr(self.policy, 'preemption', False)}"
+                 + (f" speculative=on(lookahead={config.spec_lookahead})"
+                    if self._spec_on else "")
+                 + (f" kv_quant={self._kv_quant}"
+                    if self._kv_quant != "none" else ""))
         if start:
             self.start()
 
@@ -637,14 +664,15 @@ class ServingEngine:
 
     def _tick(self) -> bool:
         """One driver iteration: latch poll, adoptions, cancellations,
-        admission (+ preemption), one engine ``put()``, token dispatch.
+        admission (+ preemption), one engine ``put()`` — a verify step
+        when speculative chains are drafted — and token dispatch.
         Returns False when idle."""
         self._check_latch()
         self._import_adoptions()
         with self._lock:
             self._process_cancellations()
-            self._admit()
-            uids, toks = self._build_feed()
+            capacity = self._admit()
+            uids, toks, drafts = self._build_feed(capacity)
         if not uids:
             self._flush_spans()
             self._update_gauges()
@@ -657,13 +685,16 @@ class ServingEngine:
             inj = get_fault_injector()
             if inj is not None:
                 inj.on_serving_tick(self._tick_count)
-            uids, logits = self._put_with_recovery(uids, toks)
+            uids, logits, verified = self._put_with_recovery(uids, toks,
+                                                             drafts)
         except Exception as e:   # InjectedFault crashes (BaseException) pass
             self._on_tick_fault(uids, e)
             self._flush_spans()
             return True
+        accepted = self._verify_drafts(verified)
         with self._lock:
-            handoffs, emissions, finished = self._dispatch(uids, logits)
+            handoffs, emissions, finished = self._dispatch(uids, logits,
+                                                           accepted)
         # user callbacks run OUTSIDE the serving lock (dslint
         # lock-discipline): caller code under our lock could re-enter
         # submit()/cancel() or stall every client of this replica.
@@ -779,7 +810,11 @@ class ServingEngine:
                 del self._live[uid]
                 self._retire(req, RequestState.CANCELLED)
 
-    def _admit(self) -> None:
+    def _admit(self) -> CapacityView:
+        """Policy-ordered admission pass (lock held). Returns the tick's
+        :class:`CapacityView` — the feed builder reuses it for the
+        speculative token-credit arithmetic, so admission and drafting
+        judge the same capacity."""
         now = self._clock.now()
         capacity = CapacityView(self._engine,
                                 reserve_output=self.config.reserve_output_blocks,
@@ -818,6 +853,7 @@ class ServingEngine:
                                   policy=self.policy.name,
                                   resume_tokens=len(req.tokens))
             self._count("admitted")
+        return capacity
 
     def _preempt(self, victim: Request) -> None:
         self._release_engine_state(victim.uid, publish=True)
@@ -833,42 +869,91 @@ class ServingEngine:
                     f"(priority {victim.priority}, "
                     f"{len(victim.tokens)} tokens in)")
 
-    def _build_feed(self) -> Tuple[List[int], List[List[int]]]:
+    def _build_feed(self, capacity: Optional[CapacityView] = None
+                    ) -> Tuple[List[int], List[List[int]], List[List[int]]]:
         """Assemble this tick's ``put()`` arguments: full resume context
         for freshly admitted requests, empty continuation chunks for
-        mid-prefill ones, one pending decode token each for the rest."""
+        mid-prefill ones, one pending decode token each for the rest.
+
+        With speculative serving on, eligible decodes additionally get a
+        draft chain — sized by the class acceptance credit
+        (``CapacityView.chain_len_for``) and spent strictly out of the
+        tick's token-budget SLACK (``CapacityView.draft_budget``): the
+        prefill backlog's claim comes off the top, so drafting can slow
+        only itself, never prompt progress or another decode's feed."""
         uids: List[int] = []
         toks: List[List[int]] = []
+        drafts: List[List[int]] = []
+        decode_rows: List[Tuple[int, Request]] = []
+        prefill_tokens = 0
         for uid, req in self._live.items():
             seq = self._engine.seqs.get(uid)
             if seq is None:
                 uids.append(uid)
                 toks.append(req.prompt + req.tokens)
+                drafts.append([])
+                prefill_tokens += len(req.prompt) + len(req.tokens)
             elif seq.pending > 0:
                 uids.append(uid)
                 toks.append([])
+                drafts.append([])
+                prefill_tokens += seq.pending
             elif req._pending_token is not None:
                 uids.append(uid)
                 toks.append([req._pending_token])
-        return uids, toks
+                drafts.append([])
+                decode_rows.append((len(uids) - 1, req))
+        if self._spec_on and capacity is not None and decode_rows:
+            slack = capacity.draft_budget(len(decode_rows), prefill_tokens)
+            cfg = self.config
+            for i, req in decode_rows:
+                if slack <= 0:
+                    break
+                if req._spec_disabled:
+                    continue
+                ema = self._spec_ema_by_class.get(req.priority, 1.0)
+                k = CapacityView.chain_len_for(ema, cfg.spec_lookahead)
+                seq = self._engine.seqs[req.uid]
+                k = min(k, slack,
+                        self._engine.config.max_context - seq.seen - 1,
+                        req.max_new_tokens - len(req.tokens) - 1)
+                if k <= 0:
+                    continue
+                guesses = self._engine.draft_tokens(
+                    req.uid, req._pending_token, cfg.spec_ngram, k)
+                if guesses:
+                    drafts[i] = guesses
+                    slack -= len(guesses)
+        return uids, toks, drafts
 
     # -- tick phases (lock NOT held) ------------------------------------
-    def _put_with_recovery(self, uids, toks):
+    def _put_with_recovery(self, uids, toks, drafts=None):
         """One engine tick; on KV-pool exhaustion, preempt the cheapest
         decode and retry. Tokens are admitted to the engine's descriptors
         before its pool check, so retries feed empty chunks — and an
         evicted victim must leave the feed entirely, or put() would mint
-        a fresh empty descriptor for it and leak its slot."""
+        a fresh empty descriptor for it and leak its slot.
+
+        With draft chains the first attempt runs the verify step
+        (``put_spec``); a PoolExhausted there strips every draft token
+        before raising, so the retry degrades to a PLAIN put of the
+        already-admitted feed — speculation is never worth an eviction."""
         uids, toks = list(uids), list(toks)
+        use_spec = drafts is not None and any(drafts)
+        drafts = list(drafts) if use_spec else None
         attempts = 0
         while True:
             try:
-                return uids, self._engine.put(uids, toks)
+                if use_spec:
+                    out, verified = self._engine.put_spec(uids, toks, drafts)
+                    return uids, out, verified
+                return uids, self._engine.put(uids, toks), {}
             except PoolExhausted:
                 # the typed catch matters: a generic device RuntimeError
                 # (e.g. XLA 'Resource exhausted' OOM) must take the
                 # tick-fault path once, not preempt healthy decodes and
                 # re-run the failing program live-count times
+                use_spec = False       # drafts were stripped on the raise
                 if attempts >= len(self._live):
                     raise
                 attempts += 1
@@ -944,7 +1029,102 @@ class ServingEngine:
                                    tick=self._tick_count)
                 tracer.flight.dump("tick-fault-exhausted")
 
-    def _dispatch(self, uids, logits: np.ndarray
+    def _verify_drafts(self, verified) -> Dict[int, List[int]]:
+        """Greedy accept/trim pass over the tick's verified draft chains
+        (driver thread, OUTSIDE the serving lock — the rejected-tail
+        trim may touch the device for a copy-on-write page). For each
+        chain the longest argmax-matching prefix is accepted — row 0 is
+        exactly the plain tick's logits, so the emitted stream is
+        TOKEN-IDENTICAL to non-speculative serving by induction — then
+        the engine rewinds to the validated context. Returns uid -> the
+        emitted tokens ``_dispatch`` applies under the lock; acceptance
+        feeds the per-request rolling EMA (fallback floor) and the
+        per-class credit EMA (chain sizing).
+
+        A trim that FAILS (its copy-on-write boundary page can allocate,
+        so PoolExhausted is reachable here) is contained per uid: that
+        request takes the tick-fault path — engine state discarded, this
+        round's accepted tokens withheld (they re-generate bit-equal on
+        the resume re-prefill), requeue under the retry budget — and
+        every other uid's acceptance proceeds. Letting it escape would
+        skip ``_on_tick_fault`` entirely and leave already-trimmed and
+        not-yet-trimmed streams silently diverged from their requests."""
+        if not verified:
+            return {}
+        with self._lock:
+            reqs = {uid: self._live.get(uid) for uid in verified}
+        eng = self._engine
+        cfg = self.config
+        accepted: Dict[int, List[int]] = {}
+        failed: Dict[int, Exception] = {}
+        tick_prop = tick_acc = 0
+        for uid, (chain, rows) in verified.items():
+            req = reqs.get(uid)
+            seq = eng.seqs.get(uid)
+            a = np.argmax(np.asarray(rows), axis=-1)
+            matched = 0
+            while (matched < len(chain) - 1
+                   and int(a[matched]) == chain[matched + 1]):
+                matched += 1
+            proposed = len(chain) - 1
+            tick_prop += proposed
+            tick_acc += matched
+            if req is None or seq is None:      # evicted mid-tick
+                continue
+            emitted = [int(x) for x in a[:matched + 1]]
+            emitted = emitted[:max(0, req.max_new_tokens - len(req.tokens))]
+            if req.eos_token_id is not None and req.eos_token_id in emitted:
+                emitted = emitted[:emitted.index(req.eos_token_id) + 1]
+            # rewind to the validated context: fed = chain, validated =
+            # the pending token + accepted (and emitted) proposals
+            keep = seq.seen - len(chain) + len(emitted)
+            try:
+                if keep < seq.seen:
+                    eng.trim(uid, keep)
+            except Exception as e:  # dslint: disable=exception-discipline -- every caught exception is handed to _on_tick_fault (the recovery path) via the deferred `failed` dict after the loop; InjectedFault is BaseException and still propagates
+                failed[uid] = e
+                continue
+            accepted[uid] = emitted
+            if proposed:
+                req.spec_proposed += proposed
+                req.spec_accepted += matched
+                rate = matched / proposed
+                alpha = cfg.spec_ema
+                req._spec_ema = (1 - alpha) * req._spec_ema + alpha * rate
+                cur = self._spec_ema_by_class.get(req.priority, 1.0)
+                self._spec_ema_by_class[req.priority] = \
+                    (1 - alpha) * cur + alpha * rate
+                request_event(req, "spec_verify", replica=self.replica_id,
+                              proposed=proposed, accepted=matched)
+                if (not req._spec_disabled
+                        and req.spec_proposed
+                        >= cfg.spec_floor_min_proposed
+                        and req._spec_ema < cfg.spec_accept_floor):
+                    # rolling acceptance under the floor: this request's
+                    # context is unpredictable — stop paying for drafts
+                    # (plain decode; the stream is identical either way)
+                    req._spec_disabled = True
+                    self._count("spec_fallbacks")
+                    request_event(req, "spec_fallback",
+                                  replica=self.replica_id,
+                                  ema=round(req._spec_ema, 4))
+        if tick_prop:
+            self._count("spec_proposed", tick_prop)
+            self._count("spec_accepted", tick_acc)
+            if hasattr(eng, "record_spec"):
+                eng.record_spec(proposed=tick_prop, accepted=tick_acc,
+                                rounds=1)
+        if failed:
+            # per-uid tick-fault recovery: discard the suspect engine
+            # state (the chain residue is still on the stream), requeue
+            # under the retry budget — resumed bit-exactly from the
+            # tokens delivered BEFORE this tick
+            self._on_tick_fault(list(failed),
+                                next(iter(failed.values())))
+        return accepted
+
+    def _dispatch(self, uids, logits: np.ndarray,
+                  accepted: Optional[Dict[int, List[int]]] = None
                   ) -> Tuple[List[Request], List[Tuple[Request, int]],
                              List[int]]:
         """Turn the tick's logits into emitted tokens, completions and
@@ -963,6 +1143,21 @@ class ServingEngine:
             req = self._live.get(uid)
             if req is None or np.isnan(row[0]):
                 continue                      # evicted mid-tick / prefilling
+            if accepted and uid in accepted:
+                # speculative chain: apply the whole accepted run (tokens
+                # delivered in order, before any terminal transition —
+                # the stream() drain contract holds per token)
+                emitted = accepted[uid]
+                for tok in emitted:
+                    req.tokens.append(tok)
+                    if req.on_token is not None:
+                        emissions.append((req, tok))
+                req._pending_token = emitted[-1]
+                if (len(req.tokens) >= req.max_new_tokens
+                        or (req.eos_token_id is not None
+                            and emitted[-1] == req.eos_token_id)):
+                    finished.append(uid)
+                continue
             tok = int(np.argmax(row))
             if req.state is RequestState.PREFILL:
                 req.transition(RequestState.DECODE)
@@ -1090,3 +1285,14 @@ class ServingEngine:
         r.gauge(f"{self._metric_prefix}/queue_depth").set(depth)
         r.gauge(f"{self._metric_prefix}/live_requests").set(live)
         r.gauge(f"{self._metric_prefix}/kv_occupancy").set(snap[2])
+        if self._spec_on and self._spec_ema_by_class:
+            # the serving-level acceptance credit (worst class is the
+            # honest headline — one cold class means drafts are being
+            # throttled somewhere)
+            r.gauge(f"{self._metric_prefix}/spec_credit").set(
+                min(self._spec_ema_by_class.values()))
+        if self._kv_quant != "none":
+            # pool headroom under quantized storage: the capacity win
+            # shows up as this gauge staying high at fixed byte budget
+            r.gauge(f"{self._metric_prefix}/kv_quant_headroom").set(
+                1.0 - snap[2])

@@ -151,6 +151,12 @@ REQUEST_RECORD_SCHEMA: Dict[str, tuple] = {
     "tokens_per_s": ((float, int), False),
     "preemptions": ((int,), True),
     "retries": ((int,), True),
+    # speculative-decoding ledger (serving tick): draft tokens proposed /
+    # accepted over the request's life. Optional — NOT a schema-version
+    # bump — same discipline as client_request_id: archived v1/v2
+    # streams predate speculative serving and must keep validating.
+    "spec_proposed": ((int,), False),
+    "spec_accepted": ((int,), False),
     "in_slo": ((bool,), False),
     "error": ((str,), False),
     # distributed-tracing join keys (telemetry/tracing.py): the request's
@@ -181,6 +187,9 @@ class RequestStats:
     tokens_per_s: Optional[float] = None
     preemptions: int = 0
     retries: int = 0
+    # speculative drafting ledger: None when the request never drafted
+    spec_proposed: Optional[int] = None
+    spec_accepted: Optional[int] = None
     in_slo: Optional[bool] = None      # None = request carried no SLO
     error: Optional[str] = None
     # tracing join keys: the request's trace and root span (tracer on)

@@ -85,6 +85,22 @@ if [ "$#" -eq 0 ]; then
         smoke_rc=$serve_rc
     fi
 
+    # speculative-serving + quantized-KV smoke (CPU evidence lane,
+    # docs/serving.md "Speculative scheduling" / "KV quantization"): on
+    # virtual time, the pinned workload served with speculation ON must
+    # emit TOKEN-IDENTICAL greedy streams in strictly fewer engine
+    # ticks than with it off (drafts proposed AND accepted); an int8 KV
+    # pool at the same byte budget must sustain >= 1.8x the concurrent
+    # decode sequences; the quantized export_kv hand-off must book a
+    # >= 1.8x wire reduction in the comm ledger and adopt bit-equal;
+    # zero leaked KV blocks on every leg
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/serve_spec_smoke.py
+    spec_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$spec_rc
+    fi
+
     # serving-fleet smoke (CPU evidence lane, docs/serving.md): in-SLA
     # goodput must scale EXACTLY 2x from 1 -> 2 replicas under the
     # seeded overload on virtual time (one full wave per replica, exact

@@ -26,7 +26,9 @@ CI evidence lane for the deterministic simulation harness
 
 Pure host-side python (the simulated engine never touches a device);
 the whole soak runs in a few seconds. Writes DST_<round>.json (round
-via DST_ROUND, default r07).
+via DST_ROUND, default r08 — r08 adds the speculative-serving and
+kv-quant config draws, the greedy token-identity invariant, and the
+paired spec-on/off identity gate).
 
     python scripts/dst_soak.py [--schedules N] [--seed-base B]
 """
@@ -43,7 +45,7 @@ HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
 sys.path.insert(0, os.path.join(HERE, "scripts"))
 
-os.environ.setdefault("DST_ROUND", "r07")
+os.environ.setdefault("DST_ROUND", "r08")
 
 #: every N-th seed is replayed for the determinism gate
 REPLAY_STRIDE = 20
@@ -60,18 +62,25 @@ def main() -> int:
         logging.disable(logging.WARNING)   # the faults ARE the workload
 
     from deepspeed_tpu.resilience.dst import (dump_repro, generate_schedule,
-                                              run_schedule, shrink_schedule)
+                                              run_schedule, shrink_schedule,
+                                              spec_identity_problems)
 
     t0 = time.monotonic()
     seeds = range(args.seed_base, args.seed_base + args.schedules)
     failures = []            # (seed, violations)
     hashes = {}
     kinds_seen = set()
+    spec_seeds = 0           # schedules drawn with speculative serving on
+    kv_quant_seeds = 0       # schedules drawn with a quantized KV mode
     totals = {"submitted": 0, "finished": 0, "cancelled": 0, "rejected": 0,
               "ticks": 0, "events": 0}
     for seed in seeds:
         sched = generate_schedule(seed)
         kinds_seen |= {e.kind for e in sched.events}
+        if sched.serving_cfg.get("speculative"):
+            spec_seeds += 1
+        if sched.engine_cfg.get("kv_quant", "none") != "none":
+            kv_quant_seeds += 1
         report = run_schedule(sched)
         # both determinism witnesses: the event trace AND the request
         # span tree (telemetry/tracing.py canonical hash)
@@ -94,6 +103,28 @@ def main() -> int:
         rep = run_schedule(generate_schedule(seed))
         if (rep.trace_hash, rep.span_hash) != hashes[seed]:
             mismatches.append(seed)
+
+    # spec-on/off token-identity gate (docs/serving.md "Speculative
+    # scheduling"): a sample of seeds runs with speculation FORCED on
+    # and forced off — per request the streams must agree on their
+    # common prefix, and requests finished in both runs must match
+    # exactly (spec moves WHEN timing-dependent events land, never
+    # WHICH tokens a context greedily yields)
+    spec_paired = 0
+    spec_identity_failures = []
+    for seed in range(args.seed_base, args.seed_base + args.schedules,
+                      REPLAY_STRIDE):
+        spec_paired += 1
+        s_on = generate_schedule(seed)
+        s_on.serving_cfg.update(speculative=True, spec_ngram=2,
+                                spec_lookahead=4)
+        s_off = generate_schedule(seed)
+        s_off.serving_cfg["speculative"] = False
+        problems = spec_identity_problems(run_schedule(s_on),
+                                          run_schedule(s_off))
+        if problems:
+            spec_identity_failures.append(seed)
+            print(f"[dst-soak] seed {seed}: spec identity: {problems[0]}")
     wall = time.monotonic() - t0
 
     # a generator regression that silently drops a fault kind narrows
@@ -105,6 +136,12 @@ def main() -> int:
         "zero_invariant_violations": not failures,
         "deterministic_replay": not mismatches,
         "all_fault_kinds_exercised": expected_kinds <= kinds_seen,
+        # generator-regression tripwires for the speculative + kv-quant
+        # config draws (a draw that silently stops firing narrows the
+        # soak's surface), plus the paired token-identity witness
+        "speculative_configs_exercised": spec_seeds > 0,
+        "kv_quant_configs_exercised": kv_quant_seeds > 0,
+        "spec_on_off_token_identity": not spec_identity_failures,
     }
     report = {
         "metric": "dst_invariant_violations_over_seeded_schedules",
@@ -113,6 +150,10 @@ def main() -> int:
         "replayed_for_determinism": replayed,
         "replay_mismatch_seeds": mismatches,
         "fault_kinds_exercised": sorted(kinds_seen),
+        "speculative_seeds": spec_seeds,
+        "kv_quant_seeds": kv_quant_seeds,
+        "spec_identity_pairs": spec_paired,
+        "spec_identity_failures": spec_identity_failures,
         "totals": totals,
         "failing_seeds": [s for s, _ in failures],
         "wall_s": round(wall, 2),

@@ -33,7 +33,11 @@ CI evidence lane for region-scale chaos tolerance (run by run_tests.sh):
   minimal reproduction and written to REGION_REPRO_<seed>.json.
 
 Pure host-side python; the whole soak runs in a few seconds. Writes
-REGION_<round>.json (round via DST_ROUND, default r01).
+REGION_<round>.json (round via DST_ROUND, default r02 — r02 adds the
+speculative-serving and kv-quant config draws to region schedules plus
+the greedy token-identity invariant, so cell outages, partitions and
+cross-cell adoptions are audited with drafts and quantized hand-offs
+in play).
 
     python scripts/region_soak.py [--schedules N] [--seed-base B]
 """
@@ -50,7 +54,7 @@ HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
 sys.path.insert(0, os.path.join(HERE, "scripts"))
 
-os.environ.setdefault("DST_ROUND", "r01")
+os.environ.setdefault("DST_ROUND", "r02")
 
 #: every N-th seed is replayed for the determinism gate
 REPLAY_STRIDE = 20
@@ -86,9 +90,15 @@ def main() -> int:
               "ticks": 0, "events": 0}
     brownout = {"runs": 0, "sheds": 0, "admits": 0}
     order_violations = []    # (seed, entry) — shed/admit out of priority order
+    spec_seeds = 0           # schedules drawn with speculative serving on
+    kv_quant_seeds = 0       # schedules drawn with a quantized KV mode
     for seed in seeds:
         sched = generate_region_schedule(seed)
         kinds_seen |= {e.kind for e in sched.events}
+        if sched.serving_cfg.get("speculative"):
+            spec_seeds += 1
+        if sched.engine_cfg.get("kv_quant", "none") != "none":
+            kv_quant_seeds += 1
         report = run_region_schedule(sched)
         hashes[seed] = (report.trace_hash, report.span_hash)
         for k in ("submitted", "finished", "cancelled", "rejected"):
@@ -130,6 +140,11 @@ def main() -> int:
         "all_fault_kinds_exercised": EXPECTED_KINDS <= kinds_seen,
         "brownout_exercised": brownout["sheds"] > 0,
         "brownout_priority_ordered": not order_violations,
+        # generator-regression tripwires (dst_soak discipline): the
+        # speculative + kv-quant draws silently stopping firing would
+        # narrow the region soak's surface without failing anything
+        "speculative_configs_exercised": spec_seeds > 0,
+        "kv_quant_configs_exercised": kv_quant_seeds > 0,
     }
     report = {
         "metric": "region_dst_invariant_violations_over_seeded_schedules",
@@ -138,6 +153,8 @@ def main() -> int:
         "replayed_for_determinism": replayed,
         "replay_mismatch_seeds": mismatches,
         "fault_kinds_exercised": sorted(kinds_seen),
+        "speculative_seeds": spec_seeds,
+        "kv_quant_seeds": kv_quant_seeds,
         "totals": totals,
         "brownout": brownout,
         "brownout_order_violations": [

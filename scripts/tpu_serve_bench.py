@@ -47,7 +47,9 @@ _SMOKE = os.environ.get("DST_SERVE_SMOKE") == "1"   # CPU logic check
 SLA_MS = 50.0 if not _SMOKE else 10000.0   # p95 per-token latency target
 PROMPT_POOL = (128, 512, 1200) if not _SMOKE else (16, 32)
 PROMPT_MIX = (0.5, 0.35, 0.15) if not _SMOKE else (0.5, 0.5)
-OUT_TOKENS = 64 if not _SMOKE else 4
+# smoke keeps 16 output tokens (not 4): the spec leg needs enough decode
+# rounds for prompt-lookup drafting to engage at all
+OUT_TOKENS = 64 if not _SMOKE else 16
 DURATION_S = 20.0 if not _SMOKE else 2.0   # per-rate measurement window
 RATES = (1.0, 2.0, 4.0, 8.0, 12.0) if not _SMOKE else (2.0,)
 
@@ -57,6 +59,16 @@ RATES = (1.0, 2.0, 4.0, 8.0, 12.0) if not _SMOKE else (2.0,)
 _SYS_LEN = int(os.environ.get("DST_SERVE_SYS_PROMPT", "0"))
 SYS_TOKENS = (np.random.default_rng(7)
               .integers(1, 32000, (_SYS_LEN,)).tolist() if _SYS_LEN else [])
+
+# speculative leg: prompt-lookup drafting inside the serving tick
+# (docs/serving.md "Speculative scheduling"); greedy output is
+# token-identical, the win is fewer engine ticks per request — the
+# virtual-time tick gate lives in scripts/serve_spec_smoke.py, this leg
+# measures the wall-clock side
+_SPEC = os.environ.get("DST_SERVE_SPEC") == "1"
+# quantized-KV leg: pool pages stored int8/int4 AT THE SAME BYTE BUDGET
+# as the fp leg (more pages, more concurrent sequences per pool)
+_KV_QUANT = os.environ.get("DST_SERVE_KV_QUANT", "none")
 
 
 def _make_prompt(rng: np.random.Generator, plen: int) -> list:
@@ -84,6 +96,16 @@ def _build_engine():
         cfg = RaggedConfig(token_budget=2048, max_seqs=64, kv_block_size=16,
                            n_kv_blocks=6144, max_context=2048,
                            enable_prefix_cache=_SYS_LEN > 0)
+    if _KV_QUANT != "none":
+        # SAME byte budget as the fp leg, quantized storage: the page
+        # count (and with it concurrent-sequence capacity) roughly
+        # doubles at int8 vs bf16 (docs/serving.md "KV quantization")
+        from deepspeed_tpu.inference.ragged import (kv_blocks_for_bytes,
+                                                    kv_page_bytes)
+
+        budget = cfg.n_kv_blocks * kv_page_bytes(model.config, cfg)
+        cfg.kv_quant = _KV_QUANT
+        cfg.n_kv_blocks = kv_blocks_for_bytes(budget, model.config, cfg)
     return RaggedInferenceEngine(model, cfg, rng=jax.random.PRNGKey(0)), model
 
 
@@ -111,10 +133,14 @@ def _run_rate_serving(eng, rate: float, rng: np.random.Generator):
     from deepspeed_tpu.serving import ServingEngine
 
     arrivals = _draw_arrivals(rate, rng)
+    spec0 = dict(eng.spec_stats)         # per-rate delta (engine is shared)
     srv = ServingEngine(eng, {"policy": "fcfs",
                               "max_queue": len(arrivals) + 8,
                               "drain_timeout_s": 60.0,
-                              "poll_interval_s": 0.001})
+                              "poll_interval_s": 0.001,
+                              "speculative": _SPEC,
+                              "spec_ngram": 2,
+                              "kv_quant": _KV_QUANT})
     reqs = []
     t0 = time.perf_counter()
     for t_arr, _uid, plen in arrivals:
@@ -143,10 +169,14 @@ def _run_rate_serving(eng, rate: float, rng: np.random.Generator):
     for stamps, _ in reqs:
         token_lat.extend((b - a) * 1e3 for a, b in zip(stamps, stamps[1:]))
     lat = np.asarray(token_lat) if token_lat else np.asarray([float("inf")])
+    spec = ({k: eng.spec_stats[k] - spec0[k] for k in spec0}
+            if _SPEC else None)
     return {
         "offered_qps": rate,
         "completed": done,
         "undrained": undrained,
+        "engine_ticks": srv._tick_count,
+        **({"spec": spec} if spec else {}),
         "achieved_qps": round(done / wall, 2),
         "gen_tokens_per_s": round(gen_tokens / wall, 1),
         "p50_token_ms": round(float(np.percentile(lat, 50)), 2),
@@ -288,6 +318,8 @@ def _run_child():
               else "serving")
     mode = ("direct" if driver == "direct"
             else "pallas_prefix_cache" if _SYS_LEN
+            else "spec" if _SPEC
+            else f"kv_quant_{_KV_QUANT}" if _KV_QUANT != "none"
             else "gather" if os.environ.get("DST_RAGGED_FORCE_GATHER") == "1"
             else "pallas")
     row = {
@@ -296,7 +328,16 @@ def _run_child():
         "device": jax.devices()[0].device_kind,
         "sla_ms": SLA_MS, "out_tokens": OUT_TOKENS,
         "prompt_pool": PROMPT_POOL, "params": model.config.param_count(),
+        "pool_pages": eng.config.n_kv_blocks,
         "qps_at_sla": best, "curve": rows}
+    if _SPEC:
+        s = eng.spec_stats
+        row["spec_stats"] = dict(s)
+        row["spec_acceptance"] = (round(s["accepted"] / s["proposed"], 3)
+                                  if s["proposed"] else None)
+    if _KV_QUANT != "none":
+        row["kv_quant"] = {"mode": _KV_QUANT,
+                           "pool_pages": eng.config.n_kv_blocks}
     if eng.prefix_cache is not None:
         row["prefix_cache"] = {"sys_prompt_len": _SYS_LEN,
                                "hits": eng.prefix_cache.hits,
@@ -311,6 +352,13 @@ def main():
         return 0
     report = {"metric": "serve_qps_at_p95_token_sla", "unit": "req/s",
               "sla_ms": SLA_MS}
+    if _SMOKE:
+        # CPU smoke legs are LOGIC checks (tiny model, host-dominated
+        # wall clock): qps ratios between legs are noise, not verdicts —
+        # the gated spec/kv-quant evidence is scripts/serve_spec_smoke.py
+        # on virtual time, and the TPU run of this bench is the
+        # wall-clock side
+        report["smoke"] = True
     # measured legs drive the SHIPPED ServingEngine path; the "direct"
     # leg replays the pallas workload through the pre-PR5 hand-rolled
     # loop as the A/B control on the front-end's own overhead.
@@ -322,16 +370,40 @@ def main():
     for mode, env_extra in (
             ("pallas", {"DST_RAGGED_FORCE_GATHER": "0",
                         "DST_SERVE_SYS_PROMPT": "0",
+                        "DST_SERVE_SPEC": "0",
+                        "DST_SERVE_KV_QUANT": "none",
                         "DST_SERVE_DRIVER": "serving"}),
             ("direct", {"DST_RAGGED_FORCE_GATHER": "0",
                         "DST_SERVE_SYS_PROMPT": "0",
+                        "DST_SERVE_SPEC": "0",
+                        "DST_SERVE_KV_QUANT": "none",
                         "DST_SERVE_DRIVER": "direct"}),
             ("gather", {"DST_RAGGED_FORCE_GATHER": "1",
                         "DST_SERVE_SYS_PROMPT": "0",
+                        "DST_SERVE_SPEC": "0",
+                        "DST_SERVE_KV_QUANT": "none",
                         "DST_SERVE_DRIVER": "serving"}),
             ("pallas_prefix_cache", {"DST_RAGGED_FORCE_GATHER": "0",
                                      "DST_SERVE_SYS_PROMPT": "256",
-                                     "DST_SERVE_DRIVER": "serving"})):
+                                     "DST_SERVE_SPEC": "0",
+                                     "DST_SERVE_KV_QUANT": "none",
+                                     "DST_SERVE_DRIVER": "serving"}),
+            # speculative decoding inside the serving tick: greedy
+            # token-identical, fewer ticks per request (the virtual-time
+            # tick gate is scripts/serve_spec_smoke.py; this is the
+            # wall-clock side of the same A/B vs the pallas leg)
+            ("spec", {"DST_RAGGED_FORCE_GATHER": "0",
+                      "DST_SERVE_SYS_PROMPT": "0",
+                      "DST_SERVE_DRIVER": "serving",
+                      "DST_SERVE_SPEC": "1",
+                      "DST_SERVE_KV_QUANT": "none"}),
+            # int8 KV pool at the SAME byte budget: ~2x the pages, so
+            # ~2x the concurrent decodes before PoolExhausted pressure
+            ("kv_quant_int8", {"DST_RAGGED_FORCE_GATHER": "0",
+                               "DST_SERVE_SYS_PROMPT": "0",
+                               "DST_SERVE_DRIVER": "serving",
+                               "DST_SERVE_SPEC": "0",
+                               "DST_SERVE_KV_QUANT": "int8"})):
         env = dict(os.environ, **env_extra)
         env[_CHILD] = "1"
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
@@ -359,6 +431,19 @@ def main():
             # shipped ServingEngine path vs the hand-rolled control loop:
             # ~1.0 means the front-end adds no measurable overhead
             report["serving_vs_direct"] = round(report["value"] / d, 2)
+        sp = (report.get("spec") or {}).get("qps_at_sla") or 0
+        if sp and report["value"]:
+            # speculative vs plain serving at the SLA knee (the tick-
+            # count win is gated on virtual time in serve_spec_smoke)
+            report["spec_vs_pallas"] = round(sp / report["value"], 2)
+        kvq = report.get("kv_quant_int8") or {}
+        fp_pages = (report.get("pallas") or {}).get("pool_pages") or 0
+        if kvq.get("kv_quant") and fp_pages:
+            # concurrent-capacity headline: pages at the same byte
+            # budget, read off the fp leg's own reported pool (never a
+            # duplicated literal that can drift from _build_engine)
+            report["kv_quant_pool_pages_vs_fp"] = round(
+                kvq["kv_quant"]["pool_pages"] / fp_pages, 2)
     sys.path.insert(0, os.path.join(HERE, "scripts"))
     from _artifact import write_artifact
 

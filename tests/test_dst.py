@@ -17,7 +17,8 @@ from deepspeed_tpu.resilience.clock import SimClock, WallClock, use_clock
 from deepspeed_tpu.resilience.dst import (Schedule, SimConfig, SimEngine,
                                           SimEvent, generate_schedule,
                                           dump_repro, load_repro,
-                                          run_schedule, shrink_schedule)
+                                          run_schedule, shrink_schedule,
+                                          spec_identity_problems)
 
 
 # ----------------------------------------------------------------------
@@ -252,6 +253,13 @@ REGRESSION_SEEDS = [
     4,    # autoscale controller live
     10,   # FCFS head-of-line under the same fault surface
     14,   # replica death in a disaggregated fleet (handoff failover)
+    # speculative-serving + quantized-KV draws (ISSUE 14): the token-
+    # identity invariant (#10) audits every one of these against the
+    # pure-function greedy expectation on every event
+    23,   # spec drafts + int8 pool + replica death + tick faults
+    38,   # spec drafts + int4 pool + latch + scale + tick faults
+    43,   # int8 pool in a disaggregated fleet (quantized hand-off wire)
+    55,   # spec drafts + int4 pool + disaggregated hand-off
 ]
 
 
@@ -273,6 +281,55 @@ def test_mini_soak_window():
     for seed in range(100, 120):
         report = run_schedule(generate_schedule(seed))
         assert report.ok, (seed, report.violations)
+
+
+@pytest.mark.parametrize("seed", [4, 23, 38])
+def test_spec_on_off_token_identity(seed):
+    """The spec-decode identity gate on regression seeds that draw
+    drafting: the same schedule run with speculation FORCED on and
+    forced off must emit per-request streams agreeing on their common
+    prefix, exactly for requests finished in both runs (docs/serving.md
+    token-identity contract; the soak samples this every CI run)."""
+    s_on = generate_schedule(seed)
+    s_on.serving_cfg.update(speculative=True, spec_ngram=2,
+                            spec_lookahead=4)
+    s_off = generate_schedule(seed)
+    s_off.serving_cfg["speculative"] = False
+    rep_on, rep_off = run_schedule(s_on), run_schedule(s_off)
+    assert rep_on.ok, rep_on.violations
+    assert rep_off.ok, rep_off.violations
+    assert spec_identity_problems(rep_on, rep_off) == []
+
+
+def test_auditor_catches_token_identity_violation():
+    """Teeth for invariant #10: an engine whose verify rows diverge from
+    the pure-function greedy stream (an off-by-one context bug planted
+    in put_spec's row builder) must trip the token-identity audit."""
+    from deepspeed_tpu.resilience.dst import _next_token
+
+    class _DivergentSpecEngine(SimEngine):
+        def put_spec(self, uids, tokens, drafts):
+            out, verified = super().put_spec(uids, tokens, drafts)
+            bad = {}
+            for uid, (chain, rows) in verified.items():
+                rows = rows.copy()
+                for j in range(rows.shape[0]):
+                    t = int(rows[j].argmax())
+                    rows[j, t] = 0.0
+                    rows[j, (t + 1) % rows.shape[1]] = 1.0   # wrong token
+                bad[uid] = (chain, rows)
+            return out, bad
+
+    sched = generate_schedule(4)              # draws speculative serving
+    sched.serving_cfg.update(speculative=True, spec_ngram=2,
+                             spec_lookahead=4, spec_accept_floor=0.0)
+    report = run_schedule(
+        sched,
+        engine_factory=lambda: _DivergentSpecEngine(
+            SimConfig(**sched.engine_cfg)))
+    assert not report.ok
+    assert any("token-identity" in v for v in report.violations), \
+        report.violations
 
 
 # ----------------------------------------------------------------------

@@ -151,3 +151,72 @@ def test_paged_kernel_windowed_interpret():
         want = paged_attention_reference(q, kpool, vpool, tbl, pos, window=w)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# quantized pools (kv_quant): dequantize inside the read path
+# ----------------------------------------------------------------------
+
+def _quantize_pools(kp, vp, bits):
+    from deepspeed_tpu.ops.quantizer import quantize_kv
+
+    qk, sk = quantize_kv(kp, bits)
+    qv, sv = quantize_kv(vp, bits)
+    return qk, qv, sk, sv
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_paged_kernel_quantized_matches_reference(bits):
+    """Quantized-pool kernel (interpret mode) vs the quantized gather
+    reference: identical dequant arithmetic, so they agree to fp
+    tolerance."""
+    rng = np.random.default_rng(11)
+    T, hq, hkv, hd, block, mp = 8, 8, 4, 64, 4, 4
+    n_pages = T * mp
+    q, kp, vp, tables, positions = _random_paged(
+        rng, T, hq, hkv, hd, n_pages, block, mp, jnp.float32)
+    qk, qv, sk, sv = _quantize_pools(kp, vp, bits)
+    ref = paged_attention_reference(q, qk, qv, tables, positions,
+                                    k_scale=sk, v_scale=sv, kv_bits=bits)
+    got = paged_attention(q, qk, qv, tables, positions,
+                          k_scale=sk, v_scale=sv, kv_bits=bits,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_quantized_close_to_fp():
+    """int8-quantized attention tracks the fp pool within the
+    accumulated scale/2 rounding (sanity on the end-to-end error, not a
+    bit-exactness claim)."""
+    rng = np.random.default_rng(12)
+    T, hq, hkv, hd, block, mp = 4, 8, 4, 64, 8, 4
+    n_pages = T * mp
+    q, kp, vp, tables, positions = _random_paged(
+        rng, T, hq, hkv, hd, n_pages, block, mp, jnp.float32)
+    qk, qv, sk, sv = _quantize_pools(kp, vp, 8)
+    fp = paged_attention_reference(q, kp, vp, tables, positions)
+    quant = paged_attention_reference(q, qk, qv, tables, positions,
+                                      k_scale=sk, v_scale=sv, kv_bits=8)
+    np.testing.assert_allclose(np.asarray(quant), np.asarray(fp),
+                               rtol=0.15, atol=0.05)
+
+
+def test_paged_quantized_int4_packed_shape():
+    """int4 payloads are REALLY nibble-packed: the pool leaf carries
+    hd//2 uint8 channels, and the kernel unpacks them to the fp result
+    the unpacked reference computes."""
+    rng = np.random.default_rng(13)
+    T, hq, hkv, hd, block, mp = 4, 4, 2, 64, 4, 4
+    n_pages = T * mp
+    q, kp, vp, tables, positions = _random_paged(
+        rng, T, hq, hkv, hd, n_pages, block, mp, jnp.float32)
+    qk, qv, sk, sv = _quantize_pools(kp, vp, 4)
+    assert qk.shape[-1] == hd // 2 and qk.dtype == jnp.uint8
+    got = paged_attention(q, qk, qv, tables, positions,
+                          k_scale=sk, v_scale=sv, kv_bits=4,
+                          interpret=True)
+    ref = paged_attention_reference(q, qk, qv, tables, positions,
+                                    k_scale=sk, v_scale=sv, kv_bits=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

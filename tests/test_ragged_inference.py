@@ -753,11 +753,15 @@ def test_speculative_acceptance_machinery(monkeypatch):
         {1: list(P)}, max_new_tokens=12)[1]
     full = P + want
 
-    def oracle(ctx, ngram, k):
+    def oracle(self, uid, next_token, ngram, k):
+        ctx = self.seqs[uid].tokens + [next_token]
         assert list(ctx) == full[:len(ctx)]        # stream stays validated
         return full[len(ctx): len(ctx) + k]
 
-    monkeypatch.setattr(ragged_mod, "_prompt_lookup", oracle)
+    # the draft seam is the memoized draft_tokens (NgramIndex) now —
+    # override it with the oracle at the same boundary
+    monkeypatch.setattr(ragged_mod.RaggedInferenceEngine, "draft_tokens",
+                        oracle)
     eng = RaggedInferenceEngine(model, _cfg(), params=params)
     got = eng.generate_speculative({1: list(P)}, max_new_tokens=12,
                                    lookahead=4)[1]
