@@ -2,13 +2,18 @@
 
 Machine-checks the invariants the perf and serving layers are built on
 (docs/static_analysis.md): no host syncs or host state inside traced
-code, no per-call jit construction, lock order fleet -> replica with no
-blocking work or user callbacks under a held lock, and no broad
-``except`` swallowing the typed fault semantics. Pure stdlib ``ast`` —
-nothing in this package imports jax or executes analyzed code.
+code, no per-call jit construction, lock order region -> cell ->
+fleet -> replica with no blocking work or user callbacks under a held
+lock, no broad ``except`` swallowing the typed fault semantics, and —
+dsrace — no shared attribute reachable from two thread roles without a
+common lock (Eraser-style lockset analysis over the discovered thread
+model, cross-validated at runtime by resilience/locksan.py). Pure
+stdlib ``ast`` — nothing in this package imports jax or executes
+analyzed code.
 
 CLI: ``python -m deepspeed_tpu.analysis --check --baseline
-dslint_baseline.json`` (the run_tests.sh gate).
+dslint_baseline.json`` (the run_tests.sh gate; ``--changed`` is the
+git-diff-scoped pre-commit fast mode).
 """
 
 from .cli import analyze, main  # noqa: F401

@@ -20,8 +20,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 from .findings import (Baseline, Finding, apply_suppressions,
                        parse_suppressions)
@@ -31,9 +32,13 @@ from .registry import all_rules, known_rule_ids
 
 def analyze(paths: Sequence[str], base: Optional[str] = None,
             select: Optional[Sequence[str]] = None,
-            ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+            ignore: Optional[Sequence[str]] = None,
+            report_unused: bool = True) -> List[Finding]:
     """Run every (selected) rule over ``paths``; returns findings with
-    suppression flags applied (suppressed ones are kept, marked)."""
+    suppression flags applied (suppressed ones are kept, marked).
+    ``report_unused=False`` drops the suppression meta-rule's UNUSED
+    findings only — whether a suppression matches is a whole-package
+    property, so ``--changed``'s scoped model cannot judge it."""
     pkg = build_package_model(paths, base=base)
     known = set(known_rule_ids())
     rules = all_rules()
@@ -52,7 +57,7 @@ def analyze(paths: Sequence[str], base: Optional[str] = None,
         if meta_on:
             findings.extend(problems)
     unused = apply_suppressions(findings, sups)
-    if meta_on:
+    if meta_on and report_unused:
         findings.extend(unused)
     for f in findings:
         mod = pkg.modules.get(f.path)
@@ -67,6 +72,56 @@ def _default_paths() -> List[str]:
     return [pkg_dir]
 
 
+def changed_py_files(cwd: Optional[str] = None
+                     ) -> Optional[Tuple[str, List[str]]]:
+    """``(repo_toplevel, abs_paths)`` of ``.py`` files changed vs HEAD
+    (staged, unstaged and untracked), for ``--changed``. git reports
+    paths relative to the REPO ROOT, so they are resolved against
+    ``git rev-parse --show-toplevel`` — never the cwd, which may be a
+    subdirectory (joining there silently dropped every changed file
+    outside it and green-lit the gate). None when git is unavailable /
+    not a repo."""
+    cwd = cwd or os.getcwd()
+
+    def git(args: List[str]) -> Optional[List[str]]:
+        try:
+            out = subprocess.run(["git"] + args, cwd=cwd,
+                                 capture_output=True, text=True,
+                                 timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if out.returncode != 0:
+            return None
+        return [line.strip() for line in out.stdout.splitlines()
+                if line.strip()]
+
+    top = git(["rev-parse", "--show-toplevel"])
+    if not top:
+        return None
+    root = top[0]
+    files: List[str] = []
+    for args in (["diff", "--name-only", "HEAD", "--"],
+                 ["ls-files", "--others", "--exclude-standard",
+                  "--full-name"]):
+        got = git(args)
+        if got is None:
+            return None
+        files.extend(got)
+    seen: List[str] = []
+    for f in files:
+        path = os.path.join(root, f)
+        if not f.endswith(".py") or not os.path.exists(path) \
+                or path in seen:
+            continue
+        if "/fixtures/" in f.replace(os.sep, "/"):
+            # rule fixtures contain PLANTED violations by design — the
+            # pre-commit fast mode must not fail on editing one (the
+            # golden tests are their gate)
+            continue
+        seen.append(path)
+    return root, sorted(seen)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.analysis",
@@ -78,6 +133,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "deepspeed_tpu package)")
     ap.add_argument("--check", action="store_true",
                     help="exit 1 on unsuppressed, un-baselined findings")
+    ap.add_argument("--changed", action="store_true",
+                    help="fast pre-commit mode: analyze only .py files "
+                         "changed vs HEAD (staged/unstaged/untracked). "
+                         "Cross-module context (weak resolution, the "
+                         "package lock graph, thread roles) is limited "
+                         "to the changed set — the full gate remains "
+                         "authoritative")
     ap.add_argument("--baseline", default=None,
                     help="baseline JSON of grandfathered findings")
     ap.add_argument("--update-baseline", action="store_true",
@@ -101,18 +163,44 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"dslint suppression comments (meta-rule)")
         return 0
 
-    paths = args.paths or _default_paths()
+    repo_root = None
+    if args.changed:
+        got = changed_py_files()
+        if got is None:
+            print("dslint: --changed needs a git checkout",
+                  file=sys.stderr)
+            return 2
+        repo_root, changed = got
+        if args.paths:
+            # an explicit path list scopes the changed set further
+            roots = [os.path.abspath(p) for p in args.paths]
+            changed = [f for f in changed
+                       if any(f == r or f.startswith(r + os.sep)
+                              for r in roots)]
+        if not changed:
+            print("dslint: no changed python files; gate: PASS")
+            return 0
+        paths: List[str] = changed
+    else:
+        paths = list(args.paths) or _default_paths()
     for p in paths:
         if not os.path.exists(p):
             print(f"dslint: no such path: {p}", file=sys.stderr)
             return 2
-    cwd = os.getcwd()
-    base = cwd if all(os.path.abspath(p).startswith(cwd + os.sep)
-                      or os.path.abspath(p) == cwd for p in paths) \
-        else None
+    if repo_root is not None:
+        # repo-root-relative display keys keep path-scoped rules and
+        # baseline/suppression fingerprints identical to the full gate
+        # no matter which subdirectory --changed runs from
+        base = repo_root
+    else:
+        cwd = os.getcwd()
+        base = cwd if all(os.path.abspath(p).startswith(cwd + os.sep)
+                          or os.path.abspath(p) == cwd for p in paths) \
+            else None
     select = args.select.split(",") if args.select else None
     ignore = args.ignore.split(",") if args.ignore else None
-    findings = analyze(paths, base=base, select=select, ignore=ignore)
+    findings = analyze(paths, base=base, select=select, ignore=ignore,
+                       report_unused=not args.changed)
 
     stale = 0
     if args.baseline and not args.update_baseline:

@@ -74,7 +74,12 @@ _LOCK_CONSTRUCTORS = {"Lock", "RLock", "Condition", "Semaphore",
 # Method names that collide with builtin container/file/thread APIs
 # (``dict.get``, ``arr.at[i].set``, ``q.put``, ``f.write``, ...): a bare
 # name match against a package class method would hijack nearly every
-# call site, so these never resolve weakly.
+# call site, so these never resolve weakly. The second group are names
+# the serving/region layers made common since PR 7 (``clock.pump`` vs
+# ``fleet.step``, router/ring ``route``, cell-digest ``publish``, ...):
+# several are no longer unique, but blocklisting keeps a future
+# refactor from silently re-uniquifying one and hijacking its call
+# sites (the PR-15 model spot-check pins this).
 _WEAK_RESOLVE_BLOCKLIST = {
     "get", "set", "put", "pop", "update", "items", "keys", "values",
     "append", "extend", "remove", "discard", "clear", "copy", "close",
@@ -82,7 +87,21 @@ _WEAK_RESOLVE_BLOCKLIST = {
     "next", "count", "index", "sort", "reverse", "split", "strip",
     "add", "insert", "setdefault", "start", "stop", "run", "result",
     "acquire", "release", "reshape", "astype", "item", "mean", "sum",
+    "step", "route", "adopt", "evacuate", "publish",
 }
+
+# Attribute constructor types whose internal state is thread-safe by
+# contract (queue.Queue hand-off, GIL-atomic deque append/popleft):
+# the races rule treats accesses to these attributes as synchronized.
+_SAFE_CONTAINER_CTORS = {"Queue", "LifoQueue", "PriorityQueue",
+                         "SimpleQueue", "deque"}
+
+#: annotation heads whose subscript carries the element/value type
+#: (``Dict[str, Replica]`` -> ``Replica``; the VALUE side for mappings)
+_CONTAINER_ANNOTATIONS = {"Dict", "dict", "List", "list", "Set", "set",
+                          "Sequence", "Deque", "Mapping", "OrderedDict",
+                          "DefaultDict", "defaultdict", "FrozenSet",
+                          "Iterable", "Tuple", "tuple"}
 
 
 def final_attr_name(node: ast.AST) -> Optional[str]:
@@ -106,14 +125,46 @@ def dotted_name(node: ast.AST) -> Optional[str]:
     return None
 
 
+def annotation_types(node: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    """(direct type name, container element/value type name) read off an
+    annotation expression. ``Optional[T]`` unwraps to ``T``;
+    ``Dict[K, V]`` yields the VALUE side; anything else best-effort."""
+    if isinstance(node, ast.Subscript):
+        head = final_attr_name(node.value)
+        sl = node.slice
+        if isinstance(sl, ast.Index):          # pragma: no cover (py<3.9)
+            sl = sl.value
+        if head == "Optional":
+            return annotation_types(sl)
+        if head in _CONTAINER_ANNOTATIONS:
+            if isinstance(sl, ast.Tuple) and sl.elts:
+                return None, final_attr_name(sl.elts[-1])
+            return None, final_attr_name(sl)
+        return None, None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        # string annotation: take the bare head ("ServingCell")
+        name = node.value.strip().split("[")[0].split(".")[-1]
+        return (name or None), None
+    return final_attr_name(node), None
+
+
 @dataclass
 class CallSite:
-    node: ast.Call
+    #: the ``ast.Call`` node — or, for ``is_property`` sites, the
+    #: ``ast.Attribute`` load that invokes a ``@property`` getter
+    node: ast.AST
     #: dotted text of the callee expression (``self._engine.put``) or None
     text: Optional[str]
     #: resolved FunctionInfo keys
     targets: List[str] = field(default_factory=list)
     weak: bool = False
+    #: an attribute read resolved to a @property getter: it IS a call
+    #: (the lock-discipline/races transitive walks follow it — a fleet
+    #: gauge pass reading ``r.serving.queue_depth`` under the fleet lock
+    #: acquires the replica lock through exactly this edge), but it is
+    #: excluded from traced-set propagation (that set was tuned on
+    #: explicit calls; property edges would need their own triage)
+    is_property: bool = False
 
 
 @dataclass
@@ -139,6 +190,30 @@ class FunctionInfo:
     #: "via <caller key>" chain element added during propagation)
     traced_reason: Optional[str] = None
     decorator_names: Set[str] = field(default_factory=set)
+    #: thread roles that may execute this function ("main" = any caller
+    #: thread; other roles are named after discovered thread entry
+    #: points — see ThreadEntry / _propagate_roles). Empty = unreached.
+    thread_roles: Set[str] = field(default_factory=set)
+
+    @property
+    def is_property_getter(self) -> bool:
+        return bool(self.decorator_names
+                    & {"property", "cached_property"})
+
+
+@dataclass
+class ThreadEntry:
+    """One discovered thread entry point: the target of a
+    ``threading.Thread(target=...)``, a ``weakref.finalize`` callback,
+    or a ``threading.Timer`` body. ``role`` is the thread's declared
+    ``name=`` when it is a string constant (``"serving-driver"``),
+    else a name derived from the target."""
+
+    role: str
+    func_key: str
+    kind: str            # "thread" | "finalizer" | "timer"
+    module: str
+    lineno: int
 
 
 @dataclass
@@ -151,6 +226,11 @@ class ClassInfo:
     #: attr name -> class name (unresolved text) from annotations or
     #: ``self.x = ClassName(...)`` / ``self.x = param`` with an annotation
     attr_types: Dict[str, str] = field(default_factory=dict)
+    #: attr name -> ELEMENT/VALUE class name for annotated containers
+    #: (``self._replicas: Dict[str, Replica]`` -> ``Replica``), so
+    #: ``self._replicas.get(k)`` / ``for r in self._replicas.values()``
+    #: type their results
+    attr_elem_types: Dict[str, str] = field(default_factory=dict)
     #: attr name -> constructor name for threading primitives
     lock_attrs: Dict[str, str] = field(default_factory=dict)
     #: attr name -> "Event" for threading.Event attributes (wall-clock
@@ -194,6 +274,8 @@ class PackageModel:
         self.class_index: Dict[str, Set[str]] = {}
         # module-level function bare name -> keys (diagnostics only)
         self.function_index: Dict[str, Set[str]] = {}
+        # discovered thread entry points (the thread model's roots)
+        self.thread_entries: List[ThreadEntry] = []
 
     # -- queries --------------------------------------------------------
     def functions_in(self, module_key: str) -> Iterator[FunctionInfo]:
@@ -354,9 +436,11 @@ class _Collector(ast.NodeVisitor):
         for stmt in node.body:
             if isinstance(stmt, ast.AnnAssign) and isinstance(
                     stmt.target, ast.Name):
-                t = final_attr_name(stmt.annotation)
+                t, elem = annotation_types(stmt.annotation)
                 if t:
                     cls.attr_types[stmt.target.id] = t
+                if elem:
+                    cls.attr_elem_types[stmt.target.id] = elem
         self.class_stack.append(cls)
         saved, self.func_stack = self.func_stack, []
         self.generic_visit(node)
@@ -377,8 +461,17 @@ class _Collector(ast.NodeVisitor):
             ctor = final_attr_name(value.func)
             if ctor in _LOCK_CONSTRUCTORS and self._is_threading(value.func):
                 cls.lock_attrs[attr] = ctor
+            elif ctor in ("named_lock", "named_rlock") \
+                    and self._is_locksan(value.func):
+                # the runtime lock-order sanitizer's construction seam
+                # (resilience/locksan.py): statically these ARE the
+                # serving locks — the lock model must keep seeing them
+                cls.lock_attrs[attr] = ("RLock" if ctor == "named_rlock"
+                                        else "Lock")
             elif ctor == "Event" and self._is_threading(value.func):
                 cls.event_attrs[attr] = ctor
+            elif ctor in _SAFE_CONTAINER_CTORS:
+                cls.attr_types.setdefault(attr, ctor)
             elif ctor and ctor[:1].isupper():
                 cls.attr_types.setdefault(attr, ctor)
         elif isinstance(value, ast.Name) and self.func_stack:
@@ -404,6 +497,20 @@ class _Collector(ast.NodeVisitor):
             return bool(imp and imp[0].lstrip(".") == "threading")
         return False
 
+    def _is_locksan(self, func_expr: ast.AST) -> bool:
+        """Constructed via resilience/locksan.py's named_lock/named_rlock
+        (any import flavor)."""
+        if isinstance(func_expr, ast.Attribute) and isinstance(
+                func_expr.value, ast.Name):
+            real = self.mod.alias_to_module.get(func_expr.value.id,
+                                                func_expr.value.id)
+            return real.split(".")[-1] == "locksan"
+        if isinstance(func_expr, ast.Name):
+            imp = self.mod.name_imports.get(func_expr.id)
+            return bool(imp and imp[0].lstrip(".").split(".")[-1]
+                        == "locksan")
+        return False
+
     def visit_Assign(self, node: ast.Assign) -> None:
         for t in node.targets:
             self._record_self_assign(t, node.value)
@@ -419,6 +526,18 @@ class _Collector(ast.NodeVisitor):
     def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
         if node.value is not None:
             self._record_self_assign(node.target, node.value)
+        # ``self._replicas: Dict[str, Replica] = {}`` — the annotation
+        # types the attribute (and its container elements) even when the
+        # assigned value is an empty literal
+        if (isinstance(node.target, ast.Attribute)
+                and isinstance(node.target.value, ast.Name)
+                and node.target.value.id == "self" and self.class_stack):
+            cls = self.class_stack[-1]
+            t, elem = annotation_types(node.annotation)
+            if t:
+                cls.attr_types.setdefault(node.target.attr, t)
+            if elem:
+                cls.attr_elem_types.setdefault(node.target.attr, elem)
         self.generic_visit(node)
 
 
@@ -501,7 +620,9 @@ class _Resolver:
 
     def resolve(self, call: ast.Call,
                 owner: FunctionInfo,
-                local_defs: Dict[str, str]) -> CallSite:
+                local_defs: Dict[str, str],
+                local_types: Optional[Dict[str, str]] = None) -> CallSite:
+        local_types = local_types or {}
         func = call.func
         site = CallSite(node=call, text=dotted_name(func))
         # plain name --------------------------------------------------
@@ -577,6 +698,15 @@ class _Resolver:
                     if got:
                         site.targets = [got]
                         return site
+        # typed LOCAL receiver: cell.fleet... where the local's type was
+        # inferred (annotation, constructor, container element)
+        if isinstance(recv, ast.Name) and recv.id in local_types:
+            cls = self.pkg.resolve_class(local_types[recv.id])
+            if cls is not None:
+                got = self._lookup_class_method(cls, func.attr)
+                if got:
+                    site.targets = [got]
+                    return site
         # weak: unique method name ------------------------------------
         if func.attr not in _WEAK_RESOLVE_BLOCKLIST:
             keys = self.pkg.method_index.get(func.attr, set())
@@ -584,6 +714,44 @@ class _Resolver:
                 site.targets = [next(iter(keys))]
                 site.weak = True
         return site
+
+    def resolve_property(self, node: ast.Attribute, owner: FunctionInfo,
+                         local_types: Dict[str, str]
+                         ) -> Optional[CallSite]:
+        """An attribute LOAD that invokes a ``@property`` getter of a
+        package class (``cell.digest``, ``r.serving.queue_depth``) is a
+        call in disguise — and the serving tier's property getters take
+        locks, so the lock-discipline graph and the races rule must see
+        the edge. Only strong receiver typings resolve (self, typed
+        local, typed attribute); a miss returns None."""
+        target_cls: Optional[ClassInfo] = None
+        recv = node.value
+        if isinstance(recv, ast.Name):
+            if recv.id in ("self", "cls") and owner.class_key:
+                target_cls = self.pkg.classes[owner.class_key]
+            elif recv.id in local_types:
+                target_cls = self.pkg.resolve_class(local_types[recv.id])
+        elif isinstance(recv, ast.Attribute):
+            if isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self" and owner.class_key:
+                t = self.pkg.classes[owner.class_key].attr_types.get(
+                    recv.attr)
+                if t:
+                    target_cls = self.pkg.resolve_class(t)
+            if target_cls is None:
+                types = self.pkg.attr_type_index.get(recv.attr, set())
+                if len(types) == 1:
+                    target_cls = self.pkg.resolve_class(next(iter(types)))
+        if target_cls is None:
+            return None
+        got = self._lookup_class_method(target_cls, node.attr)
+        if got is None:
+            return None
+        tf = self.pkg.functions.get(got)
+        if tf is None or not tf.is_property_getter:
+            return None
+        return CallSite(node=node, text=dotted_name(node), targets=[got],
+                        is_property=True)
 
 
 class _SecondPass:
@@ -608,6 +776,7 @@ class _SecondPass:
         for node in iter_shallow(self.mod.tree):
             if isinstance(node, ast.Call):
                 self._mark_transform_args(node, mod_defs, by_node)
+                self._mark_thread_entry(node, None, mod_defs, by_node)
 
     def _local_defs(self, f: FunctionInfo,
                     by_node) -> Dict[str, str]:
@@ -634,15 +803,18 @@ class _SecondPass:
             # (``jit(lambda x: helper(x))``); iter_shallow only yields
             # children, so the body node itself must be scanned too or
             # the traced set never reaches ``helper``
-            nodes: Iterable[ast.AST] = [f.node.body]
-            nodes = list(nodes) + list(iter_shallow(f.node.body))
+            nodes: List[ast.AST] = [f.node.body]
+            nodes = nodes + list(iter_shallow(f.node.body))
         else:
-            nodes = iter_shallow(f.node)
+            nodes = list(iter_shallow(f.node))
+        local_types = self._infer_local_types(f, nodes)
         for node in nodes:
             if isinstance(node, ast.Call):
-                site = self.resolver.resolve(node, f, local_defs)
+                site = self.resolver.resolve(node, f, local_defs,
+                                             local_types)
                 f.calls.append(site)
                 self._mark_transform_args(node, local_defs, by_node)
+                self._mark_thread_entry(node, f, local_defs, by_node)
             elif isinstance(node, ast.With):
                 for item in node.items:
                     lk = self._lock_key(item.context_expr, f)
@@ -650,6 +822,208 @@ class _SecondPass:
                         f.lock_regions.append(LockRegion(
                             lock_key=lk, with_node=node,
                             lineno=node.lineno))
+        # property getters invoked by attribute loads: calls in disguise
+        # (see Resolver.resolve_property). An attribute that is itself
+        # the callee of a Call was already handled above.
+        callee_ids = {id(n.func) for n in nodes
+                      if isinstance(n, ast.Call)}
+        for node in nodes:
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Load)
+                    and id(node) not in callee_ids):
+                site = self.resolver.resolve_property(node, f, local_types)
+                if site is not None:
+                    f.calls.append(site)
+
+    def _infer_local_types(self, f: FunctionInfo,
+                           nodes: Sequence[ast.AST]) -> Dict[str, str]:
+        """Best-effort local-variable typing: parameter annotations,
+        annotated assigns, constructor assigns, ``self.attr`` loads of
+        typed attributes, and container-element extraction
+        (``self._cells.get(k)`` / ``self._cells[k]`` /
+        ``for r in self._replicas.values()`` / comprehensions) using
+        the class's annotated container value types. Flow-insensitive;
+        two lexical passes so a loop over a list built later still
+        types."""
+        types: Dict[str, str] = {}
+        elems: Dict[str, str] = {}   # local list/dict var -> element type
+        cls = (self.pkg.classes.get(f.class_key)
+               if f.class_key else None)
+
+        def self_attr_type(v: ast.AST) -> Optional[str]:
+            if (isinstance(v, ast.Attribute)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == "self" and cls is not None):
+                return cls.attr_types.get(v.attr)
+            return None
+
+        def self_attr_elem(v: ast.AST) -> Optional[str]:
+            if (isinstance(v, ast.Attribute)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == "self" and cls is not None):
+                return cls.attr_elem_types.get(v.attr)
+            return None
+
+        def elem_type_of(it: ast.AST) -> Optional[str]:
+            """Element type of an iterable expression."""
+            got = self_attr_elem(it)
+            if got:
+                return got
+            if isinstance(it, ast.Name):
+                return elems.get(it.id)
+            if isinstance(it, ast.Call):
+                fn = it.func
+                if isinstance(fn, ast.Attribute) \
+                        and fn.attr == "values":
+                    return self_attr_elem(fn.value)
+                if final_attr_name(fn) in ("list", "sorted", "reversed",
+                                           "iter") and it.args:
+                    return elem_type_of(it.args[0])
+            if isinstance(it, (ast.ListComp, ast.SetComp,
+                               ast.GeneratorExp)):
+                gen = it.generators[0] if it.generators else None
+                if gen is not None and isinstance(it.elt, ast.Name) \
+                        and isinstance(gen.target, ast.Name) \
+                        and it.elt.id == gen.target.id:
+                    return elem_type_of(gen.iter)
+            return None
+
+        def value_type(v: ast.AST) -> Optional[str]:
+            got = self_attr_type(v)
+            if got:
+                return got
+            if isinstance(v, ast.Name):
+                return types.get(v.id)
+            if isinstance(v, ast.Subscript):
+                return elem_type_of(v.value)
+            if isinstance(v, ast.Call):
+                fn = v.func
+                ctor = final_attr_name(fn)
+                if ctor and ctor[:1].isupper() \
+                        and self.pkg.class_index.get(ctor):
+                    return ctor
+                if isinstance(fn, ast.Attribute) and fn.attr == "get":
+                    return elem_type_of(fn.value)
+                if ctor == "next" and v.args:
+                    return elem_type_of(v.args[0])
+            return None
+
+        for _pass in range(2):
+            for node in nodes:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    name = node.targets[0].id
+                    t = value_type(node.value)
+                    if t:
+                        types.setdefault(name, t)
+                    e = elem_type_of(node.value)
+                    if e:
+                        elems.setdefault(name, e)
+                elif isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name):
+                    t, e = annotation_types(node.annotation)
+                    if t:
+                        types.setdefault(node.target.id, t)
+                    if e:
+                        elems.setdefault(node.target.id, e)
+                elif isinstance(node, (ast.For, ast.AsyncFor)) \
+                        and isinstance(node.target, ast.Name):
+                    t = elem_type_of(node.iter)
+                    if t:
+                        types.setdefault(node.target.id, t)
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.GeneratorExp, ast.DictComp)):
+                    for gen in node.generators:
+                        if isinstance(gen.target, ast.Name):
+                            t = elem_type_of(gen.iter)
+                            if t:
+                                types.setdefault(gen.target.id, t)
+        if isinstance(f.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for arg in (list(f.node.args.posonlyargs)
+                        + list(f.node.args.args)
+                        + list(f.node.args.kwonlyargs)):
+                if arg.annotation is not None:
+                    t, _ = annotation_types(arg.annotation)
+                    if t:
+                        types[arg.arg] = t
+        return types
+
+    def _expr_module(self, func_expr: ast.AST) -> Optional[str]:
+        """Real module behind ``alias.attr`` or a from-imported name."""
+        if isinstance(func_expr, ast.Attribute) and isinstance(
+                func_expr.value, ast.Name):
+            return self.mod.alias_to_module.get(func_expr.value.id,
+                                                func_expr.value.id)
+        if isinstance(func_expr, ast.Name):
+            imp = self.mod.name_imports.get(func_expr.id)
+            if imp:
+                return imp[0].lstrip(".")
+        return None
+
+    def _callable_key(self, arg: Optional[ast.AST],
+                      owner: Optional[FunctionInfo],
+                      local_defs: Dict[str, str],
+                      by_node) -> Optional[str]:
+        """Resolve a callable-valued expression to a function key (the
+        thread-entry version of _mark_callable — prefers the owner
+        class over the global unique-name index for ``self.x``)."""
+        if arg is None:
+            return None
+        if isinstance(arg, ast.Lambda):
+            got = by_node.get(arg)
+            return got.key if got is not None else None
+        if isinstance(arg, ast.Name) and arg.id in local_defs:
+            return local_defs[arg.id]
+        if isinstance(arg, ast.Attribute) and isinstance(
+                arg.value, ast.Name):
+            if arg.value.id == "self" and owner is not None \
+                    and owner.class_key:
+                cls = self.pkg.classes[owner.class_key]
+                got = self.resolver._lookup_class_method(cls, arg.attr)
+                if got:
+                    return got
+            keys = self.pkg.method_index.get(arg.attr, set())
+            if len(keys) == 1:
+                return next(iter(keys))
+        return None
+
+    def _mark_thread_entry(self, call: ast.Call,
+                           owner: Optional[FunctionInfo],
+                           local_defs: Dict[str, str], by_node) -> None:
+        """Record thread entry points: ``threading.Thread(target=...)``
+        (role = the thread's ``name=`` string when constant),
+        ``threading.Timer(t, fn)`` and ``weakref.finalize(obj, fn)``."""
+        name = final_attr_name(call.func)
+        if name in ("Thread", "Timer"):
+            if self._expr_module(call.func) != "threading":
+                return
+            target = None
+            role_name = None
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+                elif kw.arg == "name" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    role_name = kw.value.value
+            if name == "Timer" and target is None and len(call.args) >= 2:
+                target = call.args[1]
+            key = self._callable_key(target, owner, local_defs, by_node)
+            if key is None:
+                return
+            role = role_name or f"thread:{self.pkg.functions[key].qualname}"
+            self.pkg.thread_entries.append(ThreadEntry(
+                role=role, func_key=key,
+                kind="thread" if name == "Thread" else "timer",
+                module=self.mod.key, lineno=call.lineno))
+        elif name == "finalize" and self._expr_module(call.func) \
+                == "weakref" and len(call.args) >= 2:
+            key = self._callable_key(call.args[1], owner, local_defs,
+                                     by_node)
+            if key is not None:
+                self.pkg.thread_entries.append(ThreadEntry(
+                    role="finalizer", func_key=key, kind="finalizer",
+                    module=self.mod.key, lineno=call.lineno))
 
     def _lock_key(self, expr: ast.AST,
                   f: FunctionInfo) -> Optional[str]:
@@ -737,6 +1111,10 @@ def _propagate_traced(pkg: PackageModel) -> None:
         for k in frontier:
             f = pkg.functions[k]
             for site in f.calls:
+                if site.is_property:
+                    # property-getter edges feed the lock/races graphs
+                    # only — the traced set stays explicit-call based
+                    continue
                 for t in site.targets:
                     if t in seen:
                         continue
@@ -752,6 +1130,48 @@ def _propagate_traced(pkg: PackageModel) -> None:
                     seen.add(t)
                     nxt.append(t)
         frontier = nxt
+
+
+def _propagate_roles(pkg: PackageModel) -> None:
+    """Thread-role propagation over the call graph.
+
+    Seeds: each discovered thread entry gets its role; the externally
+    callable surface — public names, dunders, and anything with no
+    resolved internal caller (minus the thread entries themselves) —
+    gets the synthetic ``"main"`` role (any caller thread). Roles then
+    flow caller -> callee to a fixpoint, so a helper reachable from both
+    ``step()`` (caller-driven) and the driver loop carries both roles —
+    exactly the "accessed from >= 2 threads" precondition the races
+    rule tests."""
+    entry_keys = set()
+    for e in pkg.thread_entries:
+        f = pkg.functions.get(e.func_key)
+        if f is not None:
+            f.thread_roles.add(e.role)
+            entry_keys.add(e.func_key)
+    incoming: Set[str] = set()
+    for f in pkg.functions.values():
+        for site in f.calls:
+            incoming.update(site.targets)
+    for k, f in pkg.functions.items():
+        if k in entry_keys:
+            continue
+        public = (not f.name.startswith("_")
+                  or (f.name.startswith("__") and f.name.endswith("__")))
+        if public or k not in incoming:
+            f.thread_roles.add("main")
+    work = [k for k, f in pkg.functions.items() if f.thread_roles]
+    while work:
+        k = work.pop()
+        f = pkg.functions[k]
+        for site in f.calls:
+            for t in site.targets:
+                g = pkg.functions.get(t)
+                if g is None:
+                    continue
+                if not f.thread_roles <= g.thread_roles:
+                    g.thread_roles |= f.thread_roles
+                    work.append(t)
 
 
 def build_package_model(paths: Sequence[str],
@@ -781,4 +1201,5 @@ def build_package_model(paths: Sequence[str],
     for mod in pkg.modules.values():
         _SecondPass(pkg, mod).run()
     _propagate_traced(pkg)
+    _propagate_roles(pkg)
     return pkg

@@ -7,3 +7,4 @@ from . import locks            # noqa: F401
 from . import exceptions       # noqa: F401
 from . import wall_clock       # noqa: F401
 from . import comm_facade      # noqa: F401
+from . import races            # noqa: F401

@@ -94,8 +94,11 @@ class LockDisciplineRule(Rule):
         summaries: Dict[str, _Summary] = {}
         for f in pkg.functions.values():
             summaries[f.key] = self._summarize(f)
-        # per-region findings + edge collection
+        # per-region findings + edge collection; ``self.edges`` is kept
+        # for collect_lock_graph (the runtime sanitizer cross-validates
+        # observed acquisition edges against exactly this graph)
         edges: Dict[Tuple[str, str], Tuple[FunctionInfo, ast.AST, str]] = {}
+        self.edges = edges
         for f in pkg.functions.values():
             for region in f.lock_regions:
                 yield from self._check_region(f, region, summaries,
@@ -304,12 +307,15 @@ class LockDisciplineRule(Rule):
                      summaries: Dict[str, _Summary]
                      ) -> Iterator[Tuple[ast.AST, List[str], str]]:
         """(site node, human path, target key) for every package
-        function reachable from inside the with-block, depth-limited."""
-        call_nodes = {id(n) for n in iter_shallow(region.with_node)
-                      if isinstance(n, ast.Call)}
+        function reachable from inside the with-block, depth-limited.
+        Matched by site node (not ``isinstance(Call)``) so @property
+        getter sites — attribute loads that acquire locks, like a fleet
+        gauge pass reading ``r.serving.queue_depth`` — are followed
+        too."""
+        region_nodes = {id(n) for n in iter_shallow(region.with_node)}
         start: List[Tuple[ast.AST, str]] = []
         for site in f.calls:
-            if id(site.node) in call_nodes:
+            if id(site.node) in region_nodes:
                 for t in site.targets:
                     start.append((site.node, t))
         seen: Set[str] = {f.key}
@@ -399,3 +405,19 @@ class LockDisciplineRule(Rule):
                                     f"different orders deadlock")
                     elif nxt not in path:
                         stack.append((nxt, path + [nxt]))
+
+
+def collect_lock_graph(pkg: PackageModel) -> Dict[Tuple[str, str], str]:
+    """The static lock-acquisition graph at display granularity:
+    ``{("ServingFleet._lock", "ServingEngine._lock"): "<call path>"}``.
+    This is the graph the runtime lock-order sanitizer
+    (resilience/locksan.py) cross-validates against: every acquisition
+    edge a real run observes must exist here, or the static model has a
+    false negative (docs/static_analysis.md "races")."""
+    rule = LockDisciplineRule()
+    for _ in rule.run(pkg):
+        pass
+    out: Dict[Tuple[str, str], str] = {}
+    for (a, b), (f, _node, path) in rule.edges.items():
+        out[(_lock_display(a), _lock_display(b))] = f"{f.qualname}: {path}"
+    return out

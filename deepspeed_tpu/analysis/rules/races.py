@@ -1,0 +1,388 @@
+"""races: Eraser-style static lockset analysis over the thread model.
+
+dslint's lock-discipline rule checks what happens WHILE locks are held;
+this rule checks what happens WITHOUT them: shared instance state
+reachable from two thread roles where no common lock covers a write and
+a conflicting access. The thread model (``model.ThreadEntry`` /
+``FunctionInfo.thread_roles``) discovers entry points —
+``threading.Thread(target=...)`` driver/monitor/watchdog loops,
+``weakref.finalize`` callbacks, timers — and propagates roles over the
+resolved call graph; the synthetic ``"main"`` role stands for any
+caller thread.
+
+For every class the rule collects each method's ``self.<attr>`` reads
+and writes together with the lockset guaranteed at the access:
+
+* the lexically enclosing ``with <lock>:`` regions, plus
+* the function's *entry lockset* — the intersection, over every
+  resolved internal call site, of the locks held at the call (so
+  ``_dispatch``, always invoked under the serving lock, is modeled as
+  lock-protected even though it takes no lock itself).
+
+A finding fires when an attribute has a write and a conflicting access
+(write-write or read-write) whose locksets share no lock and whose
+roles span >= 2 threads. Findings are deduplicated to at most one per
+(class, attribute, code), anchored at the first racy WRITE — suppress
+there to accept a deliberate pattern.
+
+Recognized safe idioms (no finding):
+
+* **init publish** — accesses inside ``__init__``/``__post_init__``
+  happen before any thread can hold the object;
+* **queue / deque hand-off** — attributes constructed as
+  ``queue.Queue`` (and friends) or ``collections.deque`` synchronize
+  internally;
+* **one-shot latch** — an attribute whose every non-init write assigns
+  the same constant (``self._accepting = False``) is monotonic; racing
+  readers see either the old or the final value;
+* **lock/event attributes** — the synchronization objects themselves.
+
+The runtime half of dsrace is resilience/locksan.py: instrumented lock
+wrappers that record real acquisition orders under tests/DST and
+cross-validate against the static lock graph (docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from ..findings import Finding
+from ..model import (_SAFE_CONTAINER_CTORS, ClassInfo, FunctionInfo,
+                     PackageModel, iter_shallow)
+from ..registry import Rule, register
+
+#: method calls on an attribute that mutate the container it names
+#: (``self._queue.remove(req)`` writes ``_queue``). Deliberately
+#: excludes the generic verbs (``put``/``get``/``set``/``pop``/``add``/
+#: ``update``/``discard``) — those also name queue, engine and
+#: domain-object methods (``self._engine.discard(uid)``), and a
+#: misattributed "write" to the holder attribute floods the rule;
+#: container attrs mutated ONLY through those verbs are in practice
+#: also written via subscript/assign, which the rule does see.
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "insert", "remove",
+    "popleft", "popitem", "clear", "sort", "reverse", "setdefault",
+}
+
+#: methods excluded wholesale: construction happens before the object
+#: is published to any other thread
+_INIT_METHODS = {"__init__", "__post_init__", "__new__", "__del__"}
+
+#: sentinel lockset for "unknown entry context" (never called from
+#: resolved package code): treated as fully locked — an unreachable
+#: helper cannot witness a race
+_TOP = None
+
+
+@dataclass
+class _Access:
+    attr: str
+    kind: str                 # "read" | "write"
+    func: FunctionInfo
+    line: int
+    col: int
+    locks: Optional[FrozenSet[str]]   # None = TOP (unknown, assume safe)
+    roles: FrozenSet[str] = field(default_factory=frozenset)
+    #: for the one-shot-latch idiom: the repr of a constant assigned by
+    #: a plain ``self.x = <const>`` write, else None
+    const: Optional[str] = None
+    is_const_assign: bool = False
+
+
+def _fmt_locks(locks: Optional[FrozenSet[str]]) -> str:
+    if locks is _TOP:
+        return "{?}"
+    if not locks:
+        return "{}"
+    return "{" + ", ".join(sorted(k.split("::")[-1] for k in locks)) + "}"
+
+
+def _fmt_roles(roles: FrozenSet[str]) -> str:
+    return "+".join(sorted(roles)) if roles else "-"
+
+
+@register
+class RacesRule(Rule):
+    id = "races"
+    summary = ("Eraser-style lockset analysis: shared attributes "
+               "reachable from >= 2 thread roles with no common lock "
+               "between a write and a conflicting access")
+
+    def run(self, pkg: PackageModel) -> Iterator[Finding]:
+        self.pkg = pkg
+        entry = self._entry_locksets()
+        # class key -> attr -> accesses
+        by_class: Dict[str, Dict[str, List[_Access]]] = {}
+        for f in pkg.functions.values():
+            if f.class_key is None or f.name in _INIT_METHODS:
+                continue
+            cls = pkg.classes.get(f.class_key)
+            if cls is None:
+                continue
+            base = entry.get(f.key, _TOP)
+            for acc in self._accesses(f, cls, base):
+                acc.roles = frozenset(f.thread_roles)
+                by_class.setdefault(cls.key, {}).setdefault(
+                    acc.attr, []).append(acc)
+        for cls_key in sorted(by_class):
+            cls = pkg.classes[cls_key]
+            for attr in sorted(by_class[cls_key]):
+                yield from self._check_attr(cls, attr,
+                                            by_class[cls_key][attr])
+
+    # -- entry locksets --------------------------------------------------
+    def _entry_locksets(self) -> Dict[str, Optional[FrozenSet[str]]]:
+        """Guaranteed-held locks at function ENTRY: the intersection
+        over every resolved internal call site of (caller's entry set
+        union the locks lexically held at the site). Functions with no
+        resolved internal caller are roots (empty set); functions only
+        reachable through unresolved paths stay TOP (assumed safe)."""
+        pkg = self.pkg
+        # target -> list of (caller key, lexical locks at the site)
+        callers: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        self._site_locks: Dict[str, Dict[int, FrozenSet[str]]] = {}
+        for f in pkg.functions.values():
+            site_locks = self._lexical_locks(f)
+            self._site_locks[f.key] = site_locks
+            for site in f.calls:
+                held = site_locks.get(id(site.node), frozenset())
+                for t in site.targets:
+                    callers.setdefault(t, []).append((f.key, held))
+        # a nested closure with no resolved caller (handed to a walker/
+        # callback) runs, in this codebase, inside its defining function
+        # — model it as called from its definition site, so a closure
+        # defined under ``with self._lock:`` (the ring-walk predicate in
+        # Region._pick_cell) inherits that lock context
+        by_qual: Dict[Tuple[str, str], str] = {
+            (f.module, f.qualname): k for k, f in pkg.functions.items()}
+        for k, f in pkg.functions.items():
+            if k in callers or ".<locals>." not in f.qualname:
+                continue
+            outer_qual = f.qualname.rsplit(".<locals>.", 1)[0]
+            outer_key = by_qual.get((f.module, outer_qual))
+            if outer_key is None:
+                continue
+            held = self._site_locks.get(outer_key, {}).get(
+                id(f.node), frozenset())
+            callers[k] = [(outer_key, held)]
+        out: Dict[str, Optional[FrozenSet[str]]] = {}
+        for k in pkg.functions:
+            out[k] = frozenset() if k not in callers else _TOP
+        # descending fixpoint (finite lattice, monotone meet)
+        changed = True
+        rounds = 0
+        while changed and rounds < 20:
+            changed = False
+            rounds += 1
+            for t, sites in callers.items():
+                vals = []
+                for caller, held in sites:
+                    base = out.get(caller, _TOP)
+                    if base is _TOP:
+                        continue        # unknown path: no constraint yet
+                    vals.append(base | held)
+                if not vals:
+                    continue
+                new: Optional[FrozenSet[str]] = vals[0]
+                for v in vals[1:]:
+                    new = new & v
+                if out.get(t, _TOP) is _TOP or new != out[t]:
+                    if out.get(t, _TOP) is _TOP or new < out[t]:
+                        out[t] = new
+                        changed = True
+        return out
+
+    def _lexical_locks(self, f: FunctionInfo) -> Dict[int, FrozenSet[str]]:
+        """id(node) -> lock keys lexically held at that node, for every
+        node in the function body."""
+        region_by_with = {id(r.with_node): r.lock_key
+                         for r in f.lock_regions}
+        out: Dict[int, FrozenSet[str]] = {}
+
+        def walk(node: ast.AST, held: FrozenSet[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                inner = held
+                if id(child) in region_by_with:
+                    inner = held | {region_by_with[id(child)]}
+                # nested defs are recorded (their DEFINITION site's lock
+                # context seeds closure entry locksets) but not entered
+                # — their bodies belong to their own FunctionInfo
+                out[id(child)] = inner
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda,
+                                      ast.ClassDef)):
+                    continue
+                walk(child, inner)
+
+        out[id(f.node)] = frozenset()
+        walk(f.node, frozenset())
+        return out
+
+    # -- access collection ----------------------------------------------
+    def _skip_attr(self, cls: ClassInfo, attr: str) -> bool:
+        if attr in cls.lock_attrs or attr in cls.event_attrs:
+            return True
+        if cls.attr_types.get(attr) in _SAFE_CONTAINER_CTORS:
+            return True
+        # inherited lock/queue attrs (single-inheritance walk)
+        seen = 0
+        cur = cls
+        while cur.base_names and seen < 4:
+            b = self.pkg.resolve_class(cur.base_names[0])
+            if b is None or b.key == cur.key:
+                break
+            if attr in b.lock_attrs or attr in b.event_attrs:
+                return True
+            if b.attr_types.get(attr) in _SAFE_CONTAINER_CTORS:
+                return True
+            cur = b
+            seen += 1
+        return False
+
+    def _accesses(self, f: FunctionInfo, cls: ClassInfo,
+                  base: Optional[FrozenSet[str]]
+                  ) -> Iterator[_Access]:
+        site_locks = self._site_locks[f.key]
+
+        def locks_at(node: ast.AST) -> Optional[FrozenSet[str]]:
+            if base is _TOP:
+                return _TOP
+            return base | site_locks.get(id(node), frozenset())
+
+        def self_attr(node: ast.AST) -> Optional[str]:
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                return node.attr
+            return None
+
+        # iter_shallow, not ast.walk: nested function/lambda bodies
+        # belong to their OWN FunctionInfo — walking into them here
+        # would attribute a closure's accesses to the enclosing method
+        # minus the closure's lock context (their lock coverage flows
+        # through the closure entry-lockset seam instead)
+        for node in iter_shallow(f.node):
+            if isinstance(node, ast.Attribute):
+                attr = self_attr(node)
+                if attr is None or self._skip_attr(cls, attr):
+                    continue
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    yield _Access(attr=attr, kind="write", func=f,
+                                  line=node.lineno, col=node.col_offset,
+                                  locks=locks_at(node))
+                else:
+                    yield _Access(attr=attr, kind="read", func=f,
+                                  line=node.lineno, col=node.col_offset,
+                                  locks=locks_at(node))
+            elif isinstance(node, ast.Call):
+                # self.X.append(...) mutates the container X names
+                func = node.func
+                if isinstance(func, ast.Attribute) \
+                        and func.attr in _MUTATOR_METHODS:
+                    attr = self_attr(func.value)
+                    if attr is not None and not self._skip_attr(cls, attr):
+                        yield _Access(attr=attr, kind="write", func=f,
+                                      line=node.lineno,
+                                      col=node.col_offset,
+                                      locks=locks_at(node))
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)):
+                attr = self_attr(node.value)
+                if attr is not None and not self._skip_attr(cls, attr):
+                    yield _Access(attr=attr, kind="write", func=f,
+                                  line=node.lineno, col=node.col_offset,
+                                  locks=locks_at(node))
+            elif isinstance(node, ast.Assign):
+                # mark plain constant assigns for the one-shot idiom
+                if isinstance(node.value, ast.Constant):
+                    for t in node.targets:
+                        attr = self_attr(t)
+                        if attr is not None \
+                                and not self._skip_attr(cls, attr):
+                            yield _Access(
+                                attr=attr, kind="write", func=f,
+                                line=t.lineno, col=t.col_offset,
+                                locks=locks_at(t),
+                                const=repr(node.value.value),
+                                is_const_assign=True)
+
+    # -- the race check --------------------------------------------------
+    def _check_attr(self, cls: ClassInfo, attr: str,
+                    accesses: List[_Access]) -> Iterator[Finding]:
+        # constant-assign accesses were emitted TWICE (once from the
+        # Store-ctx Attribute walk, once annotated): keep the annotated
+        # one per (line, col)
+        const_keys = {(a.line, a.col) for a in accesses
+                      if a.is_const_assign}
+        accesses = [a for a in accesses
+                    if a.is_const_assign
+                    or a.kind != "write"
+                    or (a.line, a.col) not in const_keys]
+        writes = [a for a in accesses if a.kind == "write"]
+        reads = [a for a in accesses if a.kind == "read"]
+        if not writes:
+            return
+        # one-shot latch: every write assigns the same constant
+        consts = {a.const for a in writes}
+        if all(a.is_const_assign for a in writes) and len(consts) == 1:
+            return
+
+        def conflict(a: _Access, b: _Access) -> bool:
+            if a.locks is _TOP or b.locks is _TOP:
+                return False
+            if a.locks & b.locks:
+                return False
+            union = a.roles | b.roles
+            if len(union) < 2:
+                return False
+            return True
+
+        order = sorted(writes, key=lambda a: (a.func.module, a.line,
+                                              a.col))
+        for code, others_all in (("write-write", writes),
+                                 ("read-write", reads)):
+            others = sorted(others_all, key=lambda a: (a.func.module,
+                                                       a.line, a.col))
+            hit = None
+            for w in order:
+                for o in others:
+                    if o is w:
+                        continue
+                    if conflict(w, o):
+                        hit = (w, o)
+                        break
+                # a single write site reachable from two roles races
+                # against itself (two threads in the same function)
+                if hit is None and code == "write-write" \
+                        and len(w.roles) >= 2 and w.locks is not _TOP \
+                        and not w.locks:
+                    hit = (w, w)
+                if hit:
+                    break
+            if hit is None:
+                continue
+            w, o = hit
+            # anchor the finding at the UNLOCKED side of the pair — a
+            # suppression accepting a deliberate pattern belongs where
+            # the lock is missing (the unlocked peek, the lock-free
+            # watchdog sample), not at the properly locked write
+            anchored, other = (o, w) if (w.locks and not o.locks) \
+                else (w, o)
+            other_desc = ("concurrent entry to the same site"
+                          if other is anchored else
+                          f"{other.kind} in {other.func.qualname} "
+                          f"({other.func.module}:{other.line}, locks "
+                          f"{_fmt_locks(other.locks)}, roles "
+                          f"{_fmt_roles(other.roles)})")
+            yield Finding(
+                rule=self.id, code=code,
+                path=anchored.func.module, line=anchored.line,
+                col=anchored.col, symbol=anchored.func.qualname,
+                message=(
+                    f"{cls.name}.{attr}: unsynchronized {anchored.kind} "
+                    f"under locks {_fmt_locks(anchored.locks)} (roles "
+                    f"{_fmt_roles(anchored.roles)}) vs {other_desc} — "
+                    f"no common lock; guard both with one lock, or "
+                    f"confine the attribute to one thread"))

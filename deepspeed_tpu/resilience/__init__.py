@@ -20,6 +20,16 @@ from .clock import (  # noqa: F401
     set_clock,
     use_clock,
 )
+from .locksan import (  # noqa: F401
+    DOCUMENTED_LOCK_ORDER,
+    LockOrderViolation,
+    LockSanitizer,
+    get_locksan,
+    install_locksan,
+    named_lock,
+    named_rlock,
+    use_locksan,
+)
 from .retry import RetryBudget, RetryError, RetryPolicy, retry_call  # noqa: F401
 from .preemption import PreemptionGuard  # noqa: F401
 from .divergence import DivergenceError, DivergenceGuard  # noqa: F401

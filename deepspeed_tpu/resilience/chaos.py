@@ -53,6 +53,7 @@ import json
 import os
 import random
 import signal
+import threading
 from typing import Any, Dict, Optional
 
 from ..utils.logging import logger
@@ -146,6 +147,11 @@ class FaultInjector:
         # bumped on every sever/heal so observers (the region monitor)
         # can detect connectivity changes without diffing group sets
         self.partition_epoch = 0
+        # the injector is polled from fleet/region monitor threads while
+        # the driving thread arms faults and severs partitions: the
+        # injection ledger and partition list are shared state (dsrace
+        # finding, PR 15) — one small mutex covers both
+        self._mu = threading.Lock()
 
     # ------------------------------------------------------------------
     @classmethod
@@ -187,7 +193,13 @@ class FaultInjector:
 
     # ------------------------------------------------------------------
     def _count(self, kind: str) -> None:
-        self.injected[kind] = self.injected.get(kind, 0) + 1
+        with self._mu:
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+        self._record_injection(kind)
+
+    def _record_injection(self, kind: str) -> None:
+        """Telemetry/flight side effects of an injection — OUTSIDE
+        ``_mu`` (the registry and recorder take their own locks)."""
         from ..telemetry.registry import get_registry
 
         get_registry().counter(f"resilience/chaos/{kind}").inc()
@@ -261,9 +273,14 @@ class FaultInjector:
             return False
         if ticks < self.replica_die_at_tick:
             return False
-        if self.injected.get("replica_death"):
-            return False
-        self._count("replica_death")
+        with self._mu:
+            # one-shot check AND ledger flip in the same mutex section:
+            # split, two monitor threads could both pass the check and
+            # double-kill a single configured death
+            if self.injected.get("replica_death"):
+                return False
+            self.injected["replica_death"] = 1
+        self._record_injection("replica_death")
         logger.warning(
             f"chaos: killing serving replica {replica_index} at tick {ticks}")
         return True
@@ -282,9 +299,12 @@ class FaultInjector:
             return False
         if ticks < self.cell_die_at_tick:
             return False
-        if self.injected.get("cell_outage"):
-            return False
-        self._count("cell_outage")
+        with self._mu:
+            # same atomic check-and-flip as should_kill_replica
+            if self.injected.get("cell_outage"):
+                return False
+            self.injected["cell_outage"] = 1
+        self._record_injection("cell_outage")
         logger.warning(
             f"chaos: killing serving cell {cell_index} at tick {ticks}")
         return True
@@ -299,27 +319,32 @@ class FaultInjector:
             raise ValueError("partition groups must be non-empty")
         if a & b:
             raise ValueError(f"partition groups overlap: {sorted(a & b)}")
-        self._partitions.append((a, b))
-        self.partition_epoch += 1
+        with self._mu:
+            self._partitions = self._partitions + [(a, b)]
+            self.partition_epoch += 1
         self._count("partition")
         logger.warning(f"chaos: partition {sorted(a)} | {sorted(b)}")
 
     def heal_partitions(self) -> None:
         """Heal every active partition (connectivity restored at once)."""
-        if not self._partitions:
-            return
-        self._partitions = []
-        self.partition_epoch += 1
+        with self._mu:
+            if not self._partitions:
+                return
+            self._partitions = []
+            self.partition_epoch += 1
         self._count("partition_heal")
         logger.warning("chaos: all partitions healed")
 
     @property
     def partitioned(self) -> bool:
-        return bool(self._partitions)
+        with self._mu:
+            return bool(self._partitions)
 
     def reachable(self, a: str, b: str) -> bool:
         """False when any active partition separates ``a`` from ``b``."""
-        for ga, gb in self._partitions:
+        with self._mu:
+            parts = self._partitions    # rebound on sever/heal, never
+        for ga, gb in parts:            # mutated: safe to scan unlocked
             if (a in ga and b in gb) or (a in gb and b in ga):
                 return False
         return True

@@ -62,7 +62,8 @@ class RetryBudget:
 
     @property
     def remaining(self) -> int:
-        return self._remaining
+        with self._lock:
+            return self._remaining
 
     def take(self, op: str = "default") -> bool:
         with self._lock:

@@ -971,7 +971,7 @@ class TrainEngine:
                 metrics["quant_rel_err"] = quant_err
             return new_params, new_opt, new_scaler, rng, metrics
 
-        self._train_step_raw = train_step
+        self._train_step_raw = train_step  # dslint: disable=races -- warmup-join synchronization: _build_train_step runs on main or on the warmup thread, never concurrently (_ensure_train_step_fn joins a pending warmup first; warmup_async is called once from initialize)
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(train_step, donate_argnums=donate,
                        out_shardings=self._step_out_shardings())
@@ -1001,7 +1001,7 @@ class TrainEngine:
             self._warmup_thread.join()
             self._warmup_thread = None
         if self._train_step_fn is None:
-            self._train_step_fn = self._build_train_step()
+            self._train_step_fn = self._build_train_step()  # dslint: disable=races -- warmup-join synchronization: the join two lines up establishes happens-before with the warmup thread's write; no other writer exists
         return self._train_step_fn
 
     # ==================================================================
@@ -1027,7 +1027,7 @@ class TrainEngine:
             lowered = self._train_step_fn.lower(
                 self.params, self.opt_state, self.scaler_state, self.rng,
                 struct)
-            self._train_step_aot = lowered.compile()
+            self._train_step_aot = lowered.compile()  # dslint: disable=races -- warmup-join synchronization: train_batch reaches its _train_step_aot read only after _ensure_train_step_fn joined this thread
             return True
         except Exception as e:  # noqa: BLE001 — warmup must never kill init
             logger.warning(f"AOT warmup failed (lazy jit path unaffected): {e}")
@@ -1099,7 +1099,7 @@ class TrainEngine:
         if self._offload_device == "nvme":
             # disk -> host staging via the aio engine (reference
             # pipelined_optimizer_swapper), then host -> device
-            self.opt_state = self._nvme_swapper.swap_in(self.opt_state_shardings)
+            self.opt_state = self._nvme_swapper.swap_in(self.opt_state_shardings)  # dslint: disable=races -- warmup-join synchronization: warmup only READS engine state, and train_batch joined it (via _ensure_train_step_fn above) before this write; offload engines additionally skip AOT warmup entirely
         elif self._offload_device == "cpu":
             # pinned host -> device upload (the reference offload engine's
             # per-step copy-in)
@@ -1126,7 +1126,7 @@ class TrainEngine:
         if out is None:
             out = fn(self.params, self.opt_state, self.scaler_state, self.rng,
                      batch)
-        self.params, self.opt_state, self.scaler_state, self.rng, metrics = out
+        self.params, self.opt_state, self.scaler_state, self.rng, metrics = out  # dslint: disable=races -- warmup-join synchronization: the warmup thread's reads of params/opt_state/scaler/rng happen strictly before _ensure_train_step_fn's join at the top of train_batch; after the join, main is the only toucher
         self._params_to_offload()
         if self._offload_device == "nvme":
             self._nvme_swapper.swap_out(self.opt_state)

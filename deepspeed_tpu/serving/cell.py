@@ -30,11 +30,11 @@ replica lock.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 from ..resilience.chaos import is_reachable
+from ..resilience.locksan import named_rlock
 from .fleet import ServingFleet
 from .request import Request
 
@@ -105,7 +105,9 @@ class ServingCell:
         self.fleet = fleet
         self.index = int(name.rsplit("-", 1)[-1]) if "-" in name else 0
         self._clock = clock
-        self._lock = threading.RLock()
+        # locksan seam: plain RLock in production, order-recording
+        # wrapper under tests/DST (docs/dst.md)
+        self._lock = named_rlock("ServingCell._lock")
         self._state = CellState.UP
         self._digest: Optional[CellDigest] = None
 

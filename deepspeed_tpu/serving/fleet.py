@@ -54,6 +54,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..resilience.clock import Clock, get_clock
+from ..resilience.locksan import named_rlock
 from ..resilience.retry import RetryBudget
 from ..telemetry.tracing import get_tracer, request_event
 from ..utils.logging import log_dist, logger
@@ -179,7 +180,9 @@ class ServingFleet:
         # backoff, drain budgets, request submit stamps — and every
         # replica it spawns inherits it (docs/dst.md)
         self._clock = clock if clock is not None else get_clock()
-        self._lock = threading.RLock()
+        # locksan seam: plain RLock in production, order-recording
+        # wrapper under tests/DST (docs/dst.md)
+        self._lock = named_rlock("ServingFleet._lock")
         self._replicas: Dict[str, Replica] = {}
         self._requests: Dict[int, Tuple[Request, str]] = {}  # uid -> (req, replica)
         self._name_counter = itertools.count()
@@ -229,7 +232,8 @@ class ServingFleet:
         if self.config.router != "prefix_affinity":
             return 16
         eng = self._factory()
-        self._pending_engine = eng
+        with self._lock:
+            self._pending_engine = eng
         return eng.config.kv_block_size
 
     # -- telemetry -------------------------------------------------------
@@ -257,10 +261,14 @@ class ServingFleet:
     def _spawn(self, role: str = "unified") -> Replica:
         """Build one replica (engine via the factory + a namespaced
         ServingEngine) and register it with the router."""
-        engine = getattr(self, "_pending_engine", None)
-        if engine is not None:
+        with self._lock:
+            # the probe engine hand-off is shared between __init__ and
+            # the monitor thread's respawn path — take-and-clear must be
+            # atomic (dsrace finding, PR 15); the factory call itself
+            # stays outside the lock (it builds a whole engine)
+            engine = getattr(self, "_pending_engine", None)
             self._pending_engine = None
-        else:
+        if engine is None:
             engine = self._factory()
         name = f"replica-{next(self._name_counter)}"
         if self.name:
@@ -771,7 +779,7 @@ class ServingFleet:
         requests are terminal and immutable by now)."""
         from .server import emit_request_span
 
-        if not self._shed_backlog:
+        if not self._shed_backlog:  # dslint: disable=races -- deliberate unlocked peek (the monitor must not take the fleet lock every poll): worst case one deferred shed span; the swap below is locked
             return
         with self._lock:
             backlog, self._shed_backlog = self._shed_backlog, []
@@ -893,8 +901,15 @@ class ServingFleet:
                 # so demand runs ahead of capacity like it does behind a
                 # real autoscaler's observe/decide/boot loop
                 interval += getattr(inj, "autoscaler_lag_s", 0.0)
-            if now - self._last_autoscale >= interval:
-                self._last_autoscale = now
+            with self._lock:
+                # interval check-then-stamp under the lock: poll() runs
+                # on the monitor thread AND via manual step() — unlocked
+                # it could double-fire one interval's autoscale decision
+                # (dsrace finding, PR 15)
+                due = now - self._last_autoscale >= interval
+                if due:
+                    self._last_autoscale = now
+            if due:
                 self.autoscale_once()
         self._flush_shed()
         self._update_gauges()

@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..resilience.chaos import get_fault_injector, is_reachable
 from ..resilience.clock import Clock, get_clock
+from ..resilience.locksan import named_rlock
 from ..telemetry.tracing import get_tracer, request_event
 from ..utils.logging import log_dist, logger
 from .cell import CellDigest, CellUnreachable, ServingCell, check_reachable
@@ -116,7 +117,9 @@ class Region:
         self._guard = preemption_guard
         self._start_drivers = start
         self._clock = clock if clock is not None else get_clock()
-        self._lock = threading.RLock()
+        # locksan seam: plain RLock in production, order-recording
+        # wrapper under tests/DST (docs/dst.md)
+        self._lock = named_rlock("Region._lock")
         self._cells: Dict[str, ServingCell] = {}
         self._ring = ConsistentHashRing(vnodes=config.cell_ring_vnodes)
         self._requests: Dict[int, Tuple[Request, str]] = {}
@@ -347,6 +350,11 @@ class Region:
                     self._shed_brownout(req, floor)
                     return False
                 name = self._pick_cell(req.prompt, refused)
+                # bind the route-work meter while the lock is still
+                # held: _pick_cell writes it under this lock, and the
+                # unlocked read below the release raced a concurrent
+                # route's write (dsrace finding, PR 15)
+                work = self.route_work_last
                 if name is None:
                     tracer.finish_span(span, error="no reachable cell")
                     self._reject(req, "no reachable cell with capacity")
@@ -356,7 +364,7 @@ class Region:
             accepted = cell.fleet.route_request(req, requeue=requeue,
                                                 shed=False)
             tracer.finish_span(span, cell=name, accepted=accepted,
-                               work=self.route_work_last)
+                               work=work)
             if accepted:
                 self._count("routed")
                 if floor > 0 and not requeue:
@@ -412,7 +420,7 @@ class Region:
             self._shed_backlog.append(req)
 
     def _flush_shed(self) -> None:
-        if not self._shed_backlog:
+        if not self._shed_backlog:  # dslint: disable=races -- deliberate unlocked peek (the fleet tier's backlog discipline, one tier up): worst case one deferred shed span; the swap below is locked
             return
         with self._lock:
             backlog, self._shed_backlog = self._shed_backlog, []
@@ -578,16 +586,21 @@ class Region:
         and — on heal — rebalance queued work onto rejoined capacity."""
         inj = get_fault_injector()
         epoch = 0 if inj is None else inj.partition_epoch
-        if epoch == self._partition_epoch_seen:
-            return
-        self._partition_epoch_seen = epoch
-        active = inj is not None and inj.partitioned
-        was_active = self._partition_active
-        self._partition_active = active
+        with self._lock:
+            # epoch compare-then-stamp under the region lock: poll()
+            # runs on the monitor thread AND via manual step(), and the
+            # unlocked check could double-run (or skip) one epoch's
+            # heal rebalance (dsrace finding, PR 15)
+            if epoch == self._partition_epoch_seen:
+                return
+            self._partition_epoch_seen = epoch
+            active = inj is not None and inj.partitioned
+            was_active = self._partition_active
+            self._partition_active = active
         tracer = get_tracer()
         if active:
             unreachable = sorted(
-                name for name in self._cells
+                name for name in self._cells  # dslint: disable=races -- cells are spawned only during __init__, before the monitor thread exists; the map is append-only and never mutated after construction
                 if not is_reachable(self.name, name))
             self._count("partitions_detected")
             logger.warning(f"Region: partition detected; unreachable "

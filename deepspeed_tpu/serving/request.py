@@ -157,9 +157,9 @@ class Request:
             raise InvalidTransition(
                 f"request {self.uid}: illegal transition "
                 f"{self.state.name} -> {new.name}")
-        self.state = new
+        self.state = new  # dslint: disable=races -- single-owner protocol (docs/serving.md "Threading model"): a request is mutated only by its CURRENT owner — the owning replica's ticking thread, or the harvesting fleet/region thread strictly after kill() has joined the old owner's driver; dsrace sees the many owner roles but not the ownership hand-off ordering between them
         if new in TERMINAL_STATES:
-            self.t_finish = self._clock.now()
+            self.t_finish = self._clock.now()  # dslint: disable=races -- single-owner protocol (see state above): terminal stamps are written once by the retiring owner before _done publishes them; waiters read them only after _done.set()
             self._done.set()
 
     @property

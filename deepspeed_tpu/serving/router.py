@@ -96,7 +96,9 @@ class ConsistentHashRing:
         for i in range(self.vnodes):
             point = _hash64(f"{member}#{i}")
             j = bisect.bisect_left(self._points, point)
+            # dslint: disable-next-line=races -- every post-construction ring mutation/walk runs under the OWNING router's lock (the fleet's for PrefixAffinityRouter, the region's for the cell ring — docs/serving.md "Threading model"); the construction-time join precedes thread start, and dsrace's entry-lockset meet over both owners' call contexts is instance-blind
             self._points.insert(j, point)
+            # dslint: disable-next-line=races -- same owning-router lock discipline as _points above
             self._ring.insert(j, (point, member))
 
     def leave(self, member: str) -> None:

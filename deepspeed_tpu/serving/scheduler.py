@@ -101,7 +101,7 @@ class CapacityView:
         counting this tick's admissions AND live requests' reserved
         future growth. Drives how much the preemption pass must evict."""
         need = self._engine.blocks_needed(self._length_for(req))
-        for length in self._admitted_lens:
+        for length in self._admitted_lens:  # dslint: disable=races -- CapacityView is tick-local: built, charged and read on the single ticking thread inside one _admit pass, then dropped; it is never published to another thread (dsrace sees both driving roles, not the one-tick confinement)
             need += self._engine.blocks_needed(length)
         need += sum(self._live_reserved.values())
         return max(0, need - self._engine._available_blocks())

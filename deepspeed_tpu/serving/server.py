@@ -48,6 +48,7 @@ import numpy as np
 
 from ..inference.ragged import PoolExhausted
 from ..resilience.clock import Clock, get_clock
+from ..resilience.locksan import named_rlock
 from ..telemetry.tracing import (begin_request_segment, end_request_segment,
                                  ensure_request_root, finish_request_trace,
                                  get_tracer, request_event)
@@ -207,7 +208,9 @@ class ServingEngine:
                 f"serving.kv_quant='{want_quant}' but the engine stores "
                 f"KV as '{have_quant}' — configure both from one source")
         self._kv_quant = have_quant
-        self._lock = threading.RLock()
+        # built through the locksan seam: a plain RLock in production,
+        # an order-recording wrapper under tests/DST (docs/dst.md)
+        self._lock = named_rlock("ServingEngine._lock")
         self._queue: List[Request] = []
         self._live: Dict[int, Request] = {}
         self._requests: Dict[int, Request] = {}   # uid -> non-terminal req
@@ -255,10 +258,12 @@ class ServingEngine:
     def start(self) -> None:
         if self._driver is not None:
             return
+        # dslint: disable-next-line=races -- thread-handle lifecycle: start precedes any competing writer (the fleet spawns, then starts); kill()/close() join the threads before clearing, and a doubled join is harmless
         self._driver = threading.Thread(target=self._drive, daemon=True,
                                         name="serving-driver")
         self._driver.start()
         if self.config.stuck_tick_timeout_s > 0:
+            # dslint: disable-next-line=races -- thread-handle lifecycle: same start/kill/close serialization as _driver above
             self._watchdog = threading.Thread(target=self._watch, daemon=True,
                                               name="serving-watchdog")
             self._watchdog.start()
@@ -605,9 +610,9 @@ class ServingEngine:
                 # watchdog samples these fields without the lock, and the
                 # reverse order lets it judge a fresh tick against the
                 # previous tick's stale clock after an idle stretch
-                self._tick_started = self._clock.now()
-                self._stuck_reported = False
-                self._in_tick = True
+                self._tick_started = self._clock.now()  # dslint: disable=races -- deliberate lock-free watchdog sampling (comment above): the watchdog tolerates stale reads, and taking the serving lock in its poll would make the health check hang exactly when a tick wedges under that lock
+                self._stuck_reported = False  # dslint: disable=races -- deliberate lock-free watchdog sampling: worst case is one duplicate/missed stuck-tick log line, never corrupted serving state
+                self._in_tick = True  # dslint: disable=races -- deliberate lock-free watchdog sampling: a torn read flips one watchdog poll's verdict, which the next poll corrects
                 did_work = self._tick()
             except Exception:  # dslint: disable=exception-discipline -- driver-loop bug guard: tick faults are handled INSIDE _tick; InjectedFault (BaseException) still crashes through
                 # a driver-loop bug must not silently wedge every caller
@@ -677,7 +682,7 @@ class ServingEngine:
             self._flush_spans()
             self._update_gauges()
             return False
-        self._tick_count += 1
+        self._tick_count += 1  # dslint: disable=races -- driver-thread-owned counter: only the ticking thread (driver or manual step, never both) increments; the watchdog and fleet chaos poll read it lock-free for diagnostics and tolerate staleness
         self._count("ticks")
         try:
             from ..resilience.chaos import get_fault_injector
@@ -954,10 +959,13 @@ class ServingEngine:
                 # tick-fault path once, not preempt healthy decodes and
                 # re-run the failing program live-count times
                 use_spec = False       # drafts were stripped on the raise
-                if attempts >= len(self._live):
-                    raise
-                attempts += 1
                 with self._lock:
+                    # the attempt bound reads _live under the lock: an
+                    # unlocked len() raced concurrent submit/cancel
+                    # mutations (dsrace finding, PR 15)
+                    if attempts >= len(self._live):
+                        raise
+                    attempts += 1
                     victim = self._pool_pressure_victim(set(uids))
                     if victim is None:
                         raise
@@ -1091,9 +1099,13 @@ class ServingEngine:
                 rate = matched / proposed
                 alpha = cfg.spec_ema
                 req._spec_ema = (1 - alpha) * req._spec_ema + alpha * rate
-                cur = self._spec_ema_by_class.get(req.priority, 1.0)
-                self._spec_ema_by_class[req.priority] = \
-                    (1 - alpha) * cur + alpha * rate
+                with self._lock:
+                    # the class credit is read by _build_feed under the
+                    # serving lock; folding into it unlocked from the
+                    # driver raced that read (dsrace finding, PR 15)
+                    cur = self._spec_ema_by_class.get(req.priority, 1.0)
+                    self._spec_ema_by_class[req.priority] = \
+                        (1 - alpha) * cur + alpha * rate
                 request_event(req, "spec_verify", replica=self.replica_id,
                               proposed=proposed, accepted=matched)
                 if (not req._spec_disabled
@@ -1234,7 +1246,7 @@ class ServingEngine:
         lock: the callback routes to (and locks) another replica, and
         holding our lock across that is a lock-order inversion waiting
         to happen."""
-        if not self._handoff_backlog:
+        if not self._handoff_backlog:  # dslint: disable=races -- deliberate unlocked peek (the idle driver must not take the lock every poll): worst case one deferred flush; the swap below is locked
             return
         with self._lock:
             backlog, self._handoff_backlog = self._handoff_backlog, []
@@ -1254,7 +1266,7 @@ class ServingEngine:
     def _flush_spans(self) -> None:
         """Emit deferred request spans OUTSIDE the serving lock (the
         request objects are terminal and immutable by now)."""
-        if not self._span_backlog:   # unlocked peek: the idle driver loop
+        if not self._span_backlog:   # unlocked peek: the idle driver loop  # dslint: disable=races -- deliberate unlocked peek (documented here since PR 5): worst case one deferred span flush; the swap below is locked
             return                   # must not take the lock every poll
         with self._lock:
             backlog, self._span_backlog = self._span_backlog, []
@@ -1277,20 +1289,27 @@ class ServingEngine:
             return
         with self._lock:
             depth, live = len(self._queue), len(self._live)
-        snap = (depth, live, self._engine.kv_occupancy())
-        if snap == self._last_gauges:   # idle loop: don't re-publish
-            return                      # unchanged values every poll
-        self._last_gauges = snap
+            # the last-published compare-and-set runs under the lock:
+            # driver ticks and a main-thread close() both publish, and
+            # the unlocked check-then-write raced them (dsrace finding,
+            # PR 15). kv_occupancy is host-side allocator arithmetic —
+            # same class of locked engine read as _admit's CapacityView.
+            snap = (depth, live, self._engine.kv_occupancy())
+            if snap == self._last_gauges:   # idle loop: don't re-publish
+                return                      # unchanged values every poll
+            self._last_gauges = snap
+            spec_credit = (min(self._spec_ema_by_class.values())
+                           if self._spec_on and self._spec_ema_by_class
+                           else None)
         r = t.registry
         r.gauge(f"{self._metric_prefix}/queue_depth").set(depth)
         r.gauge(f"{self._metric_prefix}/live_requests").set(live)
         r.gauge(f"{self._metric_prefix}/kv_occupancy").set(snap[2])
-        if self._spec_on and self._spec_ema_by_class:
+        if spec_credit is not None:
             # the serving-level acceptance credit (worst class is the
             # honest headline — one cold class means drafts are being
             # throttled somewhere)
-            r.gauge(f"{self._metric_prefix}/spec_credit").set(
-                min(self._spec_ema_by_class.values()))
+            r.gauge(f"{self._metric_prefix}/spec_credit").set(spec_credit)
         if self._kv_quant != "none":
             # pool headroom under quantized storage: the capacity win
             # shows up as this gauge staying high at fixed byte budget

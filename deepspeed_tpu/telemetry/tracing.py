@@ -167,8 +167,9 @@ class FlightRecorder:
         with self._lock:
             records = list(self._ring)
             n = next(self._dump_seq)
+            dropped = self.dropped   # written under the lock by note()
         payload = {"version": 1, "reason": reason, "t": _clock_time(),
-                   "depth": len(records), "dropped": self.dropped,
+                   "depth": len(records), "dropped": dropped,
                    "records": records}
         if path is None and self.dump_dir is not None:
             import os

@@ -10,8 +10,10 @@
 # dslint gate (docs/static_analysis.md): the AST invariant checker must
 # report ZERO unsuppressed, un-baselined findings on the package —
 # host-sync/trace-hygiene in traced code, recompile hazards, lock
-# discipline (fleet -> replica, nothing blocking under a held lock) and
-# exception discipline. It prints its own findings-count summary line.
+# discipline (region -> cell -> fleet -> replica, nothing blocking
+# under a held lock), exception discipline, and the dsrace lockset
+# races rule (shared attributes reachable from >= 2 thread roles with
+# no common lock). It prints its own findings-count summary line.
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m deepspeed_tpu.analysis --check --baseline dslint_baseline.json
 dslint_rc=$?
@@ -54,6 +56,31 @@ if [ "$#" -eq 0 ]; then
     dst_rc=$?
     if [ "$smoke_rc" -eq 0 ]; then
         smoke_rc=$dst_rc
+    fi
+
+    # dsrace cross-validation lane (docs/static_analysis.md "races"):
+    # fleet + region DST schedules re-run with the runtime lock-order
+    # sanitizer installed. Gates: zero sanitizer violations (order
+    # inversions / cycles / same-tier nesting), every runtime-observed
+    # lock edge present in dslint's STATIC lock graph (a miss is a
+    # static-model false negative), every documented-tier static edge
+    # exercised, sanitized replays bit-identical, and the dslint races
+    # rule repo-clean. Writes RACE_r01.json.
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/race_lane.py
+    race_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$race_rc
+    fi
+
+    # dslint findings-count trend artifact (DSLINT_TREND.json, fixed
+    # name): per-rule live/suppressed/baselined counts so suppression
+    # and baseline growth show up as a reviewable diff per PR
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/dslint_trend.py
+    trend_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$trend_rc
     fi
 
     # region soak (CPU evidence lane, docs/serving.md "Region & cells",

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""dslint findings-count trend artifact (docs/static_analysis.md).
+
+Writes DSLINT_TREND.json — per-rule live/suppressed/baselined counts
+for the shipped package under the committed baseline. The file name is
+FIXED (no round suffix): each CI run overwrites it, and the trend is
+its git history — a PR that grows suppressions or baselined debt shows
+up as a diff on this file, reviewable next to the code that caused it.
+
+    python scripts/dslint_trend.py [--baseline dslint_baseline.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+sys.path.insert(0, os.path.join(HERE, "scripts"))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--baseline",
+                    default=os.path.join(HERE, "dslint_baseline.json"))
+    args = ap.parse_args()
+
+    from deepspeed_tpu.analysis import Baseline, analyze, known_rule_ids
+
+    t0 = time.monotonic()
+    findings = analyze([os.path.join(HERE, "deepspeed_tpu")], base=HERE)
+    stale = Baseline.load(args.baseline).absorb(findings)
+
+    per_rule = {rid: {"live": 0, "suppressed": 0, "baselined": 0}
+                for rid in known_rule_ids()}
+    for f in findings:
+        row = per_rule.setdefault(
+            f.rule, {"live": 0, "suppressed": 0, "baselined": 0})
+        if f.suppressed:
+            row["suppressed"] += 1
+        elif f.baselined:
+            row["baselined"] += 1
+        else:
+            row["live"] += 1
+    totals = {k: sum(r[k] for r in per_rule.values())
+              for k in ("live", "suppressed", "baselined")}
+    report = {
+        "metric": "dslint_findings_by_rule",
+        "per_rule": per_rule,
+        "totals": {**totals, "stale_baseline_entries": stale},
+        "wall_s": round(time.monotonic() - t0, 2),
+    }
+    from _artifact import write_artifact
+
+    path = write_artifact("DSLINT_TREND", report, device="host",
+                          path=os.path.join(HERE, "DSLINT_TREND.json"))
+    print(f"[dslint-trend] live={totals['live']} "
+          f"suppressed={totals['suppressed']} "
+          f"baselined={totals['baselined']} stale={stale} -> {path}")
+    # the trend artifact records; the gate that FAILS on live findings
+    # is the dslint --check line in run_tests.sh
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

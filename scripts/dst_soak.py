@@ -26,9 +26,12 @@ CI evidence lane for the deterministic simulation harness
 
 Pure host-side python (the simulated engine never touches a device);
 the whole soak runs in a few seconds. Writes DST_<round>.json (round
-via DST_ROUND, default r08 — r08 adds the speculative-serving and
-kv-quant config draws, the greedy token-identity invariant, and the
-paired spec-on/off identity gate).
+via DST_ROUND, default r09 — r09 adds the lock-order sanitizer leg:
+the replay sample re-runs with instrumented serving locks, gating zero
+order/cycle violations, every runtime-observed lock edge present in
+dslint's static lock graph, and bit-identical sanitized replays; r08
+added the speculative-serving and kv-quant config draws, the greedy
+token-identity invariant, and the paired spec-on/off identity gate).
 
     python scripts/dst_soak.py [--schedules N] [--seed-base B]
 """
@@ -45,7 +48,7 @@ HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
 sys.path.insert(0, os.path.join(HERE, "scripts"))
 
-os.environ.setdefault("DST_ROUND", "r08")
+os.environ.setdefault("DST_ROUND", "r09")
 
 #: every N-th seed is replayed for the determinism gate
 REPLAY_STRIDE = 20
@@ -104,6 +107,33 @@ def main() -> int:
         if (rep.trace_hash, rep.span_hash) != hashes[seed]:
             mismatches.append(seed)
 
+    # sanitizer leg (docs/dst.md "Lock-order sanitizer leg"): the same
+    # replay sample runs with the runtime lock-order sanitizer on —
+    # instrumented serving locks record every real acquisition edge on
+    # virtual time. Gates: zero violations (order inversions / cycles /
+    # same-tier nesting), every observed edge present in dslint's
+    # STATIC lock graph (a miss is a static-model false negative), and
+    # the sanitized replays stay bit-identical (the sanitizer must not
+    # perturb the simulation). The full cross-validation — region tier,
+    # hot-edge coverage — lives in scripts/race_lane.py.
+    from deepspeed_tpu.analysis.model import build_package_model
+    from deepspeed_tpu.analysis.rules.locks import collect_lock_graph
+    from deepspeed_tpu.resilience.locksan import use_locksan
+
+    sanitized = 0
+    san_mismatches = []
+    with use_locksan() as san:
+        for seed in range(args.seed_base, args.seed_base + args.schedules,
+                          REPLAY_STRIDE):
+            sanitized += 1
+            rep = run_schedule(generate_schedule(seed))
+            if (rep.trace_hash, rep.span_hash) != hashes[seed]:
+                san_mismatches.append(seed)
+    static_pairs = set(collect_lock_graph(build_package_model(
+        [os.path.join(HERE, "deepspeed_tpu")], base=HERE)))
+    lock_edges = sorted(san.edge_pairs())
+    edges_missing = [e for e in lock_edges if e not in static_pairs]
+
     # spec-on/off token-identity gate (docs/serving.md "Speculative
     # scheduling"): a sample of seeds runs with speculation FORCED on
     # and forced off — per request the streams must agree on their
@@ -142,6 +172,12 @@ def main() -> int:
         "speculative_configs_exercised": spec_seeds > 0,
         "kv_quant_configs_exercised": kv_quant_seeds > 0,
         "spec_on_off_token_identity": not spec_identity_failures,
+        # dsrace sanitizer leg (PR 15): runtime lock discipline holds,
+        # the static lock model saw every real edge, and the sanitizer
+        # itself is invisible to the deterministic replay
+        "locksan_zero_violations": not san.violations,
+        "locksan_edges_in_static_graph": not edges_missing,
+        "locksan_replays_bit_identical": not san_mismatches,
     }
     report = {
         "metric": "dst_invariant_violations_over_seeded_schedules",
@@ -154,6 +190,11 @@ def main() -> int:
         "kv_quant_seeds": kv_quant_seeds,
         "spec_identity_pairs": spec_paired,
         "spec_identity_failures": spec_identity_failures,
+        "locksan_runs": sanitized,
+        "locksan_edges": [f"{a} -> {b}" for a, b in lock_edges],
+        "locksan_edges_missing_from_static": [f"{a} -> {b}"
+                                              for a, b in edges_missing],
+        "locksan_violations": list(san.violations),
         "totals": totals,
         "failing_seeds": [s for s, _ in failures],
         "wall_s": round(wall, 2),

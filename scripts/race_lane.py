@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""dsrace cross-validation lane (docs/static_analysis.md "races",
+docs/dst.md "Lock-order sanitizer leg").
+
+The two halves of dsrace check each other here:
+
+* **static** — the dslint ``races`` rule must be repo-clean (zero
+  unsuppressed findings), and the package lock graph
+  (``collect_lock_graph``) is the reference the runtime side is judged
+  against;
+* **dynamic** — a sample of fleet AND region DST schedules runs with
+  the runtime lock-order sanitizer installed
+  (``resilience/locksan.py``): instrumented serving-tier locks record
+  every real acquisition edge on virtual time.
+
+Gates:
+
+1. zero sanitizer violations (order inversions, cycles, same-tier
+   nesting, self-deadlocks);
+2. every runtime-observed lock edge exists in the static lock graph —
+   a miss is a static-model FALSE NEGATIVE (the model stopped seeing a
+   real acquisition path) and fails the lane;
+3. coverage: every static edge between documented-order serving-tier
+   locks is exercised by the soak — a hot edge the soak never takes
+   means the dynamic side lost its witness;
+4. the sanitizer is transparent: a sanitized re-run of a seed produces
+   bit-identical (trace_hash, span_hash) to the plain run;
+5. the dslint races rule reports zero live findings on the package.
+
+Writes RACE_<round>.json (round via RACE_ROUND, default r01).
+
+    python scripts/race_lane.py [--fleet-schedules N] [--region-schedules M]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+sys.path.insert(0, os.path.join(HERE, "scripts"))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fleet-schedules", type=int, default=20)
+    ap.add_argument("--region-schedules", type=int, default=10)
+    ap.add_argument("--seed-base", type=int, default=0)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+    if not args.verbose:
+        logging.disable(logging.WARNING)   # the faults ARE the workload
+
+    from deepspeed_tpu.analysis import analyze
+    from deepspeed_tpu.analysis.model import build_package_model
+    from deepspeed_tpu.analysis.rules.locks import (DOCUMENTED_LOCK_ORDER,
+                                                    collect_lock_graph)
+    from deepspeed_tpu.resilience.dst import (generate_region_schedule,
+                                              generate_schedule,
+                                              run_region_schedule,
+                                              run_schedule)
+    from deepspeed_tpu.resilience.locksan import use_locksan
+
+    t0 = time.monotonic()
+    pkg_dir = os.path.join(HERE, "deepspeed_tpu")
+
+    # -- static side ------------------------------------------------------
+    findings = analyze([pkg_dir], base=HERE)
+    races_live = [f for f in findings
+                  if f.rule == "races" and not f.suppressed
+                  and not f.baselined]
+    pkg = build_package_model([pkg_dir], base=HERE)
+    static_graph = collect_lock_graph(pkg)
+    static_pairs = set(static_graph)
+
+    def documented(name: str) -> bool:
+        return any(name == s or name.endswith("." + s)
+                   for s in DOCUMENTED_LOCK_ORDER)
+
+    hot = {e for e in static_pairs if documented(e[0]) and documented(e[1])}
+
+    # -- dynamic side -----------------------------------------------------
+    sim_violations = []
+    with use_locksan() as san:
+        for seed in range(args.seed_base,
+                          args.seed_base + args.fleet_schedules):
+            rep = run_schedule(generate_schedule(seed))
+            if not rep.ok:
+                sim_violations.append((seed, "fleet", rep.violations[:1]))
+        for seed in range(args.seed_base,
+                          args.seed_base + args.region_schedules):
+            rep = run_region_schedule(generate_region_schedule(seed))
+            if not rep.ok:
+                sim_violations.append((seed, "region", rep.violations[:1]))
+    san_report = san.report()
+    observed = san.edge_pairs()
+
+    # -- cross-validation -------------------------------------------------
+    missing = sorted(e for e in observed if e not in static_pairs)
+    unexercised_hot = sorted(e for e in hot if e not in observed)
+
+    # -- transparency -----------------------------------------------------
+    plain = run_schedule(generate_schedule(args.seed_base))
+    with use_locksan():
+        sanitized = run_schedule(generate_schedule(args.seed_base))
+    transparent = ((plain.trace_hash, plain.span_hash)
+                   == (sanitized.trace_hash, sanitized.span_hash))
+
+    wall = time.monotonic() - t0
+    gates = {
+        "races_rule_repo_clean": not races_live,
+        "locksan_zero_violations": not san_report["violations"],
+        "no_runtime_edge_missing_from_static_graph": not missing,
+        "static_hot_edges_exercised": not unexercised_hot,
+        "sanitizer_transparent_to_replay": transparent,
+        "sim_invariants_clean_under_sanitizer": not sim_violations,
+    }
+    report = {
+        "metric": "dsrace_static_vs_runtime_lock_model_cross_validation",
+        "fleet_schedules": args.fleet_schedules,
+        "region_schedules": args.region_schedules,
+        "seed_base": args.seed_base,
+        "races_live_findings": [f.location() for f in races_live],
+        "static_lock_edges": sorted(f"{a} -> {b}"
+                                    for a, b in static_pairs),
+        "static_hot_edges": sorted(f"{a} -> {b}" for a, b in hot),
+        "observed_edges": san_report["edges"],
+        "observed_acquires": san_report["acquires"],
+        "runtime_edges_missing_from_static": [f"{a} -> {b}"
+                                              for a, b in missing],
+        "static_hot_edges_unexercised": [f"{a} -> {b}"
+                                         for a, b in unexercised_hot],
+        "sanitizer_violations": san_report["violations"],
+        "documented_order": list(DOCUMENTED_LOCK_ORDER),
+        "wall_s": round(wall, 2),
+        "gates": gates,
+        "value": len(missing) + len(san_report["violations"]),
+    }
+    from _artifact import write_artifact
+
+    rnd = os.environ.get("RACE_ROUND", "r01")
+    path = write_artifact("RACE", report, device="host-sim",
+                          path=os.path.join(HERE, f"RACE_{rnd}.json"))
+    print(f"[race-lane] static edges: {len(static_pairs)} "
+          f"({len(hot)} hot), observed: {len(observed)}, "
+          f"violations: {len(san_report['violations'])}, "
+          f"missing-from-static: {len(missing)} in {wall:.1f}s")
+    print(f"[race-lane] artifact: {path}")
+    failed = [g for g, ok in gates.items() if not ok]
+    if failed:
+        print(f"race lane: FAILED gates {failed}")
+        for e in missing:
+            print(f"  runtime edge missing from static graph: "
+                  f"{e[0]} -> {e[1]}")
+        for v in san_report["violations"][:5]:
+            print(f"  sanitizer violation: {v}")
+        return 1
+    print("race lane: OK — static races rule clean, runtime lock edges "
+          "all present in the static graph, hot edges exercised, "
+          "sanitizer transparent")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""dslint fixture: PLANTED lockset races.
+
+A worker thread (``threading.Thread(target=self._loop)``) and the
+caller-facing surface share ``done``/``status`` with no common lock:
+
+* ``done`` — written unlocked by BOTH roles (write-write) and read by
+  the public ``report`` (read-write); both findings anchor at the
+  first racy write, in ``_loop``.
+* ``status`` — written under the lock by ``submit`` but read unlocked
+  in ``_loop``: the finding anchors at the UNLOCKED side (the read).
+"""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.done = 0                 # init publish: never flagged
+        self.status = "idle"
+        self._thread = threading.Thread(target=self._loop,
+                                        name="worker-loop")
+        self._thread.start()
+
+    def _loop(self):
+        for _ in range(100):
+            self.done += 1                    # PLANT: write-write + read-write
+            if self.status == "stopping":     # PLANT: read-write
+                break
+
+    def submit(self, state):
+        self.done += 1
+        with self._lock:
+            self.status = state
+
+    def report(self):
+        return self.done

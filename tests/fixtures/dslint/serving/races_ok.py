@@ -1,0 +1,72 @@
+"""dslint fixture: the near-miss twin of races_bad.py — every shared
+access uses a recognized safe idiom, so the races rule must stay
+silent:
+
+* ``done`` — every access under the ONE lock (including via
+  ``_bump_locked``, which takes no lock itself: its entry lockset is
+  inferred from its call sites);
+* ``status`` — only touched inside ``_bump_locked`` (entry-lockset
+  protected);
+* ``_inbox`` — ``queue.Queue`` hand-off;
+* ``_stopped`` — one-shot latch (every write assigns the same
+  constant);
+* ``limit`` — written only in ``__init__`` (publish before the thread
+  starts).
+"""
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.done = 0
+        self.status = "idle"
+        self.limit = 100
+        self._inbox = queue.Queue()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="worker-loop")
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stopped:
+            item = self._inbox.get()
+            if item is None:
+                break
+            with self._lock:
+                self.done += 1
+                self._bump_locked()
+
+    def _bump_locked(self):
+        # no lock taken HERE — every call site holds self._lock, which
+        # the rule's entry-lockset analysis must infer
+        self.status = self.status + "."
+
+    def submit(self, item):
+        if self.limit <= 0:
+            return
+        self._inbox.put(item)
+        with self._lock:
+            self.done += 1
+            self._bump_locked()
+
+    def drain(self):
+        # a closure defined (and only callable) inside the locked
+        # region: its self-accesses must not be mis-attributed to this
+        # method without the lock context (they belong to the
+        # closure's own function, covered via its entry lockset)
+        with self._lock:
+            def flush():
+                self.done += 1
+                return self.status
+
+            return flush()
+
+    def stop(self):
+        self._stopped = True
+        self._inbox.put(None)
+
+    def report(self):
+        with self._lock:
+            return self.done

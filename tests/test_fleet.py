@@ -551,6 +551,38 @@ def test_fleet_autoscale_once_uses_shared_policy(model_and_params):
     fleet.close(timeout=5.0)
 
 
+def test_autoscale_interval_check_is_atomic_and_single_fire(
+        model_and_params):
+    """Regression (PR 15 dsrace fix): poll()'s autoscale interval
+    check-then-stamp runs under the fleet lock — N concurrent polls
+    within one interval produce exactly one decision, and the next
+    interval fires exactly once again."""
+    import threading as th
+
+    from deepspeed_tpu.resilience.clock import SimClock
+
+    clock = SimClock()
+    clock.advance(100.0)
+    fleet = ServingFleet(_make_factory(model_and_params),
+                         {"replicas": 1, "autoscale": True,
+                          "autoscale_interval_s": 10.0},
+                         {"policy": "slo"}, start=False, clock=clock)
+    calls = []
+    fleet.autoscale_once = lambda: (calls.append(1), 1)[1]
+    threads = [th.Thread(target=fleet.poll) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(calls) == 1          # one interval, one decision
+    fleet.poll()
+    assert len(calls) == 1          # still inside the interval
+    clock.advance(10.0)
+    fleet.poll()
+    assert len(calls) == 2          # next interval: exactly once more
+    fleet.close(timeout=5.0)
+
+
 def test_kv_demand_ignores_reclaimable_cache(model_and_params):
     # a warm prefix cache is capacity, not pressure: kv_occupancy counts
     # it (allocator truth), kv_demand must not (autoscaler signal)

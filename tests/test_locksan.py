@@ -1,0 +1,297 @@
+"""Runtime lock-order sanitizer (resilience/locksan.py) — the dynamic
+half of dsrace.
+
+Covers: the construction seam (plain locks when disabled, wrappers
+when installed), planted order inversions and cycles caught on VIRTUAL
+time, re-entrancy, same-tier nesting, non-LIFO release, self-deadlock
+surfacing, per-thread stacks with real threads, and the
+cross-validation teeth — a real DST schedule's observed edges must be
+a subset of dslint's static lock graph, and the sanitizer must be
+invisible to the deterministic replay hashes.
+"""
+
+import os
+import threading
+
+import pytest
+
+from deepspeed_tpu.resilience.clock import SimClock, use_clock
+from deepspeed_tpu.resilience.locksan import (LockOrderViolation,
+                                              LockSanitizer, SanLock,
+                                              SanRLock, get_locksan,
+                                              named_lock, named_rlock,
+                                              use_locksan)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "deepspeed_tpu")
+
+
+# -- construction seam ---------------------------------------------------
+
+def test_named_locks_are_plain_primitives_when_disabled():
+    assert get_locksan() is None
+    lk = named_lock("X._lock")
+    rlk = named_rlock("X._lock")
+    assert not isinstance(lk, SanLock)
+    assert not isinstance(rlk, SanRLock)
+    with lk:
+        pass
+    with rlk:
+        with rlk:       # still reentrant
+            pass
+
+
+def test_named_locks_are_instrumented_under_sanitizer():
+    with use_locksan() as san:
+        rlk = named_rlock("ServingEngine._lock")
+        assert isinstance(rlk, SanRLock)
+        with rlk:
+            assert san.held_names() == ["ServingEngine._lock"]
+        assert san.held_names() == []
+        assert san.acquires["ServingEngine._lock"] == 1
+    assert get_locksan() is None
+
+
+# -- order / cycle checks ------------------------------------------------
+
+def test_planted_order_inversion_is_caught():
+    with use_locksan() as san:
+        fleet = named_rlock("ServingFleet._lock")
+        replica = named_rlock("ServingEngine._lock")
+        # documented order is fleet -> replica; do the reverse
+        with replica:
+            with fleet:
+                pass
+    [v] = [v for v in san.violations if v["kind"] == "order-inversion"]
+    assert v["outer"] == "ServingEngine._lock"
+    assert v["inner"] == "ServingFleet._lock"
+    assert ("ServingEngine._lock",
+            "ServingFleet._lock") in san.edge_pairs()
+
+
+def test_documented_order_is_clean():
+    with use_locksan() as san:
+        region = named_rlock("Region._lock")
+        cell = named_rlock("ServingCell._lock")
+        fleet = named_rlock("ServingFleet._lock")
+        replica = named_rlock("ServingEngine._lock")
+        with region, cell, fleet, replica:
+            pass
+    assert san.violations == []
+    assert ("Region._lock", "ServingCell._lock") in san.edge_pairs()
+    assert ("ServingFleet._lock",
+            "ServingEngine._lock") in san.edge_pairs()
+
+
+def test_planted_cycle_caught_on_virtual_time():
+    """A -> B then (later, same thread, sequentially — no deadlock at
+    runtime) B -> A: the cycle is two schedules from a deadlock, and
+    the violation is stamped with the VIRTUAL instant the closing edge
+    was observed."""
+    clock = SimClock()
+    with use_clock(clock), use_locksan() as san:
+        a = named_rlock("A._lock")
+        b = named_rlock("B._lock")
+        with a:
+            with b:
+                pass
+        clock.advance(7.0)
+        with b:
+            with a:
+                pass
+    [v] = [v for v in san.violations if v["kind"] == "lock-cycle"]
+    assert "A._lock" in v["cycle"] and "B._lock" in v["cycle"]
+    assert v["vt"] == 7.0
+    # edge metadata carries first-observation virtual stamps too
+    assert san.edges[("A._lock", "B._lock")].first_vt == 0.0
+    assert san.edges[("B._lock", "A._lock")].first_vt == 7.0
+
+
+def test_same_tier_nesting_flagged():
+    with use_locksan() as san:
+        r1 = named_rlock("ServingEngine._lock")
+        r2 = named_rlock("ServingEngine._lock")
+        with r1:
+            with r2:
+                pass
+    assert [v["kind"] for v in san.violations] == ["same-tier-nesting"]
+
+
+def test_reentrant_acquire_records_no_edge_or_violation():
+    with use_locksan() as san:
+        rlk = named_rlock("ServingFleet._lock")
+        with rlk:
+            with rlk:
+                pass
+    assert san.violations == []
+    assert san.edge_pairs() == set()
+    assert san.acquires["ServingFleet._lock"] == 2
+
+
+def test_non_lifo_release_is_legal():
+    with use_locksan() as san:
+        a = named_rlock("A._lock")
+        b = named_rlock("B._lock")
+        a.acquire()
+        b.acquire()
+        a.release()
+        assert san.held_names() == ["B._lock"]
+        b.release()
+    assert san.violations == []
+
+
+def test_self_deadlock_on_plain_lock_raises_instead_of_hanging():
+    with use_locksan() as san:
+        lk = named_lock("M._lock")
+        lk.acquire()
+        with pytest.raises(LockOrderViolation):
+            lk.acquire()
+        lk.release()
+    assert [v["kind"] for v in san.violations] == ["self-deadlock"]
+
+
+def test_per_thread_stacks_with_real_threads():
+    """Holding A on one thread must not manufacture an A -> B edge for
+    an acquisition on another thread."""
+    san = LockSanitizer()
+    a = SanRLock("A._lock", san)
+    b = SanRLock("B._lock", san)
+    a_held = threading.Event()
+    done = threading.Event()
+
+    def other():
+        a_held.wait(5)
+        with b:
+            pass
+        done.set()
+
+    t = threading.Thread(target=other, name="locksan-test")
+    t.start()
+    with a:
+        a_held.set()
+        assert done.wait(5)
+    t.join(5)
+    assert san.edge_pairs() == set()
+    assert san.violations == []
+    assert san.edges == {}
+
+
+def test_strict_mode_raises_on_inversion():
+    with use_locksan(strict=True):
+        fleet = named_rlock("ServingFleet._lock")
+        replica = named_rlock("ServingEngine._lock")
+        with replica:
+            with pytest.raises(LockOrderViolation):
+                with fleet:
+                    pass
+
+
+def test_documented_order_matches_static_rule():
+    """The runtime sanitizer and the static lock-discipline rule must
+    assert the SAME order — a tier added to one but not the other would
+    silently weaken the cross-validation lane (locksan cannot import
+    the analysis package at runtime, so the constants are mirrored and
+    pinned equal here)."""
+    from deepspeed_tpu.analysis.rules import locks as static_locks
+    from deepspeed_tpu.resilience import locksan
+
+    assert tuple(locksan.DOCUMENTED_LOCK_ORDER) \
+        == tuple(static_locks.DOCUMENTED_LOCK_ORDER)
+
+
+def test_chaos_one_shot_kill_fires_exactly_once_across_threads():
+    """Regression (PR 15 review): the injector's one-shot replica/cell
+    death check and its ledger flip happen in ONE mutex section — N
+    concurrent monitor polls get exactly one True."""
+    from deepspeed_tpu.resilience.chaos import FaultInjector
+
+    for method, kind in (("should_kill_replica", "replica_death"),
+                         ("should_kill_cell", "cell_outage")):
+        inj = FaultInjector(replica_die_at_tick=0, replica_die_index=0,
+                            cell_die_at_tick=0, cell_die_index=0)
+        results = []
+        barrier = threading.Barrier(6)
+
+        def probe():
+            barrier.wait(5)
+            results.append(getattr(inj, method)(0, 5))
+
+        threads = [threading.Thread(target=probe) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results.count(True) == 1, (method, results)
+        assert inj.injected[kind] == 1
+
+
+def test_report_shape():
+    with use_locksan() as san:
+        a = named_rlock("ServingFleet._lock")
+        b = named_rlock("ServingEngine._lock")
+        with a:
+            with b:
+                pass
+    rep = san.report()
+    [edge] = rep["edges"]
+    assert edge["outer"] == "ServingFleet._lock"
+    assert edge["inner"] == "ServingEngine._lock"
+    assert edge["count"] == 1 and edge["threads"]
+    assert rep["violations"] == []
+    assert rep["order"][0] == "Region._lock"
+
+
+# -- cross-validation against the static model + the real stack ---------
+
+def test_dst_schedule_edges_subset_of_static_graph():
+    """The lane's core teeth, in tier-1: drive the REAL ServingFleet
+    through a seeded DST schedule with the sanitizer on — every
+    observed lock edge must exist in dslint's static lock graph, with
+    zero runtime violations."""
+    from deepspeed_tpu.analysis.model import build_package_model
+    from deepspeed_tpu.analysis.rules.locks import collect_lock_graph
+    from deepspeed_tpu.resilience.dst import generate_schedule, run_schedule
+
+    with use_locksan() as san:
+        report = run_schedule(generate_schedule(3))
+    assert report.ok
+    assert san.violations == []
+    observed = san.edge_pairs()
+    assert observed, "the schedule should nest fleet -> replica locks"
+    static = set(collect_lock_graph(
+        build_package_model([PKG], base=REPO)))
+    missing = observed - static
+    assert not missing, f"static lock-graph false negatives: {missing}"
+
+
+def test_sanitizer_transparent_to_deterministic_replay():
+    from deepspeed_tpu.resilience.dst import generate_schedule, run_schedule
+
+    plain = run_schedule(generate_schedule(11))
+    with use_locksan():
+        sanitized = run_schedule(generate_schedule(11))
+    assert (plain.trace_hash, plain.span_hash) \
+        == (sanitized.trace_hash, sanitized.span_hash)
+
+
+def test_real_threaded_fleet_clean_under_sanitizer():
+    """Real driver/monitor threads (not the manual-step seam) under the
+    sanitizer: a submitted request completes and the run records zero
+    violations."""
+    from deepspeed_tpu.resilience.dst import SimConfig, SimEngine
+    from deepspeed_tpu.serving.fleet import ServingFleet
+
+    with use_locksan() as san:
+        fleet = ServingFleet(
+            lambda: SimEngine(SimConfig()),
+            {"replicas": 2, "autoscale": False},
+            {"policy": "fcfs", "poll_interval_s": 0.002},
+            start=True)
+        try:
+            req = fleet.submit([3, 1, 2], max_new_tokens=4)
+            assert req.result(timeout=20) is not None
+        finally:
+            fleet.close(timeout=20)
+    assert san.violations == []
+    assert ("ServingFleet._lock",
+            "ServingEngine._lock") in san.edge_pairs()

@@ -390,6 +390,37 @@ def test_heal_rebalance_respreads_queued_work():
         clock.pump = None
 
 
+def test_partition_epoch_handled_exactly_once():
+    """Regression (PR 15 dsrace fix): the partition-epoch
+    check-then-stamp in _check_partitions runs under the region lock —
+    concurrent monitor/manual polls after a heal trigger the rebalance
+    exactly once, and repeated polls within one epoch are no-ops."""
+    import threading as th
+
+    inj = install_fault_injector(FaultInjector())
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=2, replicas=1)
+        rebalances = []
+        region._rebalance = lambda: rebalances.append(1)
+        inj.sever({region.name}, {"cell-1"})
+        region.poll()                      # partition detected
+        assert region._partition_active
+        inj.heal_partitions()
+        threads = [th.Thread(target=region._check_partitions)
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(rebalances) == 1        # one heal, one rebalance
+        region._check_partitions()
+        assert len(rebalances) == 1        # same epoch: no-op
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+
+
 # ----------------------------------------------------------------------
 # brownout
 # ----------------------------------------------------------------------

@@ -27,6 +27,18 @@ def fixture(name):
     return os.path.join(FIXTURES, name)
 
 
+# whole-repo model builds and rule runs cost seconds each — the
+# repo-wide assertions share ONE of each (tier-1 budget discipline)
+@pytest.fixture(scope="module")
+def repo_pkg():
+    return build_package_model([PKG], base=REPO)
+
+
+@pytest.fixture(scope="module")
+def repo_findings():
+    return analyze([PKG], base=REPO)
+
+
 def planted_lines(name):
     with open(fixture(name)) as fh:
         return {i for i, line in enumerate(fh, 1) if "PLANT:" in line}
@@ -45,7 +57,7 @@ def test_rule_catalog():
     assert set(rules) == {"host-sync", "trace-hygiene",
                           "recompile-hazard", "lock-discipline",
                           "exception-discipline", "wall-clock",
-                          "comm-facade"}
+                          "comm-facade", "races"}
     assert "suppression" in known_rule_ids()
     for cls in rules.values():
         assert cls.summary
@@ -77,6 +89,12 @@ def test_rule_catalog():
     # with raw jax.lax collectives
     ("comm-facade", os.path.join("comm", "backends_bad.py"),
      os.path.join("comm", "backends_ok.py")),
+    # dsrace lockset analysis: a worker thread + public surface racing
+    # on shared attributes; the ok twin exercises every safe idiom
+    # (one lock, entry-lockset inference, queue hand-off, one-shot
+    # latch, init publish)
+    ("races", os.path.join("serving", "races_bad.py"),
+     os.path.join("serving", "races_ok.py")),
 ])
 def test_rule_golden(rule, bad, ok):
     bad_found = live(analyze([fixture(bad)]), rule)
@@ -169,6 +187,13 @@ def test_wall_clock_out_of_scope_module_is_ignored():
     # legitimately reads wall time)
     found = live(analyze([fixture("host_sync_bad.py")]), "wall-clock")
     assert found == []
+
+
+def test_races_subchecks_all_fire():
+    codes = {f.code
+             for f in live(analyze([fixture(os.path.join(
+                 "serving", "races_bad.py"))]), "races")}
+    assert {"write-write", "read-write"} == codes
 
 
 def test_exception_subchecks_all_fire():
@@ -278,10 +303,10 @@ def test_fingerprints_survive_line_drift():
 
 # -- the repo gate ------------------------------------------------------
 
-def test_repo_package_is_clean_under_committed_baseline():
+def test_repo_package_is_clean_under_committed_baseline(repo_findings):
     """The CI gate invariant: zero unsuppressed, un-baselined findings
     on the shipped package, and no stale baseline entries."""
-    fs = analyze([PKG], base=REPO)
+    fs = repo_findings
     stale = Baseline.load(os.path.join(REPO,
                                        "dslint_baseline.json")).absorb(fs)
     problems = live(fs)
@@ -293,17 +318,16 @@ def test_repo_package_is_clean_under_committed_baseline():
                        "run --update-baseline"
 
 
-def test_every_shipped_suppression_has_a_reason():
+def test_every_shipped_suppression_has_a_reason(repo_findings):
     # reasonless suppressions surface as findings; the gate test above
     # would catch them — this asserts the stronger property directly
-    fs = analyze([PKG], base=REPO)
-    assert not [f for f in fs if f.rule == "suppression"]
+    assert not [f for f in repo_findings if f.rule == "suppression"]
 
 
 # -- traced-set spot checks against the real codebase -------------------
 
-def test_traced_set_on_real_engine():
-    pkg = build_package_model([PKG], base=REPO)
+def test_traced_set_on_real_engine(repo_pkg):
+    pkg = repo_pkg
     traced = {k for k, f in pkg.functions.items()
               if f.traced_reason is not None}
 
@@ -320,14 +344,96 @@ def test_traced_set_on_real_engine():
     assert se and "_lock" in se[0].lock_attrs
 
 
-def test_lock_graph_documented_order_holds_in_repo():
+def test_lock_graph_documented_order_holds_in_repo(repo_findings):
     """No replica->fleet edge and no cycle exists in the shipped code —
     the discipline docs/serving.md documents, now machine-checked."""
-    fs = analyze([PKG], base=REPO)
-    assert not [f for f in fs
+    assert not [f for f in repo_findings
                 if f.rule == "lock-discipline"
                 and f.code in ("order-violation", "lock-cycle")
                 and not f.suppressed and not f.baselined]
+
+
+# -- thread model + weak-resolution spot checks (dsrace, PR 15) ---------
+
+def test_thread_model_discovers_serving_entry_points(repo_pkg):
+    pkg = repo_pkg
+    by_role = {e.role: e.func_key for e in pkg.thread_entries}
+    assert by_role["serving-driver"].endswith("ServingEngine._drive")
+    assert by_role["serving-watchdog"].endswith("ServingEngine._watch")
+    assert by_role["fleet-monitor"].endswith("ServingFleet._monitor_loop")
+    assert by_role["region-monitor"].endswith("Region._monitor_loop")
+    assert "finalizer" in by_role        # dataloader weakref.finalize
+
+    def roles_of(suffix):
+        [f] = [f for k, f in pkg.functions.items() if k.endswith(suffix)]
+        return f.thread_roles
+
+    # the driver loop runs ONLY on its thread; the tick body runs on
+    # the driver AND via the public step() seam (caller threads)
+    assert roles_of("ServingEngine._drive") == {"serving-driver"}
+    assert {"serving-driver", "main"} <= roles_of("ServingEngine._tick")
+    # roles propagate through the call graph into shared helpers
+    assert {"serving-driver", "main"} <= roles_of("ServingEngine._retire")
+
+
+def test_weak_resolution_blocklist_covers_new_method_names(repo_pkg):
+    """PR-15 refresh: `step`/`route`/`adopt`/`evacuate`/`publish` are
+    common serving-tier verbs — a weak (unique-bare-name) resolution of
+    any of them would hijack unrelated call sites. Pinned both in the
+    blocklist constant and as a behavioral property of the built
+    model: no weak edge ever targets a blocklisted name."""
+    from deepspeed_tpu.analysis.model import _WEAK_RESOLVE_BLOCKLIST
+
+    assert {"step", "route", "adopt", "evacuate",
+            "publish"} <= _WEAK_RESOLVE_BLOCKLIST
+    pkg = repo_pkg
+    for f in pkg.functions.values():
+        for site in f.calls:
+            if site.weak:
+                for t in site.targets:
+                    assert pkg.functions[t].name \
+                        not in _WEAK_RESOLVE_BLOCKLIST, (
+                            f"weak edge {f.key} -> {t} resolves a "
+                            f"blocklisted name")
+
+
+def test_static_lock_graph_sees_property_edges(repo_pkg):
+    """The cross-validation contract's static half: the fleet's gauge
+    pass acquires replica locks through @property reads, and the
+    region's route path acquires cell locks through the digest
+    property — both edges must exist in the static lock graph, or the
+    runtime sanitizer's observations would (rightly) fail the lane."""
+    from deepspeed_tpu.analysis.rules.locks import collect_lock_graph
+
+    graph = collect_lock_graph(repo_pkg)
+    assert ("ServingFleet._lock", "ServingEngine._lock") in graph
+    assert ("Region._lock", "ServingCell._lock") in graph
+
+
+def test_locksan_seam_keeps_lock_model_intact(repo_pkg):
+    """Serving locks are built through resilience/locksan.named_rlock;
+    the static model must keep seeing them as RLock attributes (the
+    whole lock-discipline + races machinery keys off lock_attrs)."""
+    for cls_name in ("ServingEngine", "ServingFleet", "ServingCell",
+                     "Region"):
+        [c] = [c for c in repo_pkg.classes.values()
+               if c.name == cls_name]
+        assert c.lock_attrs.get("_lock") == "RLock", cls_name
+
+
+def test_races_rule_fixed_sites_stay_clean(repo_findings):
+    """Regression pins for the PR-15 triage fixes: the attributes whose
+    races were FIXED (not suppressed) must not re-fire — a revert of
+    any fix shows up here by name, not just as a gate count."""
+    fs = repo_findings
+    fixed_attrs = {"_last_autoscale", "_pending_engine",
+                   "_partition_epoch_seen", "_partition_active",
+                   "route_work_last", "_spec_ema_by_class",
+                   "_last_gauges", "_remaining", "_partitions"}
+    hits = [f for f in fs if f.rule == "races"
+            and any(f".{a}:" in f.message for a in fixed_attrs)]
+    assert not hits, "\n".join(f"  {f.location()}: {f.message}"
+                               for f in hits)
 
 
 # -- CLI ----------------------------------------------------------------
@@ -359,6 +465,60 @@ def test_cli_list_rules(capsys):
     assert main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("host-sync", "trace-hygiene", "recompile-hazard",
-                "lock-discipline", "exception-discipline",
+                "lock-discipline", "exception-discipline", "races",
                 "suppression"):
         assert rid in out
+
+
+def test_cli_changed_mode(tmp_path, capsys, monkeypatch):
+    """--changed analyzes only files changed vs HEAD (the pre-commit
+    fast mode) and stays quiet about cross-module 'unused suppression'
+    verdicts a scoped model cannot judge."""
+    import shutil
+    import subprocess
+
+    repo = tmp_path / "r"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=repo, check=True,
+                       capture_output=True,
+                       env={**os.environ,
+                            "GIT_AUTHOR_NAME": "t",
+                            "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t"})
+
+    git("init", "-q")
+    (repo / "clean.py").write_text("x = 1\n")
+    git("add", "clean.py")
+    git("commit", "-qm", "seed")
+    monkeypatch.chdir(repo)
+
+    # nothing changed: trivially green
+    assert main(["--changed", "--check"]) == 0
+    assert "no changed python files" in capsys.readouterr().out
+
+    # an UNTRACKED file with a planted finding fails the changed gate
+    shutil.copy(fixture("host_sync_bad.py"), repo / "bad.py")
+    assert main(["--changed", "--check"]) == 1
+    out = capsys.readouterr().out
+    assert "bad.py" in out and "clean.py" not in out
+    (repo / "bad.py").unlink()
+
+    # a MODIFIED tracked file is picked up too: plant a finding into
+    # the tracked file and the gate must flip to FAIL
+    bad_src = open(fixture("host_sync_bad.py")).read()
+    (repo / "clean.py").write_text(bad_src)
+    assert main(["--changed", "--check"]) == 1
+    assert "clean.py" in capsys.readouterr().out
+
+    # ...and from a SUBDIRECTORY: git paths are repo-root relative, so
+    # --changed must still see the change (regression: joining them
+    # against the cwd dropped every file outside the subdir and
+    # green-lit the gate)
+    sub = repo / "pkg"
+    sub.mkdir()
+    monkeypatch.chdir(sub)
+    assert main(["--changed", "--check"]) == 1
+    assert "clean.py" in capsys.readouterr().out
