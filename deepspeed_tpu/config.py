@@ -829,6 +829,15 @@ class ChaosConfig:
     # delay every fleet autoscaler decision by this many (virtual)
     # seconds — models real controller observe/decide/boot lag
     autoscaler_lag_s: float = 0.0
+    # rollout-targeted faults (serving/rollout.py): corrupt the next N
+    # hot-swap weight loads (the swap must fall back to the old version,
+    # the controller must retry/rollback — never strand the replica);
+    # kill the replica being flipped on the Nth flip (1-based, one-shot,
+    # -1 disables); stall every other engine tick of one model version
+    # (the injected canary SLO regression auto-rollback is gated on)
+    corrupt_swap_count: int = 0
+    die_at_flip: int = -1
+    degrade_version: int = -1
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ChaosConfig":
@@ -856,11 +865,18 @@ class ChaosConfig:
             cell_die_at_tick=int(_take(d, "cell_die_at_tick", -1)),
             cell_die_index=int(_take(d, "cell_die_index", 0)),
             autoscaler_lag_s=float(_take(d, "autoscaler_lag_s", 0.0)),
+            corrupt_swap_count=int(_take(d, "corrupt_swap_count", 0)),
+            die_at_flip=int(_take(d, "die_at_flip", -1)),
+            degrade_version=int(_take(d, "degrade_version", -1)),
         )
         if out.autoscaler_lag_s < 0:
             raise ConfigError(
                 f"resilience.chaos.autoscaler_lag_s must be >= 0, got "
                 f"{out.autoscaler_lag_s}")
+        if out.corrupt_swap_count < 0:
+            raise ConfigError(
+                f"resilience.chaos.corrupt_swap_count must be >= 0, got "
+                f"{out.corrupt_swap_count}")
         _warn_unknown(d, "resilience.chaos")
         return out
 
@@ -1084,6 +1100,80 @@ class RegionConfig:
 
 
 @dataclass
+class RolloutConfig:
+    """The ``serving.rollout`` block: zero-downtime model rollout
+    (docs/serving.md "Rollout, canary, and migration").
+
+    ``canary_fraction`` is the tenant-sticky traffic slice routed to the
+    canary version while the controller observes it.  The canary is
+    judged after ``canary_observe_ticks`` controller steps: if the
+    canary's in-SLA ratio sits more than ``slo_regression_threshold``
+    below the stable version's over at least ``min_canary_samples``
+    retired requests, the rollout rolls back automatically; otherwise it
+    promotes.  ``warmup_ticks`` is the post-swap AOT warmup countdown a
+    flipped replica serves through before re-opening admission.
+    ``swap_retry_limit`` bounds hot-swap retries per replica (a corrupt
+    new-version checkpoint falls back to the old weights each time);
+    ``max_flip_attempts`` bounds how often the controller re-targets a
+    flip after the victim dies mid-flip — past either bound the rollout
+    rolls back instead of wedging."""
+
+    canary_fraction: float = 0.10
+    canary_observe_ticks: int = 40
+    slo_regression_threshold: float = 0.20
+    min_canary_samples: int = 8
+    warmup_ticks: int = 2
+    swap_retry_limit: int = 2
+    max_flip_attempts: int = 4
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "RolloutConfig":
+        if not d:
+            return cls()
+        d = dict(d)
+        out = cls(
+            canary_fraction=float(_take(d, "canary_fraction", 0.10)),
+            canary_observe_ticks=int(_take(d, "canary_observe_ticks", 40)),
+            slo_regression_threshold=float(
+                _take(d, "slo_regression_threshold", 0.20)),
+            min_canary_samples=int(_take(d, "min_canary_samples", 8)),
+            warmup_ticks=int(_take(d, "warmup_ticks", 2)),
+            swap_retry_limit=int(_take(d, "swap_retry_limit", 2)),
+            max_flip_attempts=int(_take(d, "max_flip_attempts", 4)),
+        )
+        if not 0.0 < out.canary_fraction <= 1.0:
+            raise ConfigError(
+                f"serving.rollout.canary_fraction must be in (0, 1], got "
+                f"{out.canary_fraction}")
+        if out.canary_observe_ticks < 1:
+            raise ConfigError(
+                f"serving.rollout.canary_observe_ticks must be >= 1, got "
+                f"{out.canary_observe_ticks}")
+        if not 0.0 <= out.slo_regression_threshold <= 1.0:
+            raise ConfigError(
+                f"serving.rollout.slo_regression_threshold must be in "
+                f"[0, 1], got {out.slo_regression_threshold}")
+        if out.min_canary_samples < 1:
+            raise ConfigError(
+                f"serving.rollout.min_canary_samples must be >= 1, got "
+                f"{out.min_canary_samples}")
+        if out.warmup_ticks < 0:
+            raise ConfigError(
+                f"serving.rollout.warmup_ticks must be >= 0, got "
+                f"{out.warmup_ticks}")
+        if out.swap_retry_limit < 0:
+            raise ConfigError(
+                f"serving.rollout.swap_retry_limit must be >= 0, got "
+                f"{out.swap_retry_limit}")
+        if out.max_flip_attempts < 1:
+            raise ConfigError(
+                f"serving.rollout.max_flip_attempts must be >= 1, got "
+                f"{out.max_flip_attempts}")
+        _warn_unknown(d, "serving.rollout")
+        return out
+
+
+@dataclass
 class ServingConfig:
     """The ``serving`` block: knobs for the request front-end over the
     ragged engine (docs/serving.md).
@@ -1130,8 +1220,12 @@ class ServingConfig:
     spec_floor_min_proposed: int = 16
     spec_ema: float = 0.25
     kv_quant: str = "none"
+    # the model version the fleet starts serving (serving/rollout.py);
+    # monotonically bumped by rollouts, never by config reload
+    model_version: int = 0
     fleet: FleetConfig = field(default_factory=FleetConfig)
     region: RegionConfig = field(default_factory=RegionConfig)
+    rollout: RolloutConfig = field(default_factory=RolloutConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
@@ -1141,6 +1235,7 @@ class ServingConfig:
         out = cls(
             fleet=FleetConfig.from_dict(_take(d, "fleet", None)),
             region=RegionConfig.from_dict(_take(d, "region", None)),
+            rollout=RolloutConfig.from_dict(_take(d, "rollout", None)),
             max_queue=int(_take(d, "max_queue", 256)),
             policy=str(_take(d, "policy", "slo")),
             kv_pressure=float(_take(d, "kv_pressure", 0.90)),
@@ -1160,7 +1255,12 @@ class ServingConfig:
                 _take(d, "spec_floor_min_proposed", 16)),
             spec_ema=float(_take(d, "spec_ema", 0.25)),
             kv_quant=str(_take(d, "kv_quant", "none")),
+            model_version=int(_take(d, "model_version", 0)),
         )
+        if out.model_version < 0:
+            raise ConfigError(
+                f"serving.model_version must be >= 0, got "
+                f"{out.model_version}")
         if out.policy not in ("slo", "fcfs"):
             raise ConfigError(
                 f"serving.policy must be 'slo' or 'fcfs', got '{out.policy}'")

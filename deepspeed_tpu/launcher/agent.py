@@ -41,14 +41,30 @@ from ..utils.fileio import write_json_atomic
 from ..utils.logging import logger
 
 
-def classify_exit(returncode: int) -> str:
-    """Human-readable restart reason from a worker's return code."""
+#: exit code a worker uses to request a PLANNED restart: a zero-downtime
+#: rollout (serving/rollout.py) that cannot hot-swap in place re-execs
+#: the worker to load the new weights. Restarts so classified consume no
+#: restart budget and skip the backoff ladder — a flip is an intentional
+#: reload, not an incident, and must not look like a crash loop to the
+#: agent or like a hang to the stall watchdog reading the heartbeat.
+PLANNED_ROLLOUT_EXIT = 86
+
+
+def classify_exit(returncode: int,
+                  planned_codes: Sequence[int] = (PLANNED_ROLLOUT_EXIT,)
+                  ) -> str:
+    """Human-readable restart reason from a worker's return code.
+    Three families: ``signal:<name>`` (killed), ``planned:rollout``
+    (worker-requested reload — see :data:`PLANNED_ROLLOUT_EXIT`), and
+    ``exit:<rc>`` (everything else)."""
     if returncode < 0:
         try:
             name = signal.Signals(-returncode).name
         except ValueError:
             name = str(-returncode)
         return f"signal:{name}"
+    if returncode in planned_codes:
+        return "planned:rollout"
     return f"exit:{returncode}"
 
 
@@ -58,6 +74,9 @@ class AgentReport:
     returncode: int
     history: List[int] = field(default_factory=list)
     reasons: List[str] = field(default_factory=list)
+    #: rollout-triggered reloads (``planned:*`` reasons) — relaunches
+    #: that consumed NO restart budget and slept no backoff
+    planned_restarts: int = 0
 
     @property
     def succeeded(self) -> bool:
@@ -78,9 +97,18 @@ class ElasticAgent:
                  env: Optional[dict] = None,
                  on_restart: Optional[Callable[[int], None]] = None,
                  sleep: Callable[[float], None] = time.sleep,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 planned_exit_codes: Sequence[int] = (
+                     PLANNED_ROLLOUT_EXIT,),
+                 max_planned_restarts: int = 64):
         self.cmd = list(cmd)
         self.max_restarts = max_restarts
+        # planned-reload taxonomy (serving/rollout.py flips): these exit
+        # codes relaunch immediately — no budget, no backoff. The
+        # separate generous cap is the runaway valve: a worker stuck
+        # exiting "planned" forever is a bug, not a rollout.
+        self.planned_exit_codes = tuple(planned_exit_codes)
+        self.max_planned_restarts = max_planned_restarts
         self.backoff_s = backoff_s
         self.backoff_multiplier = backoff_multiplier
         self.max_backoff_s = max_backoff_s
@@ -107,6 +135,11 @@ class ElasticAgent:
                "time": time.time()}
         if reason is not None:
             rec["reason"] = reason
+            # rollout-triggered reload: an external stall/crash-loop
+            # watchdog must read this window as ROUTINE (the flip IS the
+            # restart), not page on it
+            if reason.startswith("planned:"):
+                rec["planned"] = True
         if next_delay_s is not None:
             rec["next_delay_s"] = round(float(next_delay_s), 3)
         try:
@@ -118,8 +151,12 @@ class ElasticAgent:
         history: List[int] = []
         reasons: List[str] = []
         delay = self.backoff_s
-        for attempt in range(self.max_restarts + 1):
-            env = dict(self.env, DST_ELASTIC_RESTART=str(attempt))
+        attempt = 0          # FAILURE restarts consumed (budgeted)
+        planned = 0          # rollout reloads (free, capped separately)
+        launches = 0
+        while attempt <= self.max_restarts:
+            env = dict(self.env, DST_ELASTIC_RESTART=str(launches))
+            launches += 1
             self._write_status("running", attempt)
             t0 = time.monotonic()
             proc = subprocess.run(self.cmd, env=env)
@@ -128,15 +165,35 @@ class ElasticAgent:
             if proc.returncode == 0:
                 self._write_status("done", attempt)
                 return AgentReport(restarts=attempt, returncode=0,
-                                   history=history, reasons=reasons)
-            reason = classify_exit(proc.returncode)
+                                   history=history, reasons=reasons,
+                                   planned_restarts=planned)
+            reason = classify_exit(proc.returncode,
+                                   self.planned_exit_codes)
             reasons.append(reason)
+            from ..telemetry.registry import get_registry
+            if (reason.startswith("planned:")
+                    and planned < self.max_planned_restarts):
+                # rollout-triggered reload: relaunch NOW — no restart
+                # budget consumed, no backoff slept, and the failure
+                # backoff ladder is untouched (a flip mid-incident must
+                # not reset a crash loop's climbing delay). Beyond the
+                # planned cap the exit falls through to the failure path
+                # — a worker stuck "planning" forever is a crash loop
+                # wearing a flag.
+                planned += 1
+                get_registry().counter(
+                    f"resilience/restart_reasons/{reason}").inc()
+                logger.info(
+                    f"elastic agent: planned worker reload ({reason}, "
+                    f"#{planned}) — restarting without backoff")
+                self._write_status("restarting", attempt, reason=reason,
+                                   next_delay_s=0.0)
+                continue
             logger.warning(
                 f"elastic agent: worker failed ({reason}) "
                 f"(attempt {attempt + 1}/{self.max_restarts + 1})")
             if attempt < self.max_restarts:
                 from ..resilience import record_restart
-                from ..telemetry.registry import get_registry
 
                 record_restart()
                 get_registry().counter(
@@ -154,11 +211,12 @@ class ElasticAgent:
                 self._sleep(d)
                 delay = min(delay * self.backoff_multiplier,
                             self.max_backoff_s)
+            attempt += 1
         self._write_status("failed", self.max_restarts,
                            reason=reasons[-1] if reasons else None)
         return AgentReport(restarts=self.max_restarts,
                            returncode=history[-1], history=history,
-                           reasons=reasons)
+                           reasons=reasons, planned_restarts=planned)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

@@ -38,7 +38,15 @@ event so tests (tests/test_fault_tolerance.py) and the chaos smoke loop
   process (docs/serving.md "Region & cells");
 * :meth:`set_autoscaler_lag` — delays every fleet autoscaler decision by
   a fixed virtual interval (controller lag: real autoscalers observe,
-  deliberate and boot capacity minutes behind the demand curve).
+  deliberate and boot capacity minutes behind the demand curve);
+* rollout-targeted faults (serving/rollout.py): ``corrupt_swap_count`` /
+  :meth:`should_corrupt_swap` corrupts the next N hot-swap weight loads
+  (the swap must fall back to the old version and the controller must
+  retry or roll back — never strand the replica), ``die_at_flip`` /
+  :meth:`should_die_at_flip` kills the replica being flipped on the Nth
+  drained flip, and ``degrade_version`` / :meth:`should_degrade_tick`
+  stalls every other engine tick of one model version (the injected
+  canary SLO regression that auto-rollback is gated on).
 
 Faults raise :class:`InjectedFault` (a ``BaseException``) so retry helpers
 and broad ``except Exception`` recovery code never swallow an injected
@@ -110,7 +118,10 @@ class FaultInjector:
                  replica_die_index: int = 0,
                  cell_die_at_tick: int = -1,
                  cell_die_index: int = 0,
-                 autoscaler_lag_s: float = 0.0):
+                 autoscaler_lag_s: float = 0.0,
+                 corrupt_swap_count: int = 0,
+                 die_at_flip: int = -1,
+                 degrade_version: int = -1):
         fields = {
             "seed": seed,
             "crash_before_commit_at_save": crash_before_commit_at_save,
@@ -131,6 +142,9 @@ class FaultInjector:
             "cell_die_at_tick": cell_die_at_tick,
             "cell_die_index": cell_die_index,
             "autoscaler_lag_s": autoscaler_lag_s,
+            "corrupt_swap_count": corrupt_swap_count,
+            "die_at_flip": die_at_flip,
+            "degrade_version": degrade_version,
         }
         for name, default in fields.items():
             setattr(self, name,
@@ -140,6 +154,11 @@ class FaultInjector:
         self.save_count = 0
         self.injected: Dict[str, int] = {}
         self._collective_calls: Dict[str, int] = {}
+        # rollout-fault state: drained-flip ordinal counter (1-based,
+        # counted only while die_at_flip is armed) and the degraded
+        # version's tick parity counter
+        self._flip_calls = 0
+        self._degrade_calls = 0
         # active network partitions: (group_a, group_b) name sets. Nodes
         # in different groups of any active partition cannot reach each
         # other; nodes a partition does not mention are unaffected by it.
@@ -185,7 +204,8 @@ class FaultInjector:
                  "collective_delay_every", "serving_tick_fail_at",
                  "serving_tick_fail_every", "replica_die_at_tick",
                  "replica_die_index", "cell_die_at_tick",
-                 "cell_die_index", "autoscaler_lag_s"}
+                 "cell_die_index", "autoscaler_lag_s",
+                 "corrupt_swap_count", "die_at_flip", "degrade_version"}
         unknown = set(spec) - known
         if unknown:
             logger.warning(f"{CHAOS_ENV}: ignoring unknown keys {sorted(unknown)}")
@@ -358,6 +378,73 @@ class FaultInjector:
         self.autoscaler_lag_s = float(lag_s)
         self._count("autoscaler_lag")
         logger.warning(f"chaos: autoscaler decisions lagged by {lag_s}s")
+
+    # -- rollout faults (serving/rollout.py) -----------------------------
+    def arm_corrupt_swap(self, n: int = 1) -> None:
+        """Arm corruption of the next ``n`` hot-swap weight loads."""
+        with self._mu:
+            self.corrupt_swap_count = max(0, int(n))
+        logger.warning(f"chaos: next {n} hot-swap weight loads corrupt")
+
+    def should_corrupt_swap(self) -> bool:
+        """Injected corrupt new-version checkpoint, consumed one arm per
+        call. The hot-swap path must fall back to the OLD weights and
+        report failure — the replica keeps serving its current version,
+        never stranded half-swapped."""
+        with self._mu:
+            if self.corrupt_swap_count <= 0:
+                return False
+            self.corrupt_swap_count -= 1
+        self._count("corrupt_swap")
+        logger.warning("chaos: corrupting hot-swap weight load")
+        return True
+
+    def arm_flip_death(self, ordinal: int = 1) -> None:
+        """Kill the replica being flipped on the ``ordinal``-th (1-based)
+        drained flip attempted from now on; -1 disarms."""
+        with self._mu:
+            self.die_at_flip = int(ordinal)
+            self._flip_calls = 0
+        logger.warning(f"chaos: armed replica death at flip #{ordinal}")
+
+    def should_die_at_flip(self) -> bool:
+        """Injected replica death mid-flip: True exactly once, when the
+        rollout controller attempts its ``die_at_flip``-th drained flip.
+        The controller must re-target the flip (or roll back), never
+        wedge on the corpse."""
+        with self._mu:
+            if self.die_at_flip < 1:
+                return False
+            # ordinal equality is the one-shot: counted only while armed
+            self._flip_calls += 1
+            if self._flip_calls != self.die_at_flip:
+                return False
+        self._count("flip_death")
+        logger.warning("chaos: killing replica mid-flip")
+        return True
+
+    def degrade_model_version(self, version: int) -> None:
+        """Arm the injected canary SLO regression: every other engine
+        tick of replicas serving ``version`` makes no scheduling progress
+        (virtual time still advances), so the canary's in-SLA window
+        regresses ORGANICALLY while its work still completes — the
+        auto-rollback drain must be able to finish. -1 disarms."""
+        with self._mu:
+            self.degrade_version = int(version)
+            self._degrade_calls = 0
+        if int(version) >= 0:
+            self._count("canary_degrade")
+            logger.warning(f"chaos: degrading model version {version} "
+                           f"(every other tick stalls)")
+
+    def should_degrade_tick(self, version: int) -> bool:
+        """Whether THIS engine tick of a replica serving ``version``
+        should stall (see :meth:`degrade_model_version`)."""
+        with self._mu:
+            if self.degrade_version < 0 or version != self.degrade_version:
+                return False
+            self._degrade_calls += 1
+            return self._degrade_calls % 2 == 0
 
     def on_collective(self, op: str) -> None:
         n = self._collective_calls.get(op, 0) + 1

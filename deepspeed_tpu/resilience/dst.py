@@ -858,6 +858,50 @@ def generate_region_schedule(seed: int) -> RegionSchedule:
     kvq = rng.choice(["none", "none", "int8", "int4"])
     engine_cfg["kv_quant"] = kvq
     serving_cfg["kv_quant"] = kvq
+    # rollout / canary / migration draws — appended AFTER every
+    # pre-existing draw, same regression-corpus rationale as above.
+    # Tenants are stamped onto the already-generated submits in list
+    # order (payload keys only; the run-time sort's repr tie-break is
+    # deterministic either way), then the version-flip machinery is
+    # composed with the chaos the rest of the schedule already throws:
+    # rollouts mid-death, migrations mid-partition, injected canary SLO
+    # regressions, corrupt new-version checkpoints and deaths mid-flip.
+    for e in events:
+        if e.kind == "submit" and rng.random() < 0.8:
+            e.payload["tenant"] = f"tenant-{rng.randrange(0, 6)}"
+    serving_cfg["rollout"] = {
+        "canary_fraction": rng.choice([0.25, 0.5]),
+        "canary_observe_ticks": rng.choice([40, 80, 160]),
+        "slo_regression_threshold": rng.choice([0.15, 0.25]),
+        "min_canary_samples": rng.choice([2, 3]),
+        "warmup_ticks": rng.choice([0, 1, 2]),
+        "swap_retry_limit": 2,
+        "max_flip_attempts": 4,
+    }
+    if rng.random() < 0.55:
+        t_r = round(rng.uniform(2.0, horizon * 0.5), 3)
+        events.append(SimEvent(t=t_r, kind="rollout",
+                               payload={"version": 1,
+                                        "fraction": rng.choice(
+                                            [0.3, 0.5, 1.0])}))
+        if rng.random() < 0.45:
+            events.append(SimEvent(
+                t=round(t_r + rng.uniform(1.0, 10.0), 3),
+                kind="canary_regress", payload={}))
+        if rng.random() < 0.30:
+            events.append(SimEvent(
+                t=round(t_r - rng.uniform(0.1, 1.5), 3),
+                kind="corrupt_swap", payload={"n": rng.randint(1, 2)}))
+        if rng.random() < 0.25:
+            events.append(SimEvent(
+                t=round(t_r - rng.uniform(0.1, 1.5), 3),
+                kind="flip_death",
+                payload={"ordinal": rng.randint(1, 2)}))
+    for _ in range(rng.randint(0, 2)):
+        events.append(SimEvent(t=round(rng.uniform(2.0, horizon * 0.8), 3),
+                               kind="migrate",
+                               payload={"cell": rng.randint(0, 3),
+                                        "replica": rng.randint(0, 3)}))
     return RegionSchedule(seed=seed, horizon=horizon,
                           engine_cfg=engine_cfg, fleet_cfg=fleet_cfg,
                           serving_cfg=serving_cfg, region_cfg=region_cfg,
@@ -1096,6 +1140,17 @@ class InvariantAuditor:
                     v.append(f"[token-identity] r{t.ix}: emitted "
                              f"{list(t.req.tokens)} != greedy expectation "
                              f"{want}")
+        # 11. version-stream atomicity: one request's token stream is
+        # emitted by ONE model version end to end (serving/rollout.py's
+        # hot-swap contract). A flip that lets a swapped replica resume
+        # a mid-stream request, or a version-blind failover resume,
+        # would splice two versions into one stream — the continuation
+        # gate must refuse and re-route instead.
+        for t in tracked:
+            if len(set(t.req.served_versions)) > 1:
+                v.append(f"[version-stream] r{t.ix}: stream served by "
+                         f"versions {t.req.served_versions} — a request "
+                         f"is one version end to end")
         # 7. trace-tree connectivity: a terminal request's spans — across
         # however many replicas served it (failover, disagg hand-off) —
         # must form ONE closed connected tree: exactly one root, no
@@ -1169,6 +1224,17 @@ class RegionInvariantAuditor(InvariantAuditor):
       requests on a severed-but-alive cell must still finish (the cell
       computes locally) — a harness or region bug that stalls them
       trips [liveness].
+    * **#12 per-tenant version monotonicity** — once a tenant has been
+      served by model version V, no later request of theirs is served
+      by an older one, UNLESS the rollout controller logged a rollback
+      of the newer version (its justification ledger,
+      ``region.version_log``) or the request spilled off its version
+      preference for availability (``_canary_spilled``).
+    * **#13 rollback convergence** — a controller that enters
+      ROLLING_BACK must reach ROLLED_BACK within the liveness slack,
+      and a terminal phase must MATCH the fleet: DONE ⇒ every live
+      replica on the target version, ROLLED_BACK ⇒ every live replica
+      back on stable (the leaky-promote / phantom-rollback detector).
     """
 
     def __init__(self, region, clock, capture: _CaptureTelemetry,
@@ -1177,6 +1243,14 @@ class RegionInvariantAuditor(InvariantAuditor):
         super().__init__(fleet=None, clock=clock, capture=capture,
                          tracer=tracer, vocab=vocab)
         self.region = region
+        # rollout-invariant state (#12/#13): per tenant, the noted
+        # (submit-order, served-version) entries; the uids whose FIRST
+        # served version was already folded in (one note per request —
+        # the audit runs after every event); and when the controller
+        # was first seen ROLLING_BACK (the convergence timer)
+        self._tenant_seen: Dict[str, List[Dict[str, Any]]] = {}
+        self._version_noted: set = set()
+        self._rb_since: Optional[float] = None
 
     def _replicas(self):
         out = []
@@ -1249,6 +1323,74 @@ class RegionInvariantAuditor(InvariantAuditor):
             elif not t.req.error:
                 v.append(f"[shed-span] r{t.ix} rejected without a "
                          f"reason — silent shed")
+        # 12. per-tenant version monotonicity, in SUBMISSION order: for
+        # any two of a tenant's requests, the earlier-submitted one must
+        # not be served by a NEWER version than the later-submitted one
+        # (canary stickiness means one tenant sees one side of the split
+        # for a whole rollout; emission order is explicitly NOT the
+        # contract — an in-flight pre-rollout request legally finishes
+        # on the old version after the tenant's canary requests saw the
+        # new one). The two licenses for a decrease: a controller-logged
+        # "rollback" row for the newer version (the justification
+        # ledger), or EITHER endpoint spilling off its version
+        # preference for availability (a spill onto the canary version
+        # never moved the tenant forward, and a spill off it is not a
+        # downgrade — availability beat affinity, witnessed on the
+        # request).
+        rolled_back = {row["version"] for row in region.version_log
+                       if row["kind"] == "rollback"}
+        for t in tracked:
+            if t.req.uid in self._version_noted or not t.req.served_versions:
+                continue
+            self._version_noted.add(t.req.uid)
+            key = t.req.tenant or t.req.client_request_id
+            me = {"order": (t.req.t_submit if t.req.t_submit is not None
+                            else 0.0, t.req.uid),
+                  "ver": t.req.served_versions[0],
+                  "spilled": bool(getattr(t.req, "_canary_spilled",
+                                          False)),
+                  "ix": t.ix}
+            entries = self._tenant_seen.setdefault(key, [])
+            for o in entries:
+                early, late = ((o, me) if o["order"] <= me["order"]
+                               else (me, o))
+                if (early["ver"] > late["ver"]
+                        and early["ver"] not in rolled_back
+                        and not early["spilled"] and not late["spilled"]):
+                    v.append(f"[version-monotonic] tenant {key}: "
+                             f"r{late['ix']} served by version "
+                             f"{late['ver']} though earlier-submitted "
+                             f"r{early['ix']} saw {early['ver']} with "
+                             f"no rollback logged")
+            entries.append(me)
+        # 13. rollback convergence: ROLLING_BACK is a transient, never a
+        # destination — it must reach ROLLED_BACK within the liveness
+        # slack; and a terminal phase must agree with the fleet's actual
+        # versions (checked on every audit while terminal, so a respawn
+        # or autoscale that resurrects the abandoned version trips too)
+        from ..serving.fleet import ReplicaState
+        from ..serving.rollout import RolloutPhase, TERMINAL_PHASES
+        ro = region.rollout
+        phase = ro.phase
+        now = self.clock.now()
+        if phase == RolloutPhase.ROLLING_BACK:
+            if self._rb_since is None:
+                self._rb_since = now
+            elif now - self._rb_since > LIVENESS_SLACK_TICKS:
+                v.append(f"[rollback-convergence] controller stuck "
+                         f"ROLLING_BACK for {now - self._rb_since:.0f} "
+                         f"virtual seconds — rollback never converges")
+        else:
+            self._rb_since = None
+        if phase in TERMINAL_PHASES and ro.target_version is not None:
+            want = (ro.target_version if phase == RolloutPhase.DONE
+                    else ro.stable_version)
+            wrong = sorted(r.name for r in self._replicas()
+                           if r.state is not ReplicaState.DEAD
+                           and r.version != want)
+            if wrong:
+                v.append(f"[rollback-convergence] phase {phase} but "
+                         f"replica(s) {wrong} not on version {want}")
         return v
 
 
@@ -1434,6 +1576,7 @@ def _apply_event(fleet, ev: SimEvent, tracked: List[_Tracked], guard,
             deadline_s=p.get("deadline"),
             ttft_deadline_s=p.get("ttft_deadline"),
             eos_token_id=p.get("eos"),
+            tenant=p.get("tenant"),
             on_token=entry.delivered.append)
         tracked.append(entry)
     elif ev.kind == "cancel":
@@ -1585,6 +1728,7 @@ def _apply_region_event(region, ev: SimEvent, tracked: List[_Tracked],
             deadline_s=p.get("deadline"),
             ttft_deadline_s=p.get("ttft_deadline"),
             eos_token_id=p.get("eos"),
+            tenant=p.get("tenant"),
             on_token=entry.delivered.append)
         tracked.append(entry)
     elif ev.kind == "cancel":
@@ -1631,6 +1775,32 @@ def _apply_region_event(region, ev: SimEvent, tracked: List[_Tracked],
             cell.fleet.scale_to(int(p["n"]))
     elif ev.kind == "stall":
         clock.advance(float(p.get("dt", 1.0)))
+    elif ev.kind == "rollout":
+        # start() refuses mid-rollout / non-advancing versions itself —
+        # a schedule may legally draw a rollout that lands as a no-op
+        region.start_rollout(int(p["version"]), fraction=p.get("fraction"))
+    elif ev.kind == "migrate":
+        cells = sorted((c for c in region.live_cells),
+                       key=lambda c: c.name)
+        if cells:
+            cell = cells[int(p.get("cell", 0)) % len(cells)]
+            healthy = sorted(r.name for r in cell.fleet.healthy_replicas)
+            if healthy:
+                name = healthy[int(p.get("replica", 0)) % len(healthy)]
+                region.migrate_replica(cell.name, name,
+                                       reason="dst: scheduled migration")
+    elif ev.kind == "canary_regress":
+        # injected canary SLO regression: the new version stalls every
+        # other busy tick from here on — the observe window must catch
+        # the ratio gap and the controller must roll back
+        ro = region.rollout
+        target = ro.target_version
+        if ro.active and target is not None:
+            injector.degrade_model_version(int(target))
+    elif ev.kind == "corrupt_swap":
+        injector.arm_corrupt_swap(int(p.get("n", 1)))
+    elif ev.kind == "flip_death":
+        injector.arm_flip_death(int(p.get("ordinal", 1)))
     else:
         raise ValueError(f"unknown region simulation event '{ev.kind}'")
 

@@ -194,6 +194,20 @@ def list_tags(save_dir: str) -> List[str]:
     return [name for _t, name in sorted(cands, reverse=True)]
 
 
+def tag_model_version(path: str) -> Optional[int]:
+    """The ``model_version`` a tag's meta records (None for tags saved
+    before the field existed, or with no version stamped). ``path`` is
+    the tag directory — pair with :func:`verify_tag`/:func:`find_valid_tag`;
+    this reads identity only, it does not validate."""
+    meta_path = os.path.join(path, "meta.json")
+    try:
+        with open(meta_path) as f:
+            v = json.load(f).get("model_version")
+    except (OSError, ValueError, json.JSONDecodeError):
+        return None
+    return int(v) if v is not None else None
+
+
 def find_valid_tag(save_dir: str, checksums: bool = True) -> Optional[str]:
     """Newest tag that passes :func:`verify_tag`. Scans commit-time order
     rather than trusting the ``latest`` pointer — a crash between commit
@@ -267,7 +281,8 @@ class CheckpointEngine:
 
     def save(self, save_dir: str, tag: str, state: Dict[str, Any],
              client_state: Optional[Dict[str, Any]] = None,
-             config_snapshot: Optional[Dict[str, Any]] = None) -> str:
+             config_snapshot: Optional[Dict[str, Any]] = None,
+             model_version: Optional[int] = None) -> str:
         tag = str(tag)
         os.makedirs(save_dir, exist_ok=True)
         rank0 = jax.process_index() == 0
@@ -298,6 +313,14 @@ class CheckpointEngine:
             "config": config_snapshot or {},
             "version": 2,
         }
+        if model_version is not None:
+            # rollout identity (serving/rollout.py): which MODEL version
+            # these weights are — hot_swap_checkpoint reads it back so a
+            # weight flip stamps the replica with the version it actually
+            # loaded, not the version it was told to expect. Optional
+            # field, not a meta version bump (same discipline as the
+            # telemetry record schemas).
+            meta["model_version"] = int(model_version)
         if rank0:
             retry_call(_write_json_durable, os.path.join(tmp, "meta.json"),
                        meta, policy=_FS_RETRY, op="checkpoint_fs",

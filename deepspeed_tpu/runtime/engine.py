@@ -1999,7 +1999,8 @@ class TrainEngine:
         }
 
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
-                        client_state: Optional[Dict[str, Any]] = None) -> str:
+                        client_state: Optional[Dict[str, Any]] = None,
+                        model_version: Optional[int] = None) -> str:
         tag = tag if tag is not None else f"global_step{self.global_steps}"
         validate_tag_consistency(str(tag), self.config.checkpoint.tag_validation)
         client = {**(client_state or {}),
@@ -2014,7 +2015,8 @@ class TrainEngine:
         return self.ckpt_engine.save(
             save_dir, str(tag), self._state_dict(),
             client_state=client,
-            config_snapshot=self.config.raw)
+            config_snapshot=self.config.raw,
+            model_version=model_version)
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
@@ -2067,6 +2069,47 @@ class TrainEngine:
                 and hasattr(self._dataloader, "load_state_dict")):
             self._dataloader.load_state_dict(client["dataloader"])
         return client
+
+    def hot_swap_checkpoint(self, load_dir: str,
+                            tag: Optional[str] = None,
+                            warmup_batch: Optional[Any] = None
+                            ) -> Optional[int]:
+        """Weight-only swap for zero-downtime rollout (serving/rollout.py).
+
+        Loads ONLY ``params`` from the checkpoint — optimizer state,
+        loss-scaler, step counters, rng, and dataloader position are all
+        left untouched, because the process keeps serving/training as the
+        same logical worker; only the model weights flip. The checkpoint
+        is manifest-verified exactly like :meth:`load_checkpoint` — a
+        torn or corrupt tag raises instead of half-swapping, so the
+        rollout controller's swap-failure path (re-open admission, retry
+        or roll back) sees a clean error, never a franken-model.
+
+        ``warmup_batch`` triggers :meth:`warmup_async` on the new weights
+        so the first post-swap step does not eat a compile stall.
+
+        Returns the checkpoint's ``model_version`` manifest field (None
+        when the checkpoint predates version stamping).
+        """
+        template = {
+            "params": self._params_struct,
+            "opt_state": self._opt_struct,
+            "scaler": self.scaler_state,
+            "step": jnp.asarray(self.global_steps, jnp.int32),
+            "rng": self.rng,
+        }
+        result = self.ckpt_engine.load(load_dir, tag, template=template)
+        if result is None:
+            raise ValueError(
+                f"hot_swap_checkpoint: no valid checkpoint under "
+                f"{load_dir!r} (tag={tag!r}) — refusing to swap")
+        self.params = jax.device_put(result["state"]["params"],
+                                     self.param_shardings)
+        self._params_to_offload()
+        if warmup_batch is not None:
+            self.warmup_async(warmup_batch)
+        version = result["meta"].get("model_version")
+        return int(version) if version is not None else None
 
     def save_16bit_model(self, save_dir: str, filename: str = "model_fp16.npz") -> str:
         """Consolidated 16-bit export (reference engine.save_16bit_model

@@ -32,6 +32,11 @@ from .cell import (  # noqa: F401
 )
 from .fleet import Replica, ReplicaState, ServingFleet  # noqa: F401
 from .region import Region  # noqa: F401
+from .rollout import (  # noqa: F401
+    RolloutController,
+    RolloutPhase,
+    TERMINAL_PHASES,
+)
 from .request import (  # noqa: F401
     InvalidTransition,
     Request,

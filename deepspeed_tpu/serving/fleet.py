@@ -60,7 +60,7 @@ from ..telemetry.tracing import get_tracer, request_event
 from ..utils.logging import log_dist, logger
 from .request import Request, RequestState
 from .router import (NoHealthyReplica, PrefixAffinityRouter, RouterPolicy,
-                     least_loaded_pick, make_router)
+                     _hash64, least_loaded_pick, make_router)
 from .server import ServingEngine, stream_tokens
 
 
@@ -102,6 +102,11 @@ class Replica:
     @property
     def accepting(self) -> bool:
         return self.state == ReplicaState.HEALTHY and self.serving._accepting
+
+    @property
+    def version(self) -> int:
+        """The model version this replica serves (hot_swap bumps it)."""
+        return self.serving.model_version
 
     @property
     def load(self) -> int:
@@ -194,6 +199,16 @@ class ServingFleet:
         # sliding in-SLA window feeding the autoscaler (True/False per
         # SLO-carrying terminal request; cancels and SLO-less skipped)
         self._sla_window = collections.deque(maxlen=config.sla_window)
+        # versioned serving (docs/serving.md "Rollout, canary, and
+        # migration"): _fleet_version is what NEW replicas (spawn,
+        # respawn, migration replacement) serve; _canary is the active
+        # (version, traffic_fraction) canary split or None; per-version
+        # in-SLA windows feed the rollout controller's canary-vs-stable
+        # regression check
+        self._fleet_version = int(
+            getattr(serving_config, "model_version", 0) or 0)
+        self._canary: Optional[Tuple[int, float]] = None
+        self._version_sla: Dict[int, collections.deque] = {}
         self._shed_backlog: List[Request] = []   # fleet-rejected, span due
         # respawn backoff (ElasticAgent contract: exponential + healthy
         # reset; here per-fleet since replicas are interchangeable)
@@ -268,6 +283,7 @@ class ServingFleet:
             # stays outside the lock (it builds a whole engine)
             engine = getattr(self, "_pending_engine", None)
             self._pending_engine = None
+            fleet_version = self._fleet_version
         if engine is None:
             engine = self._factory()
         name = f"replica-{next(self._name_counter)}"
@@ -284,6 +300,10 @@ class ServingFleet:
             on_handoff=(self._on_handoff if role == "prefill" else None),
             on_retire=self._on_retire,
             clock=self._clock)
+        # new capacity serves the fleet's CURRENT version: a mid-rollout
+        # respawn or migration replacement must not resurrect the config
+        # default and silently widen (or shrink) the canary
+        serving.model_version = fleet_version
         rep = Replica(name, engine, serving, role=role)
         with self._lock:
             self._replicas[name] = rep
@@ -301,13 +321,16 @@ class ServingFleet:
         return rep
 
     def _view(self, role: Optional[str] = None, live: bool = False,
-              refused=()) -> Dict[str, int]:
+              refused=(), version: Optional[int] = None) -> Dict[str, int]:
         """name -> load routing view. ``live=False``: replicas accepting
         NEW work (health-checked admission view). ``live=True``: anything
         not DEAD — the continuation view (draining replicas finish
         admitted work, they just take no new admissions). ``role``
         filters; None = any serving (non-prefill) role. ``refused`` names
-        are excluded (stop-race retry loops)."""
+        are excluded (stop-race retry loops). ``version`` restricts to
+        replicas serving exactly that model version — the canary split
+        and the version-affine continuation path (docs/serving.md
+        "Rollout, canary, and migration")."""
         out = {}
         for r in self._replicas.values():
             if r.name in refused:
@@ -318,8 +341,88 @@ class ServingFleet:
                 continue
             if role is None and r.role == "prefill":
                 continue
+            if version is not None and r.version != version:
+                continue
             out[r.name] = r.load
         return out
+
+    # -- versioned serving (docs/serving.md "Rollout, canary, migration") -
+    def set_canary(self, version: int, fraction: float) -> None:
+        """Open a canary split: ``fraction`` of NEW traffic routes to
+        replicas serving ``version``, the rest to the stable version.
+        The slice is tenant-sticky (hash of the tenant key, not a coin
+        flip per request), so one tenant sees ONE version for the whole
+        rollout."""
+        with self._lock:
+            self._canary = (int(version), max(0.0, min(1.0, fraction)))
+
+    def clear_canary(self) -> None:
+        with self._lock:
+            self._canary = None
+
+    def set_fleet_version(self, version: int) -> None:
+        """Move the version NEW capacity serves (promotion / rollback).
+        Existing replicas are untouched — the rollout controller flips
+        them one by one through drain + ``hot_swap``."""
+        with self._lock:
+            self._fleet_version = int(version)
+
+    @property
+    def fleet_version(self) -> int:
+        with self._lock:
+            return self._fleet_version
+
+    def version_counts(self) -> Dict[int, int]:
+        """model version -> live (non-DEAD) replica count — the rollout
+        controller's progress view."""
+        with self._lock:
+            out: Dict[int, int] = {}
+            for r in self._replicas.values():
+                if r.state != ReplicaState.DEAD:
+                    out[r.version] = out.get(r.version, 0) + 1
+            return out
+
+    def version_sla(self, version: int) -> Tuple[int, Optional[float]]:
+        """(samples, in-SLA ratio) for SLO-carrying requests served by
+        ``version`` — the canary regression check compares this between
+        canary and stable."""
+        with self._lock:
+            win = self._version_sla.get(int(version))
+            if not win:
+                return 0, None
+            return len(win), sum(win) / len(win)
+
+    def _canary_slice(self, req: Request) -> bool:
+        """Whether ``req`` falls in the canary traffic slice.
+        Tenant-sticky: keyed on ``req.tenant`` (falling back to the
+        stable ``client_request_id``) through the same process-stable
+        hash the affinity ring uses, so the split is deterministic
+        across replays and restarts."""
+        canary = self._canary
+        if canary is None:
+            return False
+        key = req.tenant if req.tenant is not None else req.client_request_id
+        return (_hash64(f"canary:{key}") % 1000) < canary[1] * 1000.0
+
+    def _versioned_view(self, role, live, refused, hard, soft,
+                        req: Optional[Request] = None) -> Dict[str, int]:
+        """Version-constrained routing view (fleet lock held). A HARD
+        version (continuation affinity) never falls back — serving the
+        stream from another version is the one thing routing must never
+        do; a SOFT one (canary preference) degrades to the
+        unconstrained view when the preferred version has no accepting
+        capacity (canary still warming, stable side mid-flip). A spill
+        is stamped on the request: the DST per-tenant monotonicity
+        auditor exempts availability-over-affinity placements."""
+        want = hard if hard is not None else soft
+        view = self._view(role, live=live, refused=refused, version=want)
+        if not view and want is not None and hard is None:
+            view = self._view(role, live=live, refused=refused)
+            if view:
+                self._count("canary_spills")
+                if req is not None:
+                    req._canary_spilled = True
+        return view
 
     # -- submission ------------------------------------------------------
     def submit(self, prompt: Sequence[int], *,
@@ -329,6 +432,7 @@ class ServingFleet:
                deadline_s: Optional[float] = None,
                ttft_deadline_s: Optional[float] = None,
                client_request_id: Optional[str] = None,
+               tenant: Optional[str] = None,
                on_token=None) -> Request:
         """Route a request to a replica. Same contract as
         ``ServingEngine.submit``: returns immediately, possibly already
@@ -339,7 +443,8 @@ class ServingFleet:
                             else self._serving_config.default_max_new_tokens),
             eos_token_id=eos_token_id, priority=priority,
             deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
-            client_request_id=client_request_id, on_token=on_token)
+            client_request_id=client_request_id, tenant=tenant,
+            on_token=on_token)
         # adopt the fleet's clock before stamping (same timebase rule as
         # ServingEngine.submit_request: injected clock != global clock
         # must not split a request's lifecycle across two timebases)
@@ -400,6 +505,22 @@ class ServingFleet:
                 if not self._accepting and not requeue:
                     fail = "fleet closed to new requests"
                 else:
+                    # version constraints (docs/serving.md "Rollout,
+                    # canary, and migration"): a continuation with
+                    # tokens out is HARD-bound to the version that
+                    # emitted them (a mixed-version stream is the DST
+                    # two-version violation); fresh work gets a SOFT
+                    # canary-vs-stable preference that degrades to any
+                    # capacity rather than shedding
+                    hard = (req.model_version
+                            if requeue and req.tokens
+                            and req.model_version is not None else None)
+                    soft = None
+                    if hard is None and self._canary is not None:
+                        soft = (self._canary[0] if self._canary_slice(req)  # dslint: disable=lock-discipline -- _canary_slice only hashes (router._hash64); the ".digest()" in its chain is hashlib's, name-resolved to ServingCell.digest by the static call graph — no cell lock is taken
+                                else self._fleet_version)
+                        if soft == self._canary[0]:
+                            self._count("canary_assigned")
                     if self.config.disaggregated:
                         # prefill pool first — routed by the CONFIGURED
                         # router below (affinity composes with
@@ -407,19 +528,21 @@ class ServingFleet:
                         # replicas, where repeat prefixes find their
                         # cached KV); the handoff hook ships the result
                         # onward
-                        view = self._view("prefill", live=requeue,
-                                          refused=refused)
+                        view = self._versioned_view(
+                            "prefill", requeue, refused, hard, soft, req)
                         if not view:
                             # degrade: unified path on whatever can serve
-                            view = self._view(live=requeue,
-                                              refused=refused)
+                            view = self._versioned_view(
+                                None, requeue, refused, hard, soft, req)
                             req._handoff_requested = False
                         else:
                             req._handoff_requested = True
                     else:
-                        view = self._view(live=requeue, refused=refused)
+                        view = self._versioned_view(
+                            None, requeue, refused, hard, soft, req)
                     if not view:
-                        fail = "no healthy replica"
+                        fail = ("no healthy replica" if hard is None else
+                                f"no live replica serving version {hard}")
                     else:
                         try:
                             name = self.router.route(view, req.prompt)
@@ -662,9 +785,11 @@ class ServingFleet:
                 verdict = req.in_slo()
                 if verdict is not None:
                     self._sla_window.append(bool(verdict))
+                    self._note_version_sla(req, bool(verdict))
             elif had_slo and not (req.state is RequestState.CANCELLED
                                   and req.error is None):
                 self._sla_window.append(False)
+                self._note_version_sla(req, False)
         if self._retire_hook is not None:
             # region bookkeeping, chained OUTSIDE the fleet lock (the
             # hook takes the Region lock; region -> cell -> fleet is the
@@ -674,6 +799,18 @@ class ServingFleet:
             except Exception:  # dslint: disable=exception-discipline -- callback isolation: a region bookkeeping crash must not stop later retires on this fleet
                 logger.exception(
                     f"ServingFleet: retire hook failed (request {req.uid})")
+
+    def _note_version_sla(self, req: Request, ok: bool) -> None:
+        """Fold one SLO verdict into the request's version window (fleet
+        lock held) — the rollout controller's canary-vs-stable signal."""
+        v = req.model_version
+        if v is None:
+            return
+        win = self._version_sla.get(v)
+        if win is None:
+            win = self._version_sla[v] = collections.deque(
+                maxlen=self.config.sla_window)
+        win.append(bool(ok))
 
     def place_handoff(self, req: Request, export,
                       allow_prefill_fallback: bool = True) -> bool:
@@ -689,13 +826,19 @@ class ServingFleet:
         False with the request untouched when nothing qualifies — the
         cross-cell adoption path calls this on another cell's fleet, so
         refusal must stay non-terminal here."""
+        # a hand-off with tokens out is HARD version-affine (same
+        # contract as routing): the adopting replica must serve the
+        # version that emitted them, or adopt() refuses anyway
+        hard = (req.model_version if req.tokens
+                and req.model_version is not None else None)
         refused: set = set()
         while True:
             with self._lock:
-                view = self._view("decode", live=True, refused=refused)
+                view = self._view("decode", live=True, refused=refused,
+                                  version=hard)
                 if not view and allow_prefill_fallback:
                     view = self._view("prefill", live=True,
-                                      refused=refused)
+                                      refused=refused, version=hard)
                     req._handoff_requested = False
                 if not view:
                     return False
@@ -854,6 +997,91 @@ class ServingFleet:
         rep.serving.kill()
         orphans = rep.serving.evacuate()
         self._failover_orphans(orphans, source=name)
+        self._update_gauges()
+        return True
+
+    def migrate_replica(self, name: str,
+                        reason: str = "migration") -> bool:
+        """Live replica migration — evacuate + re-place UNDER traffic,
+        promoted from the failure path to a first-class operation
+        (docs/serving.md "Rollout, canary, and migration"). The order is
+        spawn-first: a same-role, same-version replacement joins the
+        router, THEN the victim stops admission, its driver is joined,
+        and its work moves — decodes with complete KV over the quantized
+        ``export_kv``/``adopt`` wire (no recompute), everything else
+        through the normal re-route path. Unlike :meth:`kill_replica`
+        the victim's engine state is trusted, so nothing re-prefills
+        that doesn't have to.
+
+        Returns False (untouched) when ``name`` is unknown or not
+        HEALTHY — a migration raced by death/drain falls back to the
+        failover path that is already running."""
+        with self._lock:
+            victim = self._replicas.get(name)
+            if victim is None or victim.state != ReplicaState.HEALTHY:
+                return False
+            victim.state = ReplicaState.DRAINING
+            self.router.on_leave(name)
+            version = victim.version
+            role = victim.role
+        victim.serving.stop_admission()
+        logger.info(f"ServingFleet{f'[{self.name}]' if self.name else ''}: "
+                    f"migrating {name} ({reason})")
+        # replacement first: capacity never dips below the pre-migration
+        # count, and the victim's work has somewhere version-compatible
+        # to land. _spawn stamps _fleet_version, so pin the victim's
+        # ACTUAL version after (a canary replica migrates as a canary).
+        replacement = self._spawn(role=role)
+        replacement.serving.model_version = version
+        with self._lock:
+            victim.state = ReplicaState.DEAD
+        victim.serving.kill()
+        queued, exports = victim.serving.migrate_out()
+        self._count("migrations")
+        moved_kv = 0
+        for req, export in exports:
+            if req._cancel_requested:
+                # honor the pending cancel at the boundary (same terminal
+                # contract as the failover path)
+                from .server import emit_request_span
+
+                req.transition(RequestState.CANCELLED)
+                self._count("cancelled")
+                emit_request_span(self._telemetry, req)
+                self._on_retire(req)
+                continue
+            request_event(req, "migrate_adopt", source=name,
+                          target=replacement.name)
+            with self._lock:
+                self._requests[req.uid] = (req, replacement.name)
+            if replacement.serving.adopt(req, export):
+                moved_kv += 1
+                continue
+            # adopt refused (replacement raced a kill/version flip):
+            # degrade to the ordinary re-route continuation — the KV is
+            # recomputed, the request is never lost
+            with self._lock:
+                ent = self._requests.get(req.uid)
+                if ent is not None and ent[1] == replacement.name:
+                    del self._requests[req.uid]
+            self._route(req, requeue=True)
+        if moved_kv:
+            self._count("migrated_kv", moved_kv)
+        # queued / mid-prefill work re-routes unconditionally — a
+        # migration is an OPERATION, not a death, so it must not shed
+        # under failover=False the way _failover_orphans would
+        for req in queued:
+            if req._cancel_requested:
+                from .server import emit_request_span
+
+                req.transition(RequestState.CANCELLED)
+                self._count("cancelled")
+                emit_request_span(self._telemetry, req)
+                self._on_retire(req)
+                continue
+            request_event(req, "migrate_reroute", source=name)
+            self._route(req, requeue=True)
+        self._flush_shed()
         self._update_gauges()
         return True
 

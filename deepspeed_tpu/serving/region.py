@@ -66,6 +66,7 @@ from ..utils.logging import log_dist, logger
 from .cell import CellDigest, CellUnreachable, ServingCell, check_reachable
 from .fleet import ServingFleet, route_budget_for
 from .request import Request, RequestState
+from .rollout import RolloutController
 from .router import ConsistentHashRing, _hash64, prefix_key
 from .server import emit_request_span, stream_tokens
 
@@ -163,6 +164,10 @@ class Region:
         if first is not None and first.fleet.replicas:
             eng = first.fleet.replicas[0].engine
             self._block_size = int(getattr(eng.config, "kv_block_size", 16))
+        # zero-downtime rollout controller (serving/rollout.py): owns
+        # the canary/promote/rollback state machine, stepped from poll()
+        self._rollout = RolloutController(self, serving_config.rollout,
+                                          self._clock)
         log_dist(f"Region[{name}]: {len(self._cells)} cells x "
                  f"{fleet_config.replicas} replicas "
                  f"router={fleet_config.router} "
@@ -244,6 +249,7 @@ class Region:
                deadline_s: Optional[float] = None,
                ttft_deadline_s: Optional[float] = None,
                client_request_id: Optional[str] = None,
+               tenant: Optional[str] = None,
                on_token=None) -> Request:
         """Route a request through the cell ring. Same contract as
         ``ServingFleet.submit``: returns immediately, possibly already
@@ -254,7 +260,8 @@ class Region:
                             else self._serving_config.default_max_new_tokens),
             eos_token_id=eos_token_id, priority=priority,
             deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
-            client_request_id=client_request_id, on_token=on_token)
+            client_request_id=client_request_id, tenant=tenant,
+            on_token=on_token)
         # one timebase per lifecycle (the fleet/engine rule, one tier up)
         req._clock = self._clock
         req.t_submit = self._clock.now()
@@ -504,10 +511,17 @@ class Region:
                 request_event(req, "cross_cell_handoff",
                               source=src_cell, target=name)
                 return True
+            # refusal: point the row BACK at the source cell, do not
+            # delete it — the pair is handed back to the source fleet on
+            # the False return below, and a deleted row would strand the
+            # request ownerless in the region table (version-affine
+            # hand-offs made cross-cell refusal a common outcome, not a
+            # scale-down race). The ent guard keeps a concurrent retire
+            # from being resurrected as a stale row.
             with self._lock:
                 ent = self._requests.get(req.uid)
                 if ent is not None and ent[1] == name:
-                    del self._requests[req.uid]
+                    self._requests[req.uid] = (req, src_cell)
         # nobody reachable can adopt the KV: hand the pair back to the
         # source fleet (False), whose prefill replica decodes it itself
         # as the last resort — the KV is already THERE, and a re-prefill
@@ -553,6 +567,7 @@ class Region:
         self._refresh_digests()
         self._check_dead_cells()
         self._update_brownout()
+        self._rollout.step()
         self._flush_shed()
         self._update_gauges()
 
@@ -853,6 +868,40 @@ class Region:
         for cell in cells:
             did = cell.step() or did
         return did
+
+    # -- rollout (serving/rollout.py) ------------------------------------
+    def start_rollout(self, version: int,
+                      fraction: Optional[float] = None,
+                      load_fn=None) -> bool:
+        """Begin a zero-downtime rollout to ``version`` (canary slice
+        ``fraction``, defaulting to the configured one; ``load_fn``
+        streams the new weights inside each replica's hot_swap). The
+        controller advances on the monitor cadence — poll :attr:`rollout`
+        for progress."""
+        return self._rollout.start(version, fraction=fraction,
+                                   load_fn=load_fn)
+
+    def migrate_replica(self, cell_name: str, replica_name: str,
+                        reason: str = "migration") -> bool:
+        """Live-migrate one replica under traffic (first-class
+        evacuate + re-place: drain admission, spawn the replacement on
+        the victim's version, hand its KV over the quantized export
+        wire, re-route the rest — zero requests lost)."""
+        with self._lock:
+            cell = self._cells.get(cell_name)
+        if cell is None or not cell.alive:
+            return False
+        return cell.fleet.migrate_replica(replica_name, reason=reason)
+
+    @property
+    def rollout(self) -> RolloutController:
+        return self._rollout
+
+    @property
+    def version_log(self) -> List[Dict[str, Any]]:
+        """The rollout controller's justification ledger (the DST
+        per-tenant monotonicity invariant reads it)."""
+        return self._rollout.version_log
 
     # -- introspection ---------------------------------------------------
     @property

@@ -92,6 +92,11 @@ class Request:
     # no matter how many engines touched it. Defaults to a uid-derived
     # string; callers pass their own to correlate with client-side logs.
     client_request_id: Optional[str] = None
+    # tenant key for canary routing (serving/rollout.py): requests from
+    # one tenant land on one side of the canary split for the whole
+    # rollout — a tenant never sees the version ping-pong a per-request
+    # coin flip would produce. None falls back to client_request_id.
+    tenant: Optional[str] = None
 
     # -- lifecycle bookkeeping (driver-owned; read-only for callers) ----
     state: RequestState = RequestState.QUEUED
@@ -105,6 +110,13 @@ class Request:
     # into the terminal RequestStats record
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # model-version ledger (serving/rollout.py): ``model_version`` is the
+    # version this request was ROUTED to (stamped at placement; may be
+    # re-stamped while no tokens are out yet), ``served_versions`` the
+    # distinct versions that actually EMITTED tokens, in order — the DST
+    # two-version-stream invariant audits len(set(served_versions)) <= 1
+    model_version: Optional[int] = None
+    served_versions: List[int] = field(default_factory=list)
     t_submit: Optional[float] = None     # clock.now() stamps
     t_admit: Optional[float] = None      # last admission (re-set on resume)
     t_first_admit: Optional[float] = None
@@ -122,6 +134,8 @@ class Request:
             self.client_request_id = f"req-{self.uid:08d}"
         elif not isinstance(self.client_request_id, str):
             raise ValueError("client_request_id must be a string")
+        if self.tenant is not None and not isinstance(self.tenant, str):
+            raise ValueError("tenant must be a string")
         self._done = threading.Event()
         # the clock this request's whole lifecycle is timed on, captured
         # at construction: deadlines, terminal stamps and SLO verdicts
@@ -136,6 +150,11 @@ class Request:
         # fleet-internal: hand this request from its prefill replica to a
         # decode replica once its first token resolves (disaggregated mode)
         self._handoff_requested = False
+        # routing witness: the SOFT canary/stable version preference had
+        # no accepting capacity and this request spilled to whatever
+        # could serve (availability over version affinity) — the DST
+        # per-tenant monotonicity auditor exempts spilled requests
+        self._canary_spilled = False
         # speculative-decoding driver state: rolling per-request
         # acceptance EMA (optimistic start — a fresh request gets full
         # drafts until it proves unpredictable) and the per-request

@@ -110,6 +110,7 @@ def emit_request_span(telemetry, req: Request) -> None:
         preemptions=req.preemptions, retries=req.retries,
         spec_proposed=(req.spec_proposed if req.spec_proposed else None),
         spec_accepted=(req.spec_accepted if req.spec_proposed else None),
+        model_version=req.model_version,
         in_slo=in_slo, error=req.error,
         trace_id=(root.trace_id if root is not None and not root.is_noop
                   else None),
@@ -208,6 +209,17 @@ class ServingEngine:
                 f"serving.kv_quant='{want_quant}' but the engine stores "
                 f"KV as '{have_quant}' — configure both from one source")
         self._kv_quant = have_quant
+        # model-version ledger (docs/serving.md "Rollout, canary, and
+        # migration"): the version of the weights this engine serves.
+        # Monotonic ints, bumped by hot_swap(); requests are stamped at
+        # placement and continuations are version-affine — a stream
+        # started on version N is never continued on N+1 (the DST
+        # two-version-stream invariant).
+        self.model_version = int(getattr(config, "model_version", 0) or 0)
+        # AOT-warmup countdown after a hot swap: the new version is
+        # compiled/warmed for this many ticks before the replica takes
+        # traffic again (counts down in _tick even when idle)
+        self._warmup_remaining = 0
         # built through the locksan seam: a plain RLock in production,
         # an order-recording wrapper under tests/DST (docs/dst.md)
         self._lock = named_rlock("ServingEngine._lock")
@@ -324,6 +336,16 @@ class ServingEngine:
         with self._lock:
             if requeue and self._stop_evt.is_set():
                 return None
+            if (requeue and req.tokens
+                    and req.model_version is not None
+                    and req.model_version != self.model_version):
+                # version affinity: a continuation with tokens already
+                # out must finish on the version that emitted them — a
+                # mixed-version stream is exactly what the DST
+                # two-version invariant forbids. NON-terminal refusal
+                # (like the stopped-driver case): the caller re-places
+                # it on a same-version replica or cancels it explicitly.
+                return None
             if not requeue and not self._accepting:
                 self._reject(req, "serving closed to new requests")
             elif (len(req.prompt) + req.max_new_tokens
@@ -346,6 +368,12 @@ class ServingEngine:
                 # rather than being shed
                 self._reject(req, "admission queue full")
             else:
+                if not req.tokens:
+                    # stamp (or re-stamp) the serving version: with no
+                    # tokens out yet nothing binds the stream, so a
+                    # failed-over prefill may legally restart on the new
+                    # version — only emitted tokens create affinity
+                    req.model_version = self.model_version
                 self._requests[req.uid] = req
                 self._enqueue_locked(req, requeue=bool(requeue))
         self._flush_spans()
@@ -381,6 +409,13 @@ class ServingEngine:
         with self._lock:
             if self._stop_evt.is_set():
                 return False
+            if (req.tokens and req.model_version is not None
+                    and req.model_version != self.model_version):
+                # version affinity (same contract as submit_request): a
+                # hand-off with tokens out must land on ITS version
+                return False
+            if not req.tokens:
+                req.model_version = self.model_version
             self._requests[req.uid] = req
             self._adoptions.append((req, kv_export))
         return True
@@ -391,6 +426,142 @@ class ServingEngine:
         here, live work serves out, then ``close()`` is safe."""
         with self._lock:
             self._accepting = False
+
+    def resume_admission(self) -> None:
+        """Re-open the front door after a drain that did NOT end in
+        close/kill — the rollout controller's flip-abort and rollback
+        paths (docs/serving.md "Rollout, canary, and migration")."""
+        with self._lock:
+            if not self._stop_evt.is_set() and self._warmup_remaining == 0:
+                self._accepting = True
+
+    def hot_swap(self, version: int, load_fn=None,
+                 warmup_ticks: Optional[int] = None) -> bool:
+        """Swap the serving weights to ``version`` in place — the
+        zero-downtime deploy primitive (docs/serving.md "Rollout,
+        canary, and migration"). Contract: admission must already be
+        stopped and the backlog drained (the rollout controller's
+        drain-and-flip seam) — swapping under live work would serve one
+        stream from two versions.
+
+        ``load_fn`` performs the actual weight load (checkpoint-streamed
+        on the real path, a no-op in the DST sim); a load failure —
+        including an injected corrupt new-version checkpoint — FALLS
+        BACK: the old weights are untouched, admission resumes on the
+        old version, and False is returned so the controller can retry
+        or roll back. A failed swap never strands the replica.
+
+        On success the version is bumped and the replica stays
+        non-accepting for ``warmup_ticks`` engine ticks — the AOT-warmup
+        window where the new version compiles before taking traffic
+        (the countdown runs even on idle ticks)."""
+        with self._lock:
+            if self._stop_evt.is_set():
+                return False
+            if self._accepting or not self._idle_locked():
+                raise RuntimeError(
+                    f"hot_swap needs a drained, admission-stopped engine "
+                    f"(accepting={self._accepting}, "
+                    f"pending={not self._idle_locked()})")
+            old = self.model_version
+        from ..resilience.chaos import get_fault_injector
+
+        failure: Optional[str] = None
+        inj = get_fault_injector()
+        if inj is not None and inj.should_corrupt_swap():
+            failure = "injected corrupt checkpoint"
+        if failure is None and load_fn is not None:
+            try:
+                load_fn()
+            except Exception as e:
+                # swap fallback IS the handler: the old weights are
+                # intact, so the loss-free response to ANY load failure
+                # is resume-on-old-version; InjectedFault (BaseException)
+                # still propagates
+                failure = f"{type(e).__name__}: {e}"
+        if failure is not None:
+            self._count("swap_failed")
+            logger.warning(
+                f"ServingEngine"
+                f"{f'[{self.replica_id}]' if self.replica_id else ''}: "
+                f"hot swap to version {version} failed ({failure}); "
+                f"serving stays on version {old}")
+            with self._lock:
+                if not self._stop_evt.is_set():
+                    self._accepting = True
+            return False
+        if warmup_ticks is None:
+            warmup_ticks = getattr(
+                getattr(self.config, "rollout", None), "warmup_ticks", 2)
+        with self._lock:
+            self.model_version = int(version)
+            self._warmup_remaining = max(0, int(warmup_ticks))
+            if self._warmup_remaining == 0:
+                self._accepting = True
+        self._count("swaps")
+        log_dist(f"ServingEngine"
+                 f"{f'[{self.replica_id}]' if self.replica_id else ''}: "
+                 f"hot-swapped {old} -> {version} "
+                 f"(warmup {warmup_ticks} ticks)")
+        return True
+
+    def migrate_out(self) -> Tuple[List[Request], List[tuple]]:
+        """Live-migration harvest — the first-class sibling of
+        :meth:`evacuate` (docs/serving.md "Rollout, canary, and
+        migration"). Call after ``kill()``: unlike the failure path, the
+        engine state here is TRUSTED, so decodes with a complete KV
+        footprint are exported over the quantized ``export_kv`` wire for
+        adoption elsewhere instead of being recomputed.
+
+        Returns ``(queued, exports)``: ``queued`` holds every request
+        with nothing worth shipping (queue, pens, mid-prefill live work
+        — these re-route and re-prefill normally), ``exports`` the
+        ``(request, KVExport)`` pairs to :meth:`adopt` on the
+        destination. Zero blocks stay behind either way."""
+        with self._lock:
+            queued: List[Request] = list(self._queue)
+            exports: List[tuple] = []
+            for uid, req in list(self._live.items()):
+                seq = self._engine.seqs.get(uid)
+                if (req.state is RequestState.DECODE and req.tokens
+                        and seq is not None and seq.pending == 0):
+                    # complete, trusted KV: ship it (the driver is
+                    # joined, so the export copy under our lock cannot
+                    # stall a tick — nothing else runs here)
+                    export = self._engine.export_kv(uid)
+                    self._engine.preempt(uid)
+                    req.transition(RequestState.QUEUED)
+                    req._pending_token = None
+                    exports.append((req, export))
+                else:
+                    # mid-prefill (or no tokens out): nothing a KV
+                    # import could resume — release and re-prefill
+                    self._release_engine_state(uid, publish=True)
+                    req.transition(RequestState.QUEUED)
+                    req._pending_token = None
+                    queued.append(req)
+            for req, _ in self._adoptions:        # never imported
+                queued.append(req)
+            for req, export in self._handoff_backlog:  # already exported
+                exports.append((req, export))
+            for req in queued:
+                request_event(req, "migrate", replica=self.replica_id)
+                end_request_segment(req, outcome="migrated")
+            for req, _ in exports:
+                request_event(req, "migrate", replica=self.replica_id,
+                              kv_shipped=True)
+                end_request_segment(req, outcome="migrated")
+            self._queue.clear()
+            self._live.clear()
+            self._adoptions.clear()
+            self._handoff_backlog.clear()
+            self._requests.clear()
+            for req in queued:
+                self._engine.clear_resume(req.uid)
+            for req, _ in exports:
+                self._engine.clear_resume(req.uid)
+            self._accepting = False
+        return queued, exports
 
     def kill(self) -> None:
         """Abrupt stop — the injected-replica-death shape. Joins the
@@ -532,6 +703,12 @@ class ServingEngine:
             return len(self._queue)
 
     @property
+    def warmup_remaining(self) -> int:
+        """Ticks left in the post-hot-swap AOT-warmup window (0 = warm)."""
+        with self._lock:
+            return self._warmup_remaining
+
+    @property
     def live_requests(self) -> int:
         with self._lock:
             return len(self._live)
@@ -648,8 +825,11 @@ class ServingEngine:
         OR manual stepping — it used to live in the thread loop only,
         which made the latch invisible to deterministically-driven
         tests/simulations)."""
-        if (self._guard is None or not self._guard.should_stop
-                or not self._accepting):
+        if self._guard is None or not self._guard.should_stop:
+            return
+        with self._lock:
+            accepting = self._accepting
+        if not accepting:
             return
         logger.warning("ServingEngine: preemption latched — draining "
                        "(finishing live requests, rejecting the queue)")
@@ -667,12 +847,53 @@ class ServingEngine:
             # the dump is file I/O when a dump dir is configured)
             tracer.flight.dump("preemption-latch")
 
+    def _tick_warmup(self) -> None:
+        """Post-hot-swap AOT-warmup countdown, at the top of every tick
+        — INCLUDING idle ones (an idle replica must still finish warming
+        up and re-open, so this cannot ride ``_tick_count``, which only
+        advances on busy ticks). Admission re-opens when it reaches
+        zero."""
+        reopened = False
+        with self._lock:
+            if self._warmup_remaining > 0:
+                self._warmup_remaining -= 1
+                if (self._warmup_remaining == 0
+                        and not self._stop_evt.is_set()):
+                    self._accepting = True
+                    reopened = True
+        if reopened:
+            self._count("warmup_done")
+
+    def _maybe_degrade_tick(self) -> bool:
+        """Injected canary SLO regression (chaos ``degrade_version``):
+        stall this tick — no admission, no engine put, virtual time still
+        advances — when the injector degrades THIS replica's model
+        version. Only busy ticks stall: an idle degraded replica must
+        still report idle, or the fleet would never quiesce."""
+        with self._lock:
+            busy = bool(self._queue or self._requests)
+            version = self.model_version
+        if not busy:
+            return False
+        from ..resilience.chaos import get_fault_injector
+
+        inj = get_fault_injector()
+        if inj is None or not inj.should_degrade_tick(version):
+            return False
+        self._count("degraded_ticks")
+        self._flush_spans()
+        self._update_gauges()
+        return True
+
     def _tick(self) -> bool:
         """One driver iteration: latch poll, adoptions, cancellations,
         admission (+ preemption), one engine ``put()`` — a verify step
         when speculative chains are drafted — and token dispatch.
         Returns False when idle."""
         self._check_latch()
+        self._tick_warmup()
+        if self._maybe_degrade_tick():
+            return True
         self._import_adoptions()
         with self._lock:
             self._process_cancellations()
@@ -1160,6 +1381,7 @@ class ServingEngine:
                 # delivered in order, before any terminal transition —
                 # the stream() drain contract holds per token)
                 emitted = accepted[uid]
+                self._note_served_version(req)
                 for tok in emitted:
                     req.tokens.append(tok)
                     if req.on_token is not None:
@@ -1177,6 +1399,7 @@ class ServingEngine:
                     req.t_first_token = now
                 begin_request_segment(req, "decode",
                                       track=self.replica_id)
+            self._note_served_version(req)
             req.tokens.append(tok)
             req._pending_token = tok
             if req.on_token is not None:
@@ -1212,6 +1435,16 @@ class ServingEngine:
             self._retire(req, RequestState.FINISHED)
 
     # -- shared helpers --------------------------------------------------
+    def _note_served_version(self, req: Request) -> None:
+        """Record that THIS engine's version is emitting tokens for
+        ``req`` (lock held, just before the append). Consecutive
+        duplicates collapse, so the list stays the ordered set of
+        distinct serving versions — the DST two-version-stream auditor
+        reads it directly."""
+        v = self.model_version
+        if not req.served_versions or req.served_versions[-1] != v:
+            req.served_versions.append(v)
+
     def _release_engine_state(self, uid: int, publish: bool) -> None:
         """Release whatever the engine holds for ``uid``. ``publish``
         offers full KV blocks to the prefix cache (cancel / preempt);

@@ -80,6 +80,9 @@ STEP_RECORD_SCHEMA: Dict[str, tuple] = {
     # v1/v2 JSONL streams predate them and must keep validating.
     "trace_id": ((str,), False),
     "span_id": ((str,), False),
+    # model version the step trained/served (rollout-aware runtimes
+    # stamp it; optional — archived streams predate versioned serving)
+    "model_version": ((int,), False),
 }
 
 
@@ -111,6 +114,8 @@ class StepStats:
     # tracing join keys: the tracer's "train/step" span for this record
     trace_id: Optional[str] = None
     span_id: Optional[str] = None
+    # model version in service when the step ran (None = unversioned)
+    model_version: Optional[int] = None
     # per-op comm breakdown: {op: {"count": int, "bytes": int, "time_s": float}}
     comm: Dict[str, Dict[str, float]] = field(default_factory=dict)
     # device-memory watermarks from utils/memory.py (hbm_peak_gb, ...)
@@ -157,6 +162,10 @@ REQUEST_RECORD_SCHEMA: Dict[str, tuple] = {
     # streams predate speculative serving and must keep validating.
     "spec_proposed": ((int,), False),
     "spec_accepted": ((int,), False),
+    # model version that served the request (serving/rollout.py) —
+    # Optional, NOT a schema-version bump, same discipline as
+    # client_request_id: archived streams predate versioned serving.
+    "model_version": ((int,), False),
     "in_slo": ((bool,), False),
     "error": ((str,), False),
     # distributed-tracing join keys (telemetry/tracing.py): the request's
@@ -190,6 +199,8 @@ class RequestStats:
     # speculative drafting ledger: None when the request never drafted
     spec_proposed: Optional[int] = None
     spec_accepted: Optional[int] = None
+    # serving model version (None predates versioned serving)
+    model_version: Optional[int] = None
     in_slo: Optional[bool] = None      # None = request carried no SLO
     error: Optional[str] = None
     # tracing join keys: the request's trace and root span (tracer on)

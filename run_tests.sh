@@ -98,6 +98,22 @@ if [ "$#" -eq 0 ]; then
         smoke_rc=$region_rc
     fi
 
+    # rollout smoke (CPU evidence lane, docs/serving.md "Rollout,
+    # canary, and migration"): a scripted end-to-end canary -> promote
+    # rollout with a live migration riding along, plus the seeded
+    # versioned-serving chaos sweep (rollout / migrate / canary SLO
+    # regression / corrupt swap / death-at-flip). Gates: zero invariant
+    # violations (incl. version-stream atomicity, per-tenant version
+    # monotonicity, rollback convergence), zero lost requests, the
+    # availability dip vs a fault-free baseline bounded, bit-identical
+    # replay. Writes ROLLOUT_r01.json.
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/rollout_smoke.py
+    rollout_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$rollout_rc
+    fi
+
     # serving-scheduler smoke (CPU evidence lane, docs/serving.md): on
     # VIRTUAL time (SimClock; deterministic, no calibration or jitter
     # bands) the SLO-aware policy must serve every offered request

@@ -63,7 +63,8 @@ REPLAY_STRIDE = 20
 #: regression that stops producing one must fail loudly
 EXPECTED_KINDS = {"submit", "cancel", "tick_fault", "replica_death",
                   "latch", "scale", "stall", "cell_outage", "partition",
-                  "heal", "autoscaler_lag"}
+                  "heal", "autoscaler_lag", "rollout", "migrate",
+                  "canary_regress", "corrupt_swap", "flip_death"}
 
 
 def main() -> int:

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Zero-downtime rollout smoke: versioned serving under seeded chaos
+(docs/serving.md "Rollout, canary, and migration", docs/dst.md).
+
+CI evidence lane for the rollout/canary/migration surface (run by
+run_tests.sh):
+
+* **scripted promote** — a deterministic end-to-end rollout on the
+  virtual clock: canary -> observe -> promote -> DONE across 2 cells x
+  2 replicas with a live replica migration riding mid-rollout and
+  request traffic in flight throughout. Gates: the rollout completes,
+  every replica lands on the new version, every request finishes, no
+  stream is served by two versions, and the whole drive replays
+  bit-identically (token streams + version ledger);
+* **seeded sweep** — the first 60 generated region schedules that draw
+  a versioned-serving event (rollout / migrate / canary_regress /
+  corrupt_swap / flip_death) run through the REAL region stack with
+  all region invariants armed — including the three version
+  invariants (version-stream atomicity, per-tenant monotonicity,
+  rollback convergence). Gates: zero invariant violations; zero lost
+  requests (terminal bins partition the submitted set in every run);
+  coverage (all five event kinds exercised; rollouts started, canaries
+  went live, a swap failure, a death-at-flip and an auto-rollback all
+  observed somewhere);
+* **bounded availability dip** — every sweep schedule is re-run with
+  its versioned-serving events stripped; aggregate finished requests
+  with rollout chaos must stay within 5% of submitted of the
+  fault-free baseline (a rollout is an operation, not an outage);
+* **bit-identical replay** — a sample of sweep seeds is run twice and
+  each (event-trace hash, canonical span hash) pair must match;
+* on any violation, the failing schedule is delta-debugged to a
+  minimal reproduction and written to ROLLOUT_REPRO_<seed>.json.
+
+Pure host-side python on virtual time; the whole lane runs in seconds.
+Writes ROLLOUT_<round>.json (round via DST_ROUND, default r01).
+
+    python scripts/rollout_smoke.py [--schedules N] [--seed-base B]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+sys.path.insert(0, os.path.join(HERE, "scripts"))
+
+os.environ.setdefault("DST_ROUND", "r01")
+
+#: versioned-serving schedule events this lane exists to exercise
+VERSION_KINDS = {"rollout", "migrate", "canary_regress", "corrupt_swap",
+                 "flip_death"}
+
+#: every N-th sweep seed is replayed for the determinism gate
+REPLAY_STRIDE = 10
+
+#: aggregate finished-request dip allowed vs the stripped baseline,
+#: as a fraction of submitted
+MAX_DIP_FRACTION = 0.05
+
+
+def scripted_promote() -> dict:
+    """One deterministic full rollout with a migration riding along;
+    returns the drive's observable outcome (run twice for replay)."""
+    from deepspeed_tpu.resilience.clock import SimClock, use_clock
+    from deepspeed_tpu.resilience.dst import SimConfig, SimEngine
+    from deepspeed_tpu.serving import Region, RolloutPhase
+
+    clock = SimClock()
+    with use_clock(clock):
+        region = Region(
+            lambda: SimEngine(SimConfig()),
+            {"cells": 2, "cell_ring_vnodes": 16},
+            {"replicas": 2, "router": "least_loaded", "respawn": False},
+            {"policy": "slo", "stuck_tick_timeout_s": 0.0,
+             "drain_timeout_s": 600.0, "poll_interval_s": 0.25,
+             "rollout": {"canary_fraction": 0.5,
+                         "canary_observe_ticks": 4,
+                         "slo_regression_threshold": 0.2,
+                         "min_canary_samples": 2, "warmup_ticks": 1,
+                         "swap_retry_limit": 2, "max_flip_attempts": 4}},
+            start=False, clock=clock)
+        reqs = []
+        migrated = False
+        for tick in range(200):
+            if tick < 12 and tick % 2 == 0:
+                reqs.append(region.submit(
+                    [1, 2, 3 + tick], max_new_tokens=6,
+                    tenant=f"tenant-{tick % 4}"))
+            if tick == 4:
+                assert region.start_rollout(1, fraction=0.5)
+            if (not migrated
+                    and region.rollout.phase == RolloutPhase.PROMOTING):
+                cell = region.live_cells[0]
+                victim = sorted(r.name
+                                for r in cell.fleet.healthy_replicas)[0]
+                migrated = region.migrate_replica(cell.name, victim)
+            region.step()
+            clock.advance(1.0)
+            if (region.rollout.phase == RolloutPhase.DONE
+                    and all(r.is_terminal for r in reqs)):
+                break
+        return {
+            "phase": region.rollout.phase,
+            "migrated": migrated,
+            "states": [r.state.name for r in reqs],
+            "tokens": [list(r.tokens) for r in reqs],
+            "two_version_streams": sum(
+                len(set(r.served_versions)) > 1 for r in reqs),
+            "replica_versions": sorted(
+                rep.version for c in region.live_cells
+                for rep in c.fleet.replicas
+                if rep.state != "dead"),
+            "version_log": [(row["kind"], row["version"])
+                            for row in region.version_log],
+        }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--schedules", type=int, default=60,
+                    help="versioned-serving schedules to sweep")
+    ap.add_argument("--seed-base", type=int, default=0)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+    if not args.verbose:
+        logging.disable(logging.WARNING)   # the faults ARE the workload
+
+    from deepspeed_tpu.resilience.dst import (dump_repro,
+                                              generate_region_schedule,
+                                              run_region_schedule,
+                                              shrink_schedule)
+    from deepspeed_tpu.serving.region import Region
+
+    t0 = time.monotonic()
+
+    # -- scripted promote (twice: the second run is the replay gate) ---
+    s1 = scripted_promote()
+    s2 = scripted_promote()
+    scripted_gates = {
+        "scripted_rollout_done": s1["phase"] == "done",
+        "scripted_migration_ran": bool(s1["migrated"]),
+        "scripted_zero_lost": all(s == "FINISHED" for s in s1["states"]),
+        "scripted_single_version_streams": s1["two_version_streams"] == 0,
+        "scripted_all_replicas_promoted": all(
+            v == 1 for v in s1["replica_versions"]),
+        "scripted_replay_identical": s1 == s2,
+    }
+
+    # -- seeded sweep --------------------------------------------------
+    picked = []
+    seed = args.seed_base
+    while len(picked) < args.schedules and seed < args.seed_base + 4000:
+        sched = generate_region_schedule(seed)
+        if any(e.kind in VERSION_KINDS for e in sched.events):
+            picked.append((seed, sched))
+        seed += 1
+
+    captured = {}
+
+    def probe_factory(probe_seed):
+        class _Probe(Region):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                captured[probe_seed] = self
+        return _Probe
+
+    failures = []            # (seed, violations)
+    lost = []                # seeds where terminal bins != submitted
+    hashes = {}
+    kinds_seen = set()
+    row_counts = {"start": 0, "canary_live": 0, "swap_failed": 0,
+                  "flip_death": 0, "rollback": 0, "rolled_back": 0,
+                  "promote": 0, "done": 0}
+    totals = {"submitted": 0, "finished": 0, "cancelled": 0,
+              "rejected": 0, "ticks": 0, "events": 0}
+    finished_baseline = 0
+    for sweep_seed, sched in picked:
+        kinds_seen |= {e.kind for e in sched.events}
+        report = run_region_schedule(sched,
+                                     region_factory=probe_factory(
+                                         sweep_seed))
+        hashes[sweep_seed] = (report.trace_hash, report.span_hash)
+        for k in ("submitted", "finished", "cancelled", "rejected"):
+            totals[k] += getattr(report, k)
+        totals["ticks"] += report.n_ticks
+        totals["events"] += report.n_events
+        if (report.finished + report.cancelled + report.rejected
+                != report.submitted):
+            lost.append(sweep_seed)
+        for row in captured[sweep_seed].version_log:
+            if row["kind"] in row_counts:
+                row_counts[row["kind"]] += 1
+        if not report.ok:
+            failures.append((sweep_seed, report.violations))
+            print(f"[rollout-smoke] seed {sweep_seed}: "
+                  f"{len(report.violations)} violation(s); first: "
+                  f"{report.violations[0]}")
+        # availability baseline: same schedule, version events stripped
+        baseline = sched.replace_events(
+            [e for e in sched.events if e.kind not in VERSION_KINDS])
+        finished_baseline += run_region_schedule(baseline).finished
+
+    replayed = 0
+    mismatches = []
+    for sweep_seed, _ in picked[::REPLAY_STRIDE]:
+        replayed += 1
+        rep = run_region_schedule(generate_region_schedule(sweep_seed))
+        if (rep.trace_hash, rep.span_hash) != hashes[sweep_seed]:
+            mismatches.append(sweep_seed)
+    wall = time.monotonic() - t0
+
+    dip = finished_baseline - totals["finished"]
+    gates = dict(scripted_gates)
+    gates.update({
+        "zero_invariant_violations": not failures,
+        "zero_lost_requests": not lost,
+        "all_version_kinds_exercised": VERSION_KINDS <= kinds_seen,
+        "rollouts_started": row_counts["start"] > 0,
+        "canaries_went_live": row_counts["canary_live"] > 0,
+        "swap_failure_exercised": row_counts["swap_failed"] > 0,
+        "flip_death_exercised": row_counts["flip_death"] > 0,
+        "rollback_exercised": row_counts["rollback"] > 0,
+        "bounded_availability_dip":
+            dip <= MAX_DIP_FRACTION * max(1, totals["submitted"]),
+        "deterministic_replay": not mismatches,
+    })
+    report = {
+        "metric": "rollout_smoke_invariant_violations_and_dip",
+        "schedules": len(picked),
+        "seed_base": args.seed_base,
+        "scripted_promote": {k: v for k, v in s1.items()
+                             if k not in ("tokens",)},
+        "version_log_rows": row_counts,
+        "fault_kinds_exercised": sorted(kinds_seen & VERSION_KINDS),
+        "totals": totals,
+        "finished_baseline": finished_baseline,
+        "finished_dip": dip,
+        "max_dip_allowed": int(MAX_DIP_FRACTION * totals["submitted"]),
+        "replayed_for_determinism": replayed,
+        "replay_mismatch_seeds": mismatches,
+        "lost_request_seeds": lost,
+        "failing_seeds": [s for s, _ in failures],
+        "wall_s": round(wall, 2),
+        "gates": gates,
+        "value": len(failures),
+    }
+    from _artifact import write_artifact
+
+    path = write_artifact("ROLLOUT", report, device="host-sim")
+    print(f"[rollout-smoke] scripted promote: phase={s1['phase']} "
+          f"migrated={s1['migrated']} "
+          f"{len(s1['states'])} requests all "
+          f"{'FINISHED' if scripted_gates['scripted_zero_lost'] else 'NOT finished'}")
+    print(f"[rollout-smoke] sweep: {len(picked)} schedules, "
+          f"{totals['submitted']} requests "
+          f"({totals['finished']} finished), rollout rows {row_counts}")
+    print(f"[rollout-smoke] availability: finished {totals['finished']} "
+          f"vs {finished_baseline} fault-free (dip {dip}, "
+          f"allowed {report['max_dip_allowed']})")
+    print(f"[rollout-smoke] artifact: {path}")
+
+    for sweep_seed, violations in failures:
+        try:
+            shrunk = shrink_schedule(generate_region_schedule(sweep_seed))
+        except ValueError:
+            shrunk = generate_region_schedule(sweep_seed)
+        repro = os.path.join(HERE, f"ROLLOUT_REPRO_{sweep_seed}.json")
+        shrunk_report = run_region_schedule(shrunk)
+        dump_repro(shrunk, shrunk_report.violations or violations, repro,
+                   timeline=shrunk_report.spans)
+        print(f"[rollout-smoke] seed {sweep_seed}: minimal repro "
+              f"({len(shrunk.events)} events) -> {repro}")
+
+    failed = [g for g, ok in gates.items() if not ok]
+    if failed:
+        print(f"rollout smoke: FAILED gates {failed}")
+        return 1
+    print(f"rollout smoke: OK — scripted promote replayed "
+          f"bit-identically, {len(picked)} versioned-serving chaos "
+          f"schedules with zero invariant violations, zero lost "
+          f"requests, availability dip {dip} within bound")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

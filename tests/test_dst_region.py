@@ -18,6 +18,7 @@ from deepspeed_tpu.resilience.dst import (RegionSchedule, SimConfig,
                                           shrink_schedule)
 from deepspeed_tpu.serving.region import Region
 from deepspeed_tpu.serving.request import RequestState
+from deepspeed_tpu.serving.rollout import RolloutController, RolloutPhase
 
 pytestmark = pytest.mark.fleet
 
@@ -57,7 +58,19 @@ REGION_REGRESSION_SEEDS = [
           # built behind a partition is re-spread onto rejoined
           # capacity while the shed ladder is active
     51,   # everything at once: cell outage + partition + replica death
-          # + heal + rebalance in one disaggregated 3-cell schedule
+          # + heal + rebalance in one disaggregated 3-cell schedule —
+          # now with a rollout riding on top (the seed that exposed the
+          # _escalate_handoff row-restoration bug under version-affine
+          # hand-offs)
+    5,    # live migration DURING a partition in a disaggregated region
+          # — the KV hand-off wire crosses an unreachable boundary and
+          # must degrade, never strand
+    20,   # rollout during death: canary/promote flips racing a cell
+          # outage, replica deaths, an injected death-at-flip AND a
+          # scheduled live migration
+    50,   # versioned-serving everything-at-once: rollout + canary SLO
+          # regression + corrupt swap + death-at-flip + migration under
+          # partition/heal in a disaggregated region
 ]
 
 
@@ -90,7 +103,16 @@ def test_corpus_seeds_cover_the_named_scenarios():
     assert {"partition", "heal"} <= kinds45 and rb45 > 0
     disagg51, kinds51, _ = feats[51]
     assert disagg51 and {"cell_outage", "partition", "heal",
-                         "replica_death"} <= kinds51
+                         "replica_death", "rollout"} <= kinds51
+    disagg5, kinds5, _ = feats[5]
+    assert disagg5 and {"migrate", "partition"} <= kinds5
+    _, kinds20, _ = feats[20]
+    assert {"rollout", "flip_death", "migrate", "cell_outage",
+            "replica_death"} <= kinds20
+    disagg50, kinds50, _ = feats[50]
+    assert disagg50 and {"rollout", "canary_regress", "corrupt_swap",
+                         "flip_death", "migrate", "partition",
+                         "heal"} <= kinds50
 
 
 def test_region_mini_soak_window():
@@ -185,6 +207,71 @@ class _SilentShedRegion(Region):
         req.transition(RequestState.REJECTED)
 
 
+class _LeakyFlipController(RolloutController):
+    """PLANTED BUG: the flip skips the drain seam. Every replica's
+    version is rewritten IN PLACE — no stop_admission, no drain, no
+    warmup — so a stream mid-decode emits tokens under the old version
+    and then the new one (the exact bug hot_swap's drained-engine
+    contract exists to make impossible)."""
+
+    def _step_flip(self, to_version):
+        flipped = False
+        for fleet in self._fleets():
+            for rep in fleet.healthy_replicas:
+                if rep.version != to_version:
+                    with rep.serving._lock:
+                        rep.serving.model_version = int(to_version)
+                    flipped = True
+        return "flipped" if flipped else "clean"
+
+
+class _LeakyFlipRegion(Region):
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._rollout = _LeakyFlipController(self, self._rollout.config,
+                                             self._clock)
+
+
+class _NoConvergeController(RolloutController):
+    """PLANTED BUG: rollback declares victory without doing the work.
+    The observe window trips an immediate rollback, and ROLLING_BACK
+    jumps straight to ROLLED_BACK — the canary replica is left stranded
+    on the abandoned version while the controller reports the region
+    converged back to stable."""
+
+    def _step_observing(self):
+        self._begin_rollback("planted: forced regression")
+
+    def _step_rolling_back(self):
+        with self._lock:
+            self._phase = RolloutPhase.ROLLED_BACK
+            self._log("rolled_back", self.target_version)
+            self._flip = None
+
+
+class _NoConvergeRegion(Region):
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._rollout = _NoConvergeController(self, self._rollout.config,
+                                              self._clock)
+
+
+def test_auditor_catches_leaky_flip_two_version_stream():
+    report = run_region_schedule(generate_region_schedule(4),
+                                 region_factory=_LeakyFlipRegion)
+    assert not report.ok
+    assert any("version-stream" in v
+               for v in report.violations), report.violations
+
+
+def test_auditor_catches_rollback_that_never_converges():
+    report = run_region_schedule(generate_region_schedule(4),
+                                 region_factory=_NoConvergeRegion)
+    assert not report.ok
+    assert any("rollback-convergence" in v
+               for v in report.violations), report.violations
+
+
 def test_auditor_catches_double_ownership_after_heal():
     report = run_region_schedule(generate_region_schedule(48),
                                  region_factory=_DoubleOwnRegion)
@@ -220,7 +307,7 @@ def test_auditor_catches_silent_shed():
 def test_clean_region_passes_where_bugs_fail():
     """The planted-bug seeds are not self-failing: the SHIPPED region
     audits clean on every one of them."""
-    for seed in (48, 30, 17):
+    for seed in (48, 30, 17, 4):
         report = run_region_schedule(generate_region_schedule(seed))
         assert report.ok, (seed, report.violations)
 

@@ -174,6 +174,49 @@ def test_checkpoint_cross_stage_reload(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0)
 
 
+def test_checkpoint_model_version_manifest_field(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint import tag_model_version
+
+    engine = _make_engine()
+    engine.train_batch(make_batch(16))
+    engine.save_checkpoint(str(tmp_path), tag="v3", model_version=3)
+    engine.save_checkpoint(str(tmp_path), tag="plain")
+    assert tag_model_version(str(tmp_path / "v3")) == 3
+    # unversioned checkpoints (and garbage paths) read back as None —
+    # the field is optional, not a manifest version bump
+    assert tag_model_version(str(tmp_path / "plain")) is None
+    assert tag_model_version(str(tmp_path / "no-such-tag")) is None
+
+
+def test_hot_swap_checkpoint_swaps_weights_only(tmp_path):
+    """The serving-rollout load path: params flip to the checkpoint's,
+    optimizer state / step counters / rng stay the running worker's."""
+    donor = _make_engine(zero_stage=2)
+    batch = make_batch(16)
+    for _ in range(2):
+        donor.train_batch(batch)
+    donor.save_checkpoint(str(tmp_path), tag="v7", model_version=7)
+    want = [np.asarray(x) for x in jax.tree_util.tree_leaves(donor.params)]
+
+    live = _make_engine(zero_stage=2)
+    live.train_batch(batch)
+    step_before = live.global_steps
+    opt_before = [np.asarray(x) for x
+                  in jax.tree_util.tree_leaves(live.opt_state)]
+    assert live.hot_swap_checkpoint(str(tmp_path), tag="v7") == 7
+    for a, b in zip(want, jax.tree_util.tree_leaves(live.params)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=0, atol=0)
+    assert live.global_steps == step_before
+    for a, b in zip(opt_before,
+                    jax.tree_util.tree_leaves(live.opt_state)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=0, atol=0)
+    # training continues on the swapped weights
+    live.train_batch(batch)
+    # an invalid tag refuses loudly instead of half-swapping
+    with pytest.raises(ValueError):
+        live.hot_swap_checkpoint(str(tmp_path), tag="torn")
+
+
 def test_save_16bit_model(tmp_path):
     engine = _make_engine()
     path = engine.save_16bit_model(str(tmp_path))

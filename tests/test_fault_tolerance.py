@@ -493,3 +493,94 @@ def test_agent_backoff_reasons_and_heartbeat(tmp_path):
         assert json.load(f)["state"] == "done"
     assert get_registry().counter(
         "resilience/restart_reasons/exit:7").value >= 2
+
+
+def test_classify_exit_taxonomy():
+    import signal as _signal
+
+    from deepspeed_tpu.launcher.agent import (PLANNED_ROLLOUT_EXIT,
+                                              classify_exit)
+
+    assert classify_exit(7) == "exit:7"
+    assert classify_exit(-int(_signal.SIGKILL)) == "signal:SIGKILL"
+    assert classify_exit(PLANNED_ROLLOUT_EXIT) == "planned:rollout"
+    # the planned taxonomy is opt-out: with no planned codes, 86 is just
+    # another failure
+    assert classify_exit(PLANNED_ROLLOUT_EXIT,
+                         planned_codes=()) == "exit:86"
+
+
+def test_agent_planned_rollout_restart_is_free(tmp_path):
+    """A worker exiting PLANNED_ROLLOUT_EXIT (rollout reload) relaunches
+    immediately: no restart budget consumed, no backoff slept — with
+    max_restarts=0 two planned reloads still reach the clean exit."""
+    import sys
+
+    from deepspeed_tpu.launcher.agent import (PLANNED_ROLLOUT_EXIT,
+                                              ElasticAgent)
+
+    log = tmp_path / "launches"
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        f"p = {str(log)!r}\n"
+        "n = int(os.environ['DST_ELASTIC_RESTART'])\n"
+        "open(p, 'a').write(str(n) + '\\n')\n"
+        f"sys.exit(0 if n >= 2 else {PLANNED_ROLLOUT_EXIT})\n")
+
+    def no_sleep(d):
+        raise AssertionError(f"planned reload slept {d}s")
+
+    agent = ElasticAgent([sys.executable, str(script)], max_restarts=0,
+                         sleep=no_sleep)
+    report = agent.run()
+    assert report.succeeded and report.restarts == 0
+    assert report.planned_restarts == 2
+    assert report.reasons == ["planned:rollout", "planned:rollout"]
+    # the reload counter still increments so the trainee resumes from
+    # its latest checkpoint on every planned launch
+    assert log.read_text().split() == ["0", "1", "2"]
+    assert get_registry().counter(
+        "resilience/restart_reasons/planned:rollout").value >= 2
+
+
+def test_agent_planned_cap_falls_through_to_failure(tmp_path):
+    """Past max_planned_restarts a 'planned' exit is treated as the
+    crash loop it is: budget consumed, backoff slept."""
+    import sys
+
+    from deepspeed_tpu.launcher.agent import (PLANNED_ROLLOUT_EXIT,
+                                              ElasticAgent)
+
+    script = tmp_path / "worker.py"
+    script.write_text(f"import sys; sys.exit({PLANNED_ROLLOUT_EXIT})\n")
+    delays = []
+    agent = ElasticAgent([sys.executable, str(script)], max_restarts=1,
+                         backoff_s=0.01, max_planned_restarts=2,
+                         sleep=delays.append, rng=random.Random(0))
+    report = agent.run()
+    assert not report.succeeded
+    assert report.returncode == PLANNED_ROLLOUT_EXIT
+    assert report.planned_restarts == 2
+    assert report.restarts == 1
+    assert len(delays) == 1     # only the budgeted restart backs off
+
+
+def test_agent_heartbeat_marks_planned_window(tmp_path):
+    """The restarting heartbeat during a planned reload carries
+    planned=true and a zero delay, so an external watchdog reads the
+    flip window as routine instead of paging."""
+    from deepspeed_tpu.launcher.agent import ElasticAgent
+
+    hb = str(tmp_path / "hb.json")
+    agent = ElasticAgent(["true"], heartbeat_path=hb)
+    agent._write_status("restarting", 0, reason="planned:rollout",
+                        next_delay_s=0.0)
+    with open(hb) as f:
+        rec = json.load(f)
+    assert rec["planned"] is True
+    assert rec["next_delay_s"] == 0.0
+    agent._write_status("restarting", 1, reason="exit:7",
+                        next_delay_s=0.5)
+    with open(hb) as f:
+        assert "planned" not in json.load(f)

@@ -1,0 +1,240 @@
+"""Rollout controller, hot-swap seam, and live migration — direct unit
+tests on the virtual clock (docs/serving.md "Rollout, canary, and
+migration"). The seeded fault compositions live in tests/test_dst_region
+(rollout/migrate/canary_regress/corrupt_swap/flip_death schedule events
++ the version-stream / version-monotonic / rollback-convergence
+invariants); here each seam is driven in isolation: canary -> observe ->
+promote -> done, start refusals, corrupt-swap fallback + auto-rollback,
+death-at-flip re-targeting, live migration under traffic, and the
+drained-engine hot_swap contract.
+"""
+
+import pytest
+
+from deepspeed_tpu.resilience.chaos import (FaultInjector,
+                                            install_fault_injector)
+from deepspeed_tpu.resilience.clock import SimClock, use_clock
+from deepspeed_tpu.resilience.dst import SimConfig, SimEngine
+from deepspeed_tpu.serving import (Region, RolloutPhase, TERMINAL_PHASES)
+from deepspeed_tpu.serving.fleet import ReplicaState
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    install_fault_injector(None)
+    yield
+    install_fault_injector(None)
+
+
+_FAST_ROLLOUT = {"canary_fraction": 0.5, "canary_observe_ticks": 3,
+                 "slo_regression_threshold": 0.2, "min_canary_samples": 2,
+                 "warmup_ticks": 1, "swap_retry_limit": 2,
+                 "max_flip_attempts": 4}
+
+
+def _region(clock, cells=2, replicas=2, *, rollout=None, fleet_cfg=None):
+    rc = {"cells": cells, "cell_ring_vnodes": 16}
+    fc = {"replicas": replicas, "router": "least_loaded", "respawn": False}
+    fc.update(fleet_cfg or {})
+    sc = {"policy": "slo", "stuck_tick_timeout_s": 0.0,
+          "drain_timeout_s": 600.0, "poll_interval_s": 0.25,
+          "rollout": dict(_FAST_ROLLOUT, **(rollout or {}))}
+    return Region(lambda: SimEngine(SimConfig()), rc, fc, sc,
+                  start=False, clock=clock)
+
+
+def _replicas(region):
+    return [r for c in region.live_cells for r in c.fleet.replicas]
+
+
+def _drive_until(region, clock, pred, max_ticks=600):
+    for _ in range(max_ticks):
+        if pred():
+            return
+        region.step()
+        clock.advance(1.0)
+    raise AssertionError(f"condition not reached in {max_ticks} ticks "
+                         f"(phase {region.rollout.phase})")
+
+
+def _log_kinds(region):
+    return [row["kind"] for row in region.version_log]
+
+
+# ----------------------------------------------------------------------
+# happy path: canary -> observe -> promote -> done
+# ----------------------------------------------------------------------
+
+def test_rollout_promotes_every_replica_with_zero_lost_requests():
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock)
+        reqs = [region.submit([1, 2, 3 + i], max_new_tokens=6,
+                              tenant=f"tenant-{i % 3}") for i in range(6)]
+        assert region.start_rollout(1, fraction=0.5)
+        assert region.rollout.active
+        _drive_until(region, clock,
+                     lambda: region.rollout.phase == RolloutPhase.DONE)
+        _drive_until(region, clock,
+                     lambda: all(r.is_terminal for r in reqs))
+        # every replica flipped, nobody lost, no stream saw two versions
+        assert all(r.version == 1 for r in _replicas(region))
+        assert all(r.state.name == "FINISHED" for r in reqs)
+        assert all(len(set(r.served_versions)) <= 1 for r in reqs)
+        kinds = _log_kinds(region)
+        assert ["start", "canary_live", "promote", "done"] == \
+            [k for k in kinds if k in ("start", "canary_live",
+                                       "promote", "done")]
+        # the ledger rows carry the target version and the virtual time
+        assert all(row["version"] == 1 for row in region.version_log)
+        # late capacity spawns on the promoted version
+        cell = region.live_cells[0]
+        cell.fleet.scale_to(3)
+        assert all(r.version == 1 for r in cell.fleet.replicas
+                   if r.state is not ReplicaState.DEAD)
+
+
+def test_rollout_start_refusals_and_rearm():
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=1, replicas=1)
+        # versions are monotonic by contract: no-op and backwards refuse
+        assert not region.start_rollout(0)
+        assert region.start_rollout(1)
+        # one rollout at a time
+        assert not region.start_rollout(2)
+        _drive_until(region, clock,
+                     lambda: region.rollout.phase in TERMINAL_PHASES)
+        assert region.rollout.phase == RolloutPhase.DONE
+        # terminal phases re-arm the controller
+        assert region.start_rollout(2)
+        _drive_until(region, clock,
+                     lambda: region.rollout.phase == RolloutPhase.DONE)
+        assert all(r.version == 2 for r in _replicas(region))
+
+
+# ----------------------------------------------------------------------
+# fault paths: corrupt swap, death at the flip point
+# ----------------------------------------------------------------------
+
+def test_corrupt_swap_falls_back_then_rolls_back_without_stranding():
+    clock = SimClock()
+    with use_clock(clock):
+        inj = FaultInjector(seed=0)
+        inj.arm_corrupt_swap(99)      # every swap attempt fails
+        install_fault_injector(inj)
+        region = _region(clock, cells=1, replicas=2)
+        assert region.start_rollout(1)
+        _drive_until(region, clock,
+                     lambda: region.rollout.phase
+                     == RolloutPhase.ROLLED_BACK)
+        # the failed swaps fell back in place: still on stable, still
+        # serving — a failed rollout must never strand a replica
+        for rep in _replicas(region):
+            assert rep.version == 0
+            assert rep.accepting
+        kinds = _log_kinds(region)
+        assert "swap_failed" in kinds
+        assert "rollback" in kinds and "rolled_back" in kinds
+        req = region.submit([1, 2, 3], max_new_tokens=4)
+        _drive_until(region, clock, lambda: req.is_terminal)
+        assert req.state.name == "FINISHED"
+
+
+def test_flip_death_retargets_and_still_promotes():
+    clock = SimClock()
+    with use_clock(clock):
+        inj = FaultInjector(seed=0)
+        inj.arm_flip_death(1)         # first flip victim dies at the swap
+        install_fault_injector(inj)
+        region = _region(clock, cells=1, replicas=3)
+        assert region.start_rollout(1)
+        _drive_until(region, clock,
+                     lambda: region.rollout.phase in TERMINAL_PHASES)
+        assert region.rollout.phase == RolloutPhase.DONE
+        assert "flip_death" in _log_kinds(region)
+        live = [r for r in _replicas(region)
+                if r.state is not ReplicaState.DEAD]
+        assert live and all(r.version == 1 for r in live)
+        # exactly the one injected death
+        assert sum(r.state is ReplicaState.DEAD
+                   for r in _replicas(region)) == 1
+
+
+# ----------------------------------------------------------------------
+# live migration
+# ----------------------------------------------------------------------
+
+def test_migrate_replica_under_traffic_loses_nothing():
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=1, replicas=2)
+        reqs = [region.submit([1, 2, 3 + i], max_new_tokens=8)
+                for i in range(4)]
+        # let decodes get going so the migration has live KV to move
+        for _ in range(3):
+            region.step()
+            clock.advance(1.0)
+        cell = region.live_cells[0]
+        victim = cell.fleet.replicas[0].name
+        assert region.migrate_replica(cell.name, victim)
+        _drive_until(region, clock,
+                     lambda: all(r.is_terminal for r in reqs))
+        assert all(r.state.name == "FINISHED" for r in reqs)
+        states = {r.name: r.state for r in cell.fleet.replicas}
+        assert states[victim] is ReplicaState.DEAD
+        # replacement joined: pre-migration healthy count is preserved
+        assert len(cell.fleet.healthy_replicas) == 2
+
+
+def test_migrate_replica_refuses_unknown_and_dead_cell():
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=2, replicas=1)
+        assert not region.migrate_replica("cell-0", "no-such-replica")
+        assert not region.migrate_replica("no-such-cell",
+                                          "cell-0/replica-0")
+        region.kill_cell("cell-1", reason="test")
+        name = "cell-1/replica-0"
+        assert not region.migrate_replica("cell-1", name)
+
+
+# ----------------------------------------------------------------------
+# the hot_swap drained-engine contract
+# ----------------------------------------------------------------------
+
+def test_hot_swap_requires_drained_admission_stopped_engine():
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=1, replicas=1)
+        serving = _replicas(region)[0].serving
+        # accepting engine: the contract violation is loud, not silent
+        with pytest.raises(RuntimeError):
+            serving.hot_swap(1)
+        serving.stop_admission()
+        assert serving.hot_swap(1, warmup_ticks=2)
+        assert serving.model_version == 1
+        # AOT warmup window: non-accepting for warmup_ticks engine ticks
+        assert not serving._accepting
+        for _ in range(3):
+            region.step()
+            clock.advance(1.0)
+        assert serving._accepting
+
+
+def test_hot_swap_load_failure_resumes_on_old_version():
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=1, replicas=1)
+        serving = _replicas(region)[0].serving
+        serving.stop_admission()
+
+        def bad_load():
+            raise OSError("checkpoint shard missing")
+
+        assert not serving.hot_swap(1, load_fn=bad_load)
+        # fallback: old weights, old version, admission re-opened
+        assert serving.model_version == 0
+        assert serving._accepting
