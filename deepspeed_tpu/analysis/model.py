@@ -708,6 +708,16 @@ class _Resolver:
                     site.targets = [got]
                     return site
         # weak: unique method name ------------------------------------
+        # ... but never on the result of a call the model cannot
+        # resolve: ``hashlib.sha256(data).digest()`` is a method on an
+        # EXTERNAL object, and weak-resolving it to the one package
+        # method named ``digest`` (ServingCell.digest) planted a
+        # phantom Fleet->Cell edge in the lock graph that no runtime
+        # path can ever exercise (race-lane hot-edge gate).
+        if isinstance(recv, ast.Call):
+            inner = self.resolve(recv, owner, local_defs, local_types)
+            if not inner.targets:
+                return site
         if func.attr not in _WEAK_RESOLVE_BLOCKLIST:
             keys = self.pkg.method_index.get(func.attr, set())
             if len(keys) == 1:

@@ -1047,7 +1047,16 @@ class RegionConfig:
 
     ``rebalance_threshold`` (queued requests per replica above the
     reachable mean, 0 = off) lets a heal re-spread QUEUED work from
-    cells that bore the partition onto the rejoined capacity."""
+    cells that bore the partition onto the rejoined capacity.
+
+    Telemetry plane (docs/observability.md "Region rollups"): every
+    ``telemetry_rollup_every``-th digest refresh the region pulls each
+    cell's telemetry digest delta (sketch merges + counter deltas +
+    SLO verdicts) into its accumulator and SLO tracker. The ``slo_*``
+    knobs parameterize the per-tenant SLO objective
+    (:class:`~deepspeed_tpu.telemetry.slo.SLOObjective`): target in-SLA
+    ratio over ``slo_window_s`` of virtual time, with fast/slow
+    burn-rate alert windows and thresholds."""
 
     cells: int = 2
     cell_ring_vnodes: int = 32
@@ -1056,6 +1065,14 @@ class RegionConfig:
     brownout_exit_ratio: float = 0.5
     rebalance_threshold: float = 4.0
     health_interval_s: float = 0.05
+    telemetry_rollup_every: int = 1
+    slo_target: float = 0.95
+    slo_window_s: float = 240.0
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+    slo_fast_burn: float = 14.4
+    slo_slow_burn: float = 6.0
+    slo_min_samples: int = 4
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "RegionConfig":
@@ -1073,6 +1090,16 @@ class RegionConfig:
             rebalance_threshold=float(
                 _take(d, "rebalance_threshold", 4.0)),
             health_interval_s=float(_take(d, "health_interval_s", 0.05)),
+            telemetry_rollup_every=int(
+                _take(d, "telemetry_rollup_every", 1)),
+            slo_target=float(_take(d, "slo_target", 0.95)),
+            slo_window_s=float(_take(d, "slo_window_s", 240.0)),
+            slo_fast_window_s=float(_take(d, "slo_fast_window_s", 300.0)),
+            slo_slow_window_s=float(
+                _take(d, "slo_slow_window_s", 3600.0)),
+            slo_fast_burn=float(_take(d, "slo_fast_burn", 14.4)),
+            slo_slow_burn=float(_take(d, "slo_slow_burn", 6.0)),
+            slo_min_samples=int(_take(d, "slo_min_samples", 4)),
         )
         if out.cells < 1:
             raise ConfigError(
@@ -1095,6 +1122,12 @@ class RegionConfig:
             raise ConfigError(
                 f"serving.region.rebalance_threshold must be >= 0, got "
                 f"{out.rebalance_threshold}")
+        if out.telemetry_rollup_every < 1:
+            # 0 would divide-by-zero the poll's cadence modulo; a named
+            # error at parse beats a ZeroDivisionError mid-rollup
+            raise ConfigError(
+                f"serving.region.telemetry_rollup_every must be >= 1, "
+                f"got {out.telemetry_rollup_every}")
         _warn_unknown(d, "serving.region")
         return out
 

@@ -155,6 +155,18 @@ class ServingCell:
             self._digest = d
         return d
 
+    def publish_telemetry(self, t: float):
+        """Collect this cell's telemetry digest delta (replica sketches
+        + fleet verdict source merged into one fixed-size
+        :class:`~deepspeed_tpu.telemetry.digest.TelemetryDigest`) for
+        the region's rollup — same publish-not-scan cadence as
+        :meth:`publish_digest`, a separate channel so the routing digest
+        stays a flat frozen row."""
+        with self._lock:
+            if self._state == CellState.DEAD:
+                return None
+        return self.fleet.collect_telemetry_digest(t)
+
     # -- failure / shutdown ---------------------------------------------
     def kill(self, reason: str = "cell outage") -> List[Request]:
         """Whole-cell death: every replica dies at once, every

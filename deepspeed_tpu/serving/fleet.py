@@ -199,6 +199,13 @@ class ServingFleet:
         # sliding in-SLA window feeding the autoscaler (True/False per
         # SLO-carrying terminal request; cancels and SLO-less skipped)
         self._sla_window = collections.deque(maxlen=config.sla_window)
+        # fleet-tier digest source (telemetry/digest.py): per-tenant /
+        # per-version SLO verdicts recorded at retire time, published as
+        # deltas up the cell→region rollup alongside the replica sketches
+        from ..telemetry.digest import DigestSource
+
+        self.telemetry_source = DigestSource(
+            f"{name}/fleet" if name else "fleet")
         # versioned serving (docs/serving.md "Rollout, canary, and
         # migration"): _fleet_version is what NEW replicas (spawn,
         # respawn, migration replacement) serve; _canary is the active
@@ -517,7 +524,7 @@ class ServingFleet:
                             and req.model_version is not None else None)
                     soft = None
                     if hard is None and self._canary is not None:
-                        soft = (self._canary[0] if self._canary_slice(req)  # dslint: disable=lock-discipline -- _canary_slice only hashes (router._hash64); the ".digest()" in its chain is hashlib's, name-resolved to ServingCell.digest by the static call graph — no cell lock is taken
+                        soft = (self._canary[0] if self._canary_slice(req)
                                 else self._fleet_version)
                         if soft == self._canary[0]:
                             self._count("canary_assigned")
@@ -772,6 +779,23 @@ class ServingFleet:
                 return None
             return sum(self._sla_window) / len(self._sla_window)
 
+    def collect_telemetry_digest(self, t: float):
+        """One rollup pass over this fleet (cell tier calls it on the
+        monitor cadence): publish-and-merge every live replica's digest
+        delta plus the fleet's own verdict source into ONE fixed-size
+        digest for the region. The per-replica walk happens HERE, never
+        on a region read."""
+        with self._lock:
+            replicas = list(self._replicas.values())
+        out = self.telemetry_source.publish(t)
+        for r in replicas:
+            # DEAD replicas included: a replica that died after emitting
+            # spans still holds unpublished deltas, and deltas already
+            # observed are valid history — skipping them would undercount
+            # the pooled stream
+            out.merge(r.serving.digest.publish(t))
+        return out
+
     # -- replica-driver callbacks (OUTSIDE the replica's serving lock) ---
     def _on_retire(self, req: Request) -> None:
         # same verdict discipline as the request span: completions judged
@@ -779,17 +803,28 @@ class ServingFleet:
         # user cancels not judged
         had_slo = (req.deadline_s is not None
                    or req.ttft_deadline_s is not None)
+        if req.state is RequestState.FINISHED:
+            verdict = req.in_slo()
+        elif had_slo and not (req.state is RequestState.CANCELLED
+                              and req.error is None):
+            verdict = False
+        else:
+            verdict = None
         with self._lock:
             self._requests.pop(req.uid, None)
-            if req.state is RequestState.FINISHED:
-                verdict = req.in_slo()
-                if verdict is not None:
-                    self._sla_window.append(bool(verdict))
-                    self._note_version_sla(req, bool(verdict))
-            elif had_slo and not (req.state is RequestState.CANCELLED
-                                  and req.error is None):
-                self._sla_window.append(False)
-                self._note_version_sla(req, False)
+            if verdict is not None:
+                self._sla_window.append(bool(verdict))
+                self._note_version_sla(req, bool(verdict))
+        if verdict is not None:
+            # rollup-plane verdict (outside the fleet lock — the source
+            # has its own leaf lock): per-tenant attainment and the
+            # canary judge both read this via the region's SLO tracker
+            self.telemetry_source.slo_verdict(req.tenant,
+                                              req.model_version,
+                                              bool(verdict))
+            self.telemetry_source.count("slo_judged")
+            if verdict:
+                self.telemetry_source.count("slo_met")
         if self._retire_hook is not None:
             # region bookkeeping, chained OUTSIDE the fleet lock (the
             # hook takes the Region lock; region -> cell -> fleet is the

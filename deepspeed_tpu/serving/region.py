@@ -54,6 +54,8 @@ fleet under ``serving/<cell>/fleet/...`` and its replicas under
 from __future__ import annotations
 
 import collections
+import hashlib
+import json
 import random
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -61,6 +63,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ..resilience.chaos import get_fault_injector, is_reachable
 from ..resilience.clock import Clock, get_clock
 from ..resilience.locksan import named_rlock
+from ..telemetry.digest import DigestAccumulator, DigestSource
+from ..telemetry.slo import SLOObjective, TenantSLOTracker
 from ..telemetry.tracing import get_tracer, request_event
 from ..utils.logging import log_dist, logger
 from .cell import CellDigest, CellUnreachable, ServingCell, check_reachable
@@ -126,7 +130,34 @@ class Region:
         self._requests: Dict[int, Tuple[Request, str]] = {}
         self._accepting = True
         self._shed_backlog: List[Request] = []
-        self._sla_window = collections.deque(maxlen=fleet_config.sla_window)
+        # region telemetry plane (telemetry/digest.py, telemetry/slo.py):
+        # per-cell digest deltas are absorbed on the rollup cadence into
+        # ONE accumulator + SLO tracker — the flat per-request SLA deque
+        # this replaces was a region-wide scan magnet and carried no
+        # tenant attribution. All rollup state is touched only by the
+        # monitor/poll thread (the digest-refresh discipline).
+        self._slo_objective = SLOObjective(
+            target=config.slo_target,
+            window_s=config.slo_window_s,
+            fast_window_s=config.slo_fast_window_s,
+            slow_window_s=config.slo_slow_window_s,
+            fast_burn_threshold=config.slo_fast_burn,
+            slow_burn_threshold=config.slo_slow_burn,
+            min_samples=config.slo_min_samples)
+        self._slo = TenantSLOTracker(self._slo_objective)
+        self._tel_rollup = DigestAccumulator()
+        self._region_tel = DigestSource("region")
+        # final deltas pulled from cells at death (kill_cell), absorbed
+        # by the next rollup pass on the poll thread
+        self._salvaged_digests: List[Any] = []
+        self._rollup_tick = 0
+        self._rollup_hasher = hashlib.sha256()
+        #: per-rollup work accounting, pinned by the SLO lane: digest
+        #: rows absorbed in the LAST rollup pass and cumulatively —
+        #: O(cells), independent of replica count
+        self.rollup_work_last = 0
+        self.rollup_work_total = 0
+        self.rollup_count = 0
         self._stop_evt = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         # route retries draw from the REQUEST's own budget
@@ -432,22 +463,25 @@ class Region:
         with self._lock:
             backlog, self._shed_backlog = self._shed_backlog, []
         for req in backlog:
-            emit_request_span(self._telemetry, req)
+            emit_request_span(self._telemetry, req, digest=self._region_tel)
+            # a region-tier shed never reached a fleet, so its SLO
+            # verdict enters the plane HERE (fleet-retired requests are
+            # recorded by their fleet's source — never twice)
+            had_slo = (req.deadline_s is not None
+                       or req.ttft_deadline_s is not None)
+            if had_slo and not (req.state is RequestState.CANCELLED
+                                and req.error is None):
+                self._region_tel.slo_verdict(req.tenant, req.model_version,
+                                             False)
+                self._region_tel.count("slo_judged")
             self._on_fleet_retire(req)
 
     # -- fleet callbacks (invoked OUTSIDE fleet locks) -------------------
     def _on_fleet_retire(self, req: Request) -> None:
-        had_slo = (req.deadline_s is not None
-                   or req.ttft_deadline_s is not None)
+        # SLO verdicts live in the rollup plane (the fleet's digest
+        # source records them); the region only clears its routing entry
         with self._lock:
             self._requests.pop(req.uid, None)
-            if req.state is RequestState.FINISHED:
-                verdict = req.in_slo()
-                if verdict is not None:
-                    self._sla_window.append(bool(verdict))
-            elif had_slo and not (req.state is RequestState.CANCELLED
-                                  and req.error is None):
-                self._sla_window.append(False)
 
     def _escalate_route(self, src_cell: str, req: Request) -> bool:
         """A cell found no replica for a CONTINUATION: place it on
@@ -636,6 +670,77 @@ class Region:
             cells = [c for c in self._cells.values() if c.alive]
         for cell in cells:
             cell.publish_digest()
+        with self._lock:
+            self._rollup_tick += 1
+            tick = self._rollup_tick
+        if tick % self.config.telemetry_rollup_every == 0:
+            self._publish_rollup(cells)
+
+    def _publish_rollup(self, cells: Optional[List[ServingCell]] = None
+                        ) -> None:
+        """One telemetry rollup pass (monitor cadence, every
+        ``telemetry_rollup_every``-th digest refresh): pull each live
+        cell's telemetry digest delta, fold it into the region
+        accumulator and the SLO tracker, then evaluate burn-rate
+        alerts. Work is O(cells x digest rows) — independent of replica
+        count, metered by ``rollup_work_last``. Deterministic: no RNG,
+        no extra clock advance, stable cell order — the per-seed digest
+        stream hashes bit-identically under DST (scripts/slo_lane.py)."""
+        if cells is None:
+            with self._lock:
+                cells = [c for c in self._cells.values() if c.alive]
+        now = self._clock.now()
+        digests = []
+        with self._lock:
+            salvaged, self._salvaged_digests = self._salvaged_digests, []
+        digests.extend(d for d in salvaged if not d.is_empty())
+        for cell in cells:
+            d = cell.publish_telemetry(now)
+            if d is not None and not d.is_empty():
+                digests.append(d)
+        own = self._region_tel.publish(now)
+        if not own.is_empty():
+            digests.append(own)
+        work = 0
+        for d in digests:
+            work += self._tel_rollup.absorb(d)
+            self._slo.record(
+                now, d.tenants, d.versions,
+                ok=int(d.counters.get("slo_met", 0)),
+                judged=int(d.counters.get("slo_judged", 0)))
+            self._rollup_hasher.update(json.dumps(
+                d.to_dict(), sort_keys=True).encode("utf-8"))
+        with self._lock:
+            self.rollup_count += len(digests)
+            self.rollup_work_last = work
+            self.rollup_work_total += work
+        self._emit_slo_alerts(self._slo.check_alerts(now))
+        t = self._telemetry
+        if t.enabled and digests:
+            r = t.registry
+            for tenant in self._slo.tenants():
+                _, ratio = self._slo.tenant_attainment(tenant, now)
+                if ratio is not None:
+                    r.gauge(
+                        f"serving/region/slo/{tenant}/attainment"
+                    ).set(ratio)
+
+    def _emit_slo_alerts(self, transitions: List[Dict[str, Any]]) -> None:
+        """Mirror SLO alert transitions into the registry and flight
+        recorder (the alert_log itself is the tracker's)."""
+        if not transitions:
+            return
+        tracer = get_tracer()
+        for tr in transitions:
+            self._count(f"slo_alerts_{tr['state']}")
+            logger.warning(
+                f"Region: SLO burn-rate alert {tr['state']} "
+                f"(tenant={tr['tenant']} window={tr['window']} "
+                f"burn={tr['burn']:.2f})")
+            if tracer.enabled:
+                tracer.flight.note("slo_alert", tenant=tr["tenant"],
+                                   window=tr["window"], state=tr["state"],
+                                   burn=tr["burn"])
 
     def _check_dead_cells(self) -> None:
         """A cell whose digest reports zero healthy replicas and whose
@@ -676,11 +781,17 @@ class Region:
         level = (FLOOR_MAX if pressure == float("inf")
                  else min(FLOOR_MAX, int(pressure // step)))
         tracer = get_tracer()
+        # SLO-plane coupling (telemetry/slo.py): while any tenant's FAST
+        # burn-rate alert is firing, the ladder holds its floor — queue
+        # pressure easing is not recovery if a tenant is still burning
+        # error budget. The alert auto-clears when its window's samples
+        # age out, so a quiet region always descends eventually.
+        slo_hold = self._slo.has_fast_burn()
         with self._lock:
             cur = self._brownout_floor
             if level > cur:
                 new = level
-            elif level < cur and pressure \
+            elif level < cur and not slo_hold and pressure \
                     <= self.config.brownout_exit_ratio * cur * step:
                 # <= not <: at exit_ratio 0 (a value validation allows)
                 # a fully drained region (pressure 0.0) must still
@@ -730,6 +841,14 @@ class Region:
             tracer.flight.note("cell_outage", cell=name, reason=reason)
             tracer.flight.dump("cell-outage")
         orphans = cell.kill(reason)
+        # salvage the dead cell's last unpublished telemetry delta
+        # (publish_telemetry returns None once DEAD): spans the cell
+        # emitted before dying must still reach the rollup plane, or
+        # region sketches would silently undercount on outage seeds
+        salvage = cell.fleet.collect_telemetry_digest(self._clock.now())
+        if not salvage.is_empty():
+            with self._lock:
+                self._salvaged_digests.append(salvage)
         self._failover_orphans(orphans, source=name)
         self._update_brownout()     # reachable capacity just shrank
         self._update_gauges()
@@ -744,7 +863,8 @@ class Region:
             if req._cancel_requested:
                 req.transition(RequestState.CANCELLED)
                 self._count("cancelled")
-                emit_request_span(self._telemetry, req)
+                emit_request_span(self._telemetry, req,
+                                  digest=self._region_tel)
                 self._on_fleet_retire(req)
                 continue
             self._route_request(req, requeue=True)
@@ -848,6 +968,10 @@ class Region:
         for cell in cells:
             cell.fleet.close(timeout=timeout)
         self._flush_shed()
+        # final rollup: absorb the tail of every cell's telemetry delta
+        # (requests that retired after the last monitor pass) so the
+        # region accumulator's counts match the pooled request stream
+        self._publish_rollup()
         self._update_gauges()
 
     def __enter__(self) -> "Region":
@@ -928,10 +1052,41 @@ class Region:
         return sum(c.fleet.live_requests for c in self.live_cells)
 
     def in_sla_ratio(self) -> Optional[float]:
-        with self._lock:
-            if not self._sla_window:
-                return None
-            return sum(self._sla_window) / len(self._sla_window)
+        """Region-wide windowed SLO attainment, read from the rollup
+        plane (None until a judged verdict lands in the window)."""
+        return self._slo.attainment(self._clock.now())
+
+    # -- telemetry plane (docs/observability.md "Region rollups") --------
+    @property
+    def slo(self) -> TenantSLOTracker:
+        """The region's SLO tracker: per-tenant/per-version attainment,
+        burn-rate alert state and the alert transition log."""
+        return self._slo
+
+    @property
+    def slo_alert_log(self):
+        return self._slo.alert_log
+
+    @property
+    def rollup_hash(self) -> str:
+        """Running SHA-256 over every absorbed digest's canonical form —
+        the DST lane's bit-identity witness for the digest stream."""
+        return self._rollup_hasher.hexdigest()
+
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        """Region-scale merged telemetry view (counters + sketch
+        summaries) — answered from the digest accumulator, never from a
+        replica scan."""
+        return self._tel_rollup.snapshot()
+
+    def telemetry_percentile(self, metric: str,
+                             p: float) -> Optional[float]:
+        """Percentile of one hot-path metric over the MERGED region
+        sketch (``alpha``-bounded relative error, docs/observability.md).
+        Metrics use the digest short names: ``ttft_s``,
+        ``request_latency_s``, ``tokens_per_s``, ``queue_wait_s``,
+        ``tick_s``."""
+        return self._tel_rollup.percentile(metric, p)
 
     def block_leaks(self) -> List[str]:
         """Region-wide KV leak audit: the union of every cell's fleet

@@ -165,14 +165,12 @@ class RolloutController:
         return out
 
     def _version_sla(self, version: int) -> Tuple[int, Optional[float]]:
-        """Region-wide (samples, in-SLA ratio) for one version."""
-        samples, ok = 0, 0.0
-        for fleet in self._fleets():
-            n, ratio = fleet.version_sla(version)
-            if n and ratio is not None:
-                samples += n
-                ok += ratio * n
-        return samples, (ok / samples if samples else None)
+        """Region-wide (samples, in-SLA ratio) for one version, read
+        from the region's SLO plane (telemetry/slo.py): one windowed
+        read of rollup-fed verdicts instead of a per-fleet deque scan —
+        the canary judge's cost no longer grows with fleet count."""
+        return self._region.slo.version_attainment(version,
+                                                   self._clock.now())
 
     # -- lifecycle -------------------------------------------------------
     def start(self, version: int, fraction: Optional[float] = None,

@@ -57,12 +57,14 @@ from .request import Request, RequestState
 from .scheduler import CapacityView, SchedulerPolicy, make_policy
 
 
-def emit_request_span(telemetry, req: Request) -> None:
+def emit_request_span(telemetry, req: Request, digest=None) -> None:
     """Emit one terminal request's span record — shared by the
     ServingEngine retire path and fleet-level rejections (a request shed
     before it ever reached a replica must still appear in
     requests.jsonl: one logical request, one record, no matter where it
-    died)."""
+    died). ``digest`` is the emitting tier's
+    :class:`~deepspeed_tpu.telemetry.digest.DigestSource`: the same
+    terminal observations also feed the replica→region rollup plane."""
     from ..telemetry.spans import RequestStats
 
     # terminal trace closure lives HERE because every terminal request
@@ -74,8 +76,6 @@ def emit_request_span(telemetry, req: Request) -> None:
                          preemptions=req.preemptions, retries=req.retries,
                          error=req.error)
     root = getattr(req, "_trace_root", None)
-    if not telemetry.enabled:
-        return
     n = len(req.tokens)
     decode_s = (req.t_finish - req.t_first_token
                 if req.t_finish is not None
@@ -93,6 +93,24 @@ def emit_request_span(telemetry, req: Request) -> None:
         in_slo = None
     else:
         in_slo = False if had_slo else None
+    if digest is not None:
+        # rollup-plane copy of the hot-path observations: sketch
+        # observes are O(1) and the digest publishes deltas upward on
+        # the monitor cadence (telemetry/digest.py)
+        digest.count("requests")
+        digest.observe("queue_wait_s", req.queue_wait_s)
+        digest.observe("ttft_s", req.ttft_s)
+        if req.state is RequestState.FINISHED:
+            digest.observe("request_latency_s", req.latency_s)
+        if decode_s and n > 1:
+            digest.observe("tokens_per_s", (n - 1) / decode_s)
+        if n:
+            digest.count("generated_tokens", n)
+    # the rollup plane above feeds regardless of the registry sink: the
+    # region's SLO tracker and digest rollups must see every terminal
+    # request even when telemetry output is disabled
+    if not telemetry.enabled:
+        return
     telemetry.record_request_span(RequestStats(
         uid=req.uid, state=req.state.value,
         client_request_id=req.client_request_id, priority=req.priority,
@@ -111,6 +129,7 @@ def emit_request_span(telemetry, req: Request) -> None:
         spec_proposed=(req.spec_proposed if req.spec_proposed else None),
         spec_accepted=(req.spec_accepted if req.spec_proposed else None),
         model_version=req.model_version,
+        tenant=req.tenant,
         in_slo=in_slo, error=req.error,
         trace_id=(root.trace_id if root is not None and not root.is_noop
                   else None),
@@ -187,6 +206,13 @@ class ServingEngine:
         self.replica_id = replica_id
         self._metric_prefix = (f"serving/{replica_id}" if replica_id
                                else "serving")
+        # replica-tier digest source (telemetry/digest.py): terminal
+        # request observations + tick timings collected here, published
+        # as deltas up the fleet→cell→region rollup on the monitor
+        # cadence — region reads never scan replicas
+        from ..telemetry.digest import DigestSource
+
+        self.digest = DigestSource(replica_id or "serving")
         self._on_handoff = on_handoff
         self._on_retire = on_retire
         # every deadline, latency stamp and poll interval reads this
@@ -886,6 +912,21 @@ class ServingEngine:
         return True
 
     def _tick(self) -> bool:
+        """One driver iteration; times the productive ticks into the
+        hot-path tick sketch (zero-width under a SimClock — the sketch
+        stays deterministic; on a wall clock it is the real tick time)."""
+        t0 = self._clock.now()
+        did = self._tick_inner()
+        if did:
+            dt = self._clock.now() - t0
+            self.digest.observe("tick_s", dt)
+            t = self._telemetry
+            if t.enabled:
+                t.registry.sketch(
+                    f"{self._metric_prefix}/tick_s").observe(dt)
+        return did
+
+    def _tick_inner(self) -> bool:
         """One driver iteration: latch poll, adoptions, cancellations,
         admission (+ preemption), one engine ``put()`` — a verify step
         when speculative chains are drafted — and token dispatch.
@@ -1514,7 +1555,7 @@ class ServingEngine:
                         f"(request {req.uid})")
 
     def _emit_span(self, req: Request) -> None:
-        emit_request_span(self._telemetry, req)
+        emit_request_span(self._telemetry, req, digest=self.digest)
 
     def _update_gauges(self) -> None:
         t = self._telemetry

@@ -13,8 +13,18 @@ from .registry import (  # noqa: F401
     Gauge,
     Histogram,
     MetricsRegistry,
+    SketchHistogram,
     get_registry,
     set_registry,
+)
+from .digest import (  # noqa: F401
+    DigestAccumulator,
+    DigestSource,
+    TelemetryDigest,
+)
+from .slo import (  # noqa: F401
+    SLOObjective,
+    TenantSLOTracker,
 )
 from .spans import (  # noqa: F401
     REQUEST_RECORD_SCHEMA,

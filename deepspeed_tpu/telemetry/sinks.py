@@ -21,7 +21,8 @@ import re
 from typing import Any, Dict, Optional
 
 from ..utils.logging import logger
-from .registry import Counter, Gauge, Histogram, MetricsRegistry
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       SketchHistogram)
 
 
 class JsonlSink:
@@ -67,7 +68,11 @@ def _prom_name(name: str) -> str:
 def render_prometheus(registry: MetricsRegistry,
                       prefix: str = "dst") -> str:
     """Render every metric in ``registry`` in the Prometheus text format.
-    Histograms export as summaries (count/sum + p50/p90/p99 quantiles)."""
+    Exact-window :class:`Histogram` exports as a summary (count/sum +
+    p50/p90/p99 quantiles); :class:`SketchHistogram` exports as a native
+    Prometheus histogram — cumulative ``_bucket{le=...}`` series straight
+    from the sketch's log-bucket upper bounds, so server-side quantile
+    math (``histogram_quantile``) and cross-scrape aggregation work."""
     lines = []
     for name, m in sorted(registry.metrics().items()):
         pname = f"{prefix}_{_prom_name(name)}"
@@ -79,6 +84,16 @@ def render_prometheus(registry: MetricsRegistry,
                 continue
             lines.append(f"# TYPE {pname} gauge")
             lines.append(f"{pname} {m.value}")
+        elif isinstance(m, SketchHistogram):
+            lines.append(f"# TYPE {pname} histogram")
+            cum = 0
+            for ub, n in m.bucket_bounds():
+                cum += n
+                lines.append(
+                    f"{pname}_bucket{{le=\"{ub}\"}} {cum}")
+            lines.append(f"{pname}_bucket{{le=\"+Inf\"}} {m.count}")
+            lines.append(f"{pname}_sum {m.sum}")
+            lines.append(f"{pname}_count {m.count}")
         elif isinstance(m, Histogram):
             lines.append(f"# TYPE {pname} summary")
             for q in (50, 90, 99):

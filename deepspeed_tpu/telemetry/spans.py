@@ -166,6 +166,10 @@ REQUEST_RECORD_SCHEMA: Dict[str, tuple] = {
     # Optional, NOT a schema-version bump, same discipline as
     # client_request_id: archived streams predate versioned serving.
     "model_version": ((int,), False),
+    # tenant id the request was submitted under (per-tenant SLO
+    # accounting, telemetry/slo.py). Optional — NOT a schema-version
+    # bump — archived streams predate multi-tenant serving.
+    "tenant": ((str,), False),
     "in_slo": ((bool,), False),
     "error": ((str,), False),
     # distributed-tracing join keys (telemetry/tracing.py): the request's
@@ -201,6 +205,8 @@ class RequestStats:
     spec_accepted: Optional[int] = None
     # serving model version (None predates versioned serving)
     model_version: Optional[int] = None
+    # tenant id (None = untenanted; feeds per-tenant SLO attainment)
+    tenant: Optional[str] = None
     in_slo: Optional[bool] = None      # None = request carried no SLO
     error: Optional[str] = None
     # tracing join keys: the request's trace and root span (tracer on)

@@ -188,14 +188,18 @@ class Telemetry:
         if not self.enabled:
             return record
         r = self.registry
+        # hot-path serving metrics are SKETCHES (docs/observability.md
+        # "Sketch vs exact-window"): O(1) observe, mergeable up the
+        # replica→region rollup, bounded relative error on percentiles.
+        # Low-rate training metrics keep the exact-window Histogram.
         if stats.queue_wait_s is not None:
-            r.histogram("serving/queue_wait_s").observe(stats.queue_wait_s)
+            r.sketch("serving/queue_wait_s").observe(stats.queue_wait_s)
         if stats.ttft_s is not None:
-            r.histogram("serving/ttft_s").observe(stats.ttft_s)
+            r.sketch("serving/ttft_s").observe(stats.ttft_s)
         if stats.latency_s is not None:
-            r.histogram("serving/request_latency_s").observe(stats.latency_s)
+            r.sketch("serving/request_latency_s").observe(stats.latency_s)
         if stats.tokens_per_s is not None:
-            r.histogram("serving/tokens_per_s").observe(stats.tokens_per_s)
+            r.sketch("serving/tokens_per_s").observe(stats.tokens_per_s)
         if stats.new_tokens:
             r.counter("serving/generated_tokens").inc(stats.new_tokens)
         if stats.in_slo is not None:

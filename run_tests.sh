@@ -98,6 +98,22 @@ if [ "$#" -eq 0 ]; then
         smoke_rc=$region_rc
     fi
 
+    # SLO lane (CPU evidence lane, docs/observability.md "Region
+    # rollups & SLO alerting"): >= 200 seeded region chaos schedules
+    # with every digest observation mirrored into a pooled ground-truth
+    # stream. Gates: merged region sketch sample counts exactly equal
+    # pooled counts (outages/partitions/salvage included), p50/p99
+    # within the sketch's documented relative-error bound, digest +
+    # alert streams bit-identical on replay, rollup cost independent of
+    # replica count, and the scripted two-tenant burst fires/clears
+    # per-tenant burn-rate alerts deterministically. Writes SLO_r01.json.
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/slo_lane.py
+    slo_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$slo_rc
+    fi
+
     # rollout smoke (CPU evidence lane, docs/serving.md "Rollout,
     # canary, and migration"): a scripted end-to-end canary -> promote
     # rollout with a live migration riding along, plus the seeded

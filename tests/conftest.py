@@ -16,6 +16,17 @@ if not _tpu_lane:
     if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                    " --xla_force_host_platform_device_count=8")
+    # Tier-1 is compile-bound on small-core CI hosts (the 8 virtual devices
+    # share one or two physical cores, and XLA compiles serially). Dial XLA's
+    # backend/LLVM optimization effort down for the test lane only: the jitted
+    # programs are tiny, every numeric assertion carries its own tolerance,
+    # and bit-exactness tests compare two paths compiled under the SAME flags.
+    # Measured ~25% wall-clock reduction on a 1-core host with zero test
+    # outcome changes. The on-chip lane (DST_TPU_TESTS=1) is untouched.
+    if "--xla_backend_optimization_level" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_backend_optimization_level=0"
+                                   " --xla_llvm_disable_expensive_passes=true")
     os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402

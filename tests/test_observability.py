@@ -97,6 +97,100 @@ def test_snapshot_shapes():
 
 
 # ----------------------------------------------------------------------
+# SketchHistogram: relative-error bound + merge algebra
+def test_sketch_relative_error_bound():
+    from deepspeed_tpu.telemetry import SketchHistogram
+
+    s = SketchHistogram("s", alpha=0.01)
+    # values across 8 orders of magnitude plus negatives and zero
+    vals = ([10.0 ** k for k in range(-4, 5)]
+            + [-(10.0 ** k) for k in range(-2, 3)] + [0.0])
+    for v in vals:
+        s.observe(v)
+    assert s.count == len(vals)
+    assert s.min == min(vals) and s.max == max(vals)
+    # every percentile estimate lands within alpha of SOME true value
+    exact = sorted(vals)
+    for p in (0, 10, 25, 50, 75, 90, 99, 100):
+        est = s.percentile(p)
+        rank = int((p / 100.0) * (len(exact) - 1) + 1e-9)
+        true = exact[rank]
+        if true == 0.0:
+            assert abs(est) <= SketchHistogram.ZERO_EPS
+        else:
+            assert abs(est - true) <= abs(true) * (s.alpha + 1e-9), (
+                p, est, true)
+
+
+def test_sketch_merge_algebra():
+    from deepspeed_tpu.telemetry import SketchHistogram
+
+    def fill(name, vals):
+        s = SketchHistogram(name, alpha=0.02)
+        for v in vals:
+            s.observe(v)
+        return s
+
+    a_vals = [0.5, 1.0, 3.0, -2.0]
+    b_vals = [100.0, 0.001, 7.0]
+    c_vals = [0.0, 42.0]
+
+    # commutative: a+b == b+a
+    ab = fill("ab", a_vals)
+    ab.merge(fill("b", b_vals))
+    ba = fill("ba", b_vals)
+    ba.merge(fill("a", a_vals))
+    assert ab.serialize()["pos"] == ba.serialize()["pos"]
+    assert ab.serialize()["neg"] == ba.serialize()["neg"]
+    assert ab.count == ba.count and ab.sum == ba.sum
+
+    # associative: (a+b)+c == a+(b+c)
+    left = fill("l", a_vals)
+    left.merge(fill("b", b_vals))
+    left.merge(fill("c", c_vals))
+    bc = fill("bc", b_vals)
+    bc.merge(fill("c", c_vals))
+    right = fill("r", a_vals)
+    right.merge(bc)
+    ls, rs = left.serialize(), right.serialize()
+    for k in ("count", "zero", "pos", "neg", "min", "max"):
+        assert ls[k] == rs[k], k
+
+    # identity: merging an empty sketch changes nothing
+    before = fill("i", a_vals).serialize()
+    ident = fill("i2", a_vals)
+    ident.merge(SketchHistogram("empty", alpha=0.02))
+    assert ident.serialize() == dict(before, alpha=ident.alpha)
+
+    # merged == union observed directly (sketch is a true monoid hom);
+    # sum is float-addition-order sensitive, so approx for that field
+    union = fill("u", a_vals + b_vals + c_vals)
+    us = union.serialize()
+    for k in ("count", "zero", "pos", "neg", "min", "max"):
+        assert ls[k] == us[k], k
+    assert ls["sum"] == pytest.approx(us["sum"])
+
+    # alpha mismatch is a hard error, not silent precision loss
+    with pytest.raises(ValueError):
+        left.merge(SketchHistogram("other", alpha=0.01))
+
+
+def test_sketch_serde_roundtrip():
+    from deepspeed_tpu.telemetry import SketchHistogram
+
+    s = SketchHistogram("s", alpha=0.01)
+    for v in (0.0, 1e-6, 0.5, 2.0, -3.5, 1e4):
+        s.observe(v)
+    d = s.serialize()
+    # serialized form is json-stable (sorted bucket lists)
+    assert d == json.loads(json.dumps(d))
+    s2 = SketchHistogram.deserialize("s2", d)
+    assert s2.serialize() == d
+    for p in (1, 50, 99):
+        assert s2.percentile(p) == s.percentile(p)
+
+
+# ----------------------------------------------------------------------
 # exporters
 def test_prometheus_render():
     reg = MetricsRegistry()
@@ -109,6 +203,26 @@ def test_prometheus_render():
     assert "dst_inference_kv_occupancy 0.25" in text
     assert 'dst_train_step_time_s{quantile="0.5"} 0.1' in text
     assert "dst_train_step_time_s_count 1" in text
+
+
+def test_prometheus_renders_sketch_as_native_histogram():
+    reg = MetricsRegistry()
+    s = reg.sketch("serving/ttft_s", alpha=0.01)
+    for v in (0.05, 0.1, 0.1, 2.0):
+        s.observe(v)
+    text = render_prometheus(reg)
+    assert "# TYPE dst_serving_ttft_s histogram" in text
+    # cumulative le-series: monotone counts ending at the +Inf total
+    bucket_lines = [ln for ln in text.splitlines()
+                    if ln.startswith("dst_serving_ttft_s_bucket")]
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
+    assert counts == sorted(counts)
+    assert 'le="+Inf"' in bucket_lines[-1] and counts[-1] == 4
+    assert "dst_serving_ttft_s_count 4" in text
+    # every upper bound is >= the values it covers (log-bucket bounds)
+    ubs = [float(ln.split('le="')[1].split('"')[0])
+           for ln in bucket_lines[:-1]]
+    assert all(u > 0 for u in ubs) and max(ubs) >= 2.0
 
 
 def test_jsonl_sink_roundtrip(tmp_path):
@@ -343,8 +457,10 @@ def test_record_request_span_series_and_jsonl(tmp_path):
     assert r.counter("serving/generated_tokens").value == 4
     assert r.counter("serving/slo_judged").value == 2
     assert r.counter("serving/slo_met").value == 1
-    assert r.histogram("serving/ttft_s").count == 1
-    assert r.histogram("serving/queue_wait_s").count == 1
+    # serving hot-path latency series are sketch-backed (mergeable,
+    # bounded-memory) — exact-window histograms stay for training
+    assert r.sketch("serving/ttft_s").count == 1
+    assert r.sketch("serving/queue_wait_s").count == 1
     t.close()
     # requests get their OWN jsonl stream (one file, one schema) and every
     # line validates

@@ -568,6 +568,9 @@ def test_region_config_validation():
     with pytest.raises(ConfigError):
         RegionConfig.from_dict({"brownout_queue_per_replica": 0.0})
     with pytest.raises(ConfigError):
+        # 0 would divide-by-zero the rollup cadence modulo at poll time
+        RegionConfig.from_dict({"telemetry_rollup_every": 0})
+    with pytest.raises(ConfigError):
         from deepspeed_tpu.config import FleetConfig
 
         FleetConfig.from_dict({"route_retry_budget": -1})

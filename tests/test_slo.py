@@ -1,0 +1,377 @@
+"""Region telemetry plane: digest sources/accumulators (delta publish,
+merge-of-stream == total), per-tenant SLO burn-rate alerting (fire/clear
+hysteresis, quiet-tenant auto-clear, determinism), and the region
+integration — rollup cost independent of replica count, ``in_sla_ratio``
+served from the plane, region-shed verdicts, and the brownout descend
+hold while a fast burn fires (docs/observability.md "Region rollups").
+
+Unit tests drive trackers directly on hand-fed virtual timestamps; the
+integration tests use the manual region drive (docs/dst.md).
+"""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.resilience.chaos import install_fault_injector
+from deepspeed_tpu.resilience.clock import SimClock, use_clock
+from deepspeed_tpu.resilience.dst import SimConfig, SimEngine
+from deepspeed_tpu.serving import Region
+from deepspeed_tpu.telemetry import (DigestAccumulator, DigestSource,
+                                     SLOObjective, TelemetryDigest,
+                                     TenantSLOTracker)
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    install_fault_injector(None)
+    yield
+    install_fault_injector(None)
+
+
+# ----------------------------------------------------------------------
+# SLOObjective
+# ----------------------------------------------------------------------
+
+def test_objective_validation():
+    SLOObjective()  # defaults valid
+    with pytest.raises(ValueError):
+        SLOObjective(target=1.0)
+    with pytest.raises(ValueError):
+        SLOObjective(target=0.0)
+    with pytest.raises(ValueError):
+        SLOObjective(fast_window_s=0.0)
+    with pytest.raises(ValueError):
+        SLOObjective(fast_burn_threshold=-1.0)
+    with pytest.raises(ValueError):
+        SLOObjective(clear_ratio=0.0)
+    with pytest.raises(ValueError):
+        SLOObjective(min_samples=0)
+
+
+def test_burn_rate_math():
+    obj = SLOObjective(target=0.95)
+    assert obj.error_budget == pytest.approx(0.05)
+    # exactly at target: burning budget at 1x (sustainable)
+    assert obj.burn_rate(0.95) == pytest.approx(1.0)
+    # total outage: burning at 1/budget
+    assert obj.burn_rate(0.0) == pytest.approx(20.0)
+    assert obj.burn_rate(1.0) == pytest.approx(0.0)
+
+
+# ----------------------------------------------------------------------
+# digest source / accumulator algebra
+# ----------------------------------------------------------------------
+
+def test_digest_publish_is_delta():
+    src = DigestSource("replica-0")
+    src.count("requests")
+    src.observe("ttft_s", 0.1)
+    src.slo_verdict("tenant-a", 3, True)
+    d1 = src.publish(1.0)
+    assert d1.counters["requests"] == 1.0
+    assert d1.sketches["ttft_s"].count == 1
+    assert d1.tenants["tenant-a"] == [1, 1]
+    assert d1.versions[3] == [1, 1]
+    # publish reset the source: second publish is empty
+    d2 = src.publish(2.0)
+    assert d2.is_empty()
+    # ...and new observations land only in the next delta
+    src.slo_verdict("tenant-a", 3, False)
+    d3 = src.publish(3.0)
+    assert d3.tenants["tenant-a"] == [0, 1]
+
+
+def test_merge_of_digest_stream_equals_union():
+    """The rollup invariant: absorbing a stream of deltas reproduces the
+    exact totals — nothing double counted, nothing dropped."""
+    src = DigestSource("r", alpha=0.01)
+    acc = DigestAccumulator(alpha=0.01)
+    vals = [0.01 * (i + 1) for i in range(50)]
+    for i, v in enumerate(vals):
+        src.count("requests")
+        src.observe("ttft_s", v)
+        src.slo_verdict("t", 1, i % 3 != 0)
+        if i % 7 == 0:           # publish mid-stream at uneven cadence
+            acc.absorb(src.publish(float(i)))
+    acc.absorb(src.publish(99.0))
+    assert acc.counter("requests") == len(vals)
+    s = acc.sketch("ttft_s")
+    assert s.count == len(vals)
+    assert s.min == min(vals) and s.max == max(vals)
+    ok = sum(1 for i in range(len(vals)) if i % 3 != 0)
+    assert acc.tenant_totals()["t"] == (ok, len(vals))
+    assert acc.version_totals()[1] == (ok, len(vals))
+    # merged percentile within the sketch's relative-error bound of the
+    # pooled exact value (same non-interpolated rank convention)
+    exact = sorted(vals)
+    for p in (50, 99):
+        rank = int((p / 100.0) * (len(exact) - 1) + 1e-9)
+        true = exact[rank]
+        assert abs(acc.percentile("ttft_s", p) - true) <= \
+            true * (0.01 + 1e-9)
+
+
+def test_digest_to_dict_is_canonical():
+    src = DigestSource("x")
+    src.count("b")
+    src.count("a")
+    src.slo_verdict("t2", 2, True)
+    src.slo_verdict("t1", 1, False)
+    d = src.publish(5.0).to_dict()
+    assert list(d["counters"]) == ["a", "b"]
+    assert list(d["tenants"]) == ["t1", "t2"]
+    assert list(d["versions"]) == ["1", "2"]   # stringified for json
+    # stable under a json round-trip (the lane's hash surface)
+    assert json.loads(json.dumps(d, sort_keys=True)) == d
+
+
+def test_empty_digest_is_merge_identity():
+    a = TelemetryDigest(1.0, "a")
+    a.counters["c"] = 2.0
+    a.tenants["t"] = [1, 2]
+    before = a.to_dict()
+    a.merge(TelemetryDigest(9.0, "empty"))
+    after = a.to_dict()
+    assert {k: after[k] for k in ("counters", "tenants", "versions",
+                                  "sketches")} == \
+        {k: before[k] for k in ("counters", "tenants", "versions",
+                                "sketches")}
+
+
+# ----------------------------------------------------------------------
+# TenantSLOTracker: windows + burn alerts
+# ----------------------------------------------------------------------
+
+def _feed(tr, t, tenant, ok, judged):
+    tr.record(t, {tenant: [ok, judged]}, {}, ok=ok, judged=judged)
+
+
+def test_attainment_windows():
+    obj = SLOObjective(target=0.9, window_s=10.0, slow_window_s=10.0)
+    tr = TenantSLOTracker(obj)
+    assert tr.attainment(0.0) is None
+    _feed(tr, 1.0, "a", 4, 4)
+    _feed(tr, 2.0, "a", 0, 4)
+    assert tr.attainment(2.0) == pytest.approx(0.5)
+    n, ratio = tr.tenant_attainment("a", 2.0)
+    assert n == 8 and ratio == pytest.approx(0.5)
+    # the early rows age out of the window; only the misses remain
+    assert tr.attainment(11.5) == pytest.approx(0.0)
+    # unknown tenant / version: no samples, no ratio
+    assert tr.tenant_attainment("ghost", 2.0) == (0, None)
+    assert tr.version_attainment(7, 2.0) == (0, None)
+
+
+def test_version_attainment_feeds_canary_judge():
+    obj = SLOObjective(target=0.9, window_s=100.0)
+    tr = TenantSLOTracker(obj)
+    tr.record(1.0, {}, {1: [5, 5], 2: [1, 4]}, ok=6, judged=9)
+    assert tr.version_attainment(1, 1.0) == (5, 1.0)
+    n, ratio = tr.version_attainment(2, 1.0)
+    assert n == 4 and ratio == pytest.approx(0.25)
+
+
+def test_burn_alert_fire_clear_hysteresis():
+    # target 0.5 -> budget 0.5; thresholds low so small feeds trip them
+    obj = SLOObjective(target=0.5, window_s=20.0, fast_window_s=10.0,
+                       slow_window_s=20.0, fast_burn_threshold=1.5,
+                       slow_burn_threshold=1.2, clear_ratio=0.5,
+                       min_samples=4)
+    tr = TenantSLOTracker(obj)
+    # below min_samples: no alert no matter how bad
+    _feed(tr, 1.0, "a", 0, 3)
+    assert tr.check_alerts(1.0) == []
+    # 0/8 in window: burn = (1-0)/0.5 = 2.0 >= both thresholds
+    _feed(tr, 2.0, "a", 0, 5)
+    fired = tr.check_alerts(2.0)
+    assert [(f["window"], f["state"]) for f in fired] == \
+        [("fast", "firing"), ("slow", "firing")]
+    assert tr.has_fast_burn()
+    assert tr.active_alerts() == [("a", "fast"), ("a", "slow")]
+    # still burning: no duplicate transitions
+    assert tr.check_alerts(3.0) == []
+    # recovery: lots of successes pull burn under clear_ratio*threshold
+    _feed(tr, 4.0, "a", 40, 40)
+    cleared = tr.check_alerts(4.0)
+    assert [(f["window"], f["state"]) for f in cleared] == \
+        [("fast", "clear"), ("slow", "clear")]
+    assert not tr.has_fast_burn()
+    # the log kept every transition in order
+    assert [(r["window"], r["state"]) for r in tr.alert_log] == [
+        ("fast", "firing"), ("slow", "firing"),
+        ("fast", "clear"), ("slow", "clear")]
+
+
+def test_quiet_tenant_auto_clears():
+    """An active alert must not latch forever when its tenant goes
+    quiet — zero samples in the window means nothing is burning budget
+    (and the brownout descend-hold releases)."""
+    obj = SLOObjective(target=0.5, window_s=10.0, fast_window_s=10.0,
+                       slow_window_s=10.0, fast_burn_threshold=1.5,
+                       slow_burn_threshold=1.5, min_samples=4)
+    tr = TenantSLOTracker(obj)
+    _feed(tr, 1.0, "a", 0, 8)
+    assert len(tr.check_alerts(1.0)) == 2
+    assert tr.has_fast_burn()
+    # tenant stops sending; rows age out entirely
+    tr.record(20.0, {}, {}, ok=0, judged=0)   # prune pass
+    cleared = tr.check_alerts(20.0)
+    assert [(f["state"], f["burn"]) for f in cleared] == \
+        [("clear", 0.0), ("clear", 0.0)]
+    assert not tr.has_fast_burn()
+
+
+def test_alert_stream_is_deterministic():
+    """Same feed, same alerts, bit-identical rows — the property the
+    SLO lane hashes across DST replays."""
+    def run():
+        obj = SLOObjective(target=0.8, window_s=30.0, fast_window_s=15.0,
+                           slow_window_s=30.0, fast_burn_threshold=2.0,
+                           slow_burn_threshold=1.5, min_samples=2)
+        tr = TenantSLOTracker(obj)
+        for i in range(40):
+            tenant = f"tenant-{i % 3}"
+            ok = 0 if (i // 10) % 2 else 1
+            _feed(tr, float(i), tenant, ok, 1)
+            tr.check_alerts(float(i))
+        return json.dumps(list(tr.alert_log), sort_keys=True)
+
+    assert run() == run()
+
+
+# ----------------------------------------------------------------------
+# region integration
+# ----------------------------------------------------------------------
+
+def _region(clock, cells=2, replicas=1, *, region_cfg=None,
+            serving_cfg=None):
+    rc = {"cells": cells, "cell_ring_vnodes": 16}
+    rc.update(region_cfg or {})
+    fc = {"replicas": replicas, "router": "prefix_affinity",
+          "respawn": False}
+    sc = {"policy": "slo", "stuck_tick_timeout_s": 0.0,
+          "drain_timeout_s": 600.0, "poll_interval_s": 0.25}
+    sc.update(serving_cfg or {})
+    return Region(lambda: SimEngine(SimConfig()), rc, fc, sc,
+                  start=False, clock=clock)
+
+
+def _drive(region, clock, reqs, max_ticks=400):
+    for _ in range(max_ticks):
+        if all(r.is_terminal for r in reqs):
+            return
+        region.step()
+        clock.advance(1.0)
+    raise AssertionError("requests not terminal")
+
+
+def _close(region, clock):
+    clock.pump = region.step
+    region.close(timeout=30.0)
+    clock.pump = None
+
+
+def test_rollup_work_independent_of_replica_count():
+    """The tentpole acceptance pin: per-poll rollup work (absorbed
+    digest rows) must not grow with replica count — each cell publishes
+    ONE merged digest whose row count is bounded by the number of
+    distinct metric/tenant/version keys, never by replicas or requests.
+    """
+    prompts = [[i, i + 1, 7] for i in range(1, 9)]
+    # fixed row budget per digest: 4 counters + 5 latency sketches +
+    # 1 tenant + 1 version, with headroom. Replica count nowhere in it.
+    cells = 3
+    bound = (cells + 1) * 15
+    max_work = {}
+    for replicas in (1, 4):
+        clock = SimClock()
+        with use_clock(clock):
+            region = _region(clock, cells=cells, replicas=replicas)
+            reqs = [region.submit(list(p), max_new_tokens=2,
+                                  deadline_s=300.0, tenant="t0")
+                    for p in prompts]
+            seen = []
+            for _ in range(400):
+                region.step()
+                seen.append(region.rollup_work_last)
+                clock.advance(1.0)
+                if all(r.is_terminal for r in reqs):
+                    break
+            assert all(r.is_terminal for r in reqs)
+            assert region.rollup_count > 0
+            max_work[replicas] = max(seen)
+            _close(region, clock)
+    # busy polls did absorb rows, and 4x the replicas stayed inside the
+    # same fixed per-cell row budget
+    assert 0 < max_work[1] <= bound
+    assert 0 < max_work[4] <= bound
+
+
+def test_in_sla_ratio_served_from_plane():
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(clock, cells=2, replicas=1)
+        # generous deadline -> hits; the plane must see the verdicts
+        reqs = [region.submit([i, 2, 3], max_new_tokens=2,
+                              deadline_s=500.0, tenant="gold")
+                for i in range(1, 5)]
+        _drive(region, clock, reqs)
+        region.poll()                       # absorb the final deltas
+        assert region.in_sla_ratio() == pytest.approx(1.0)
+        n, ratio = region.slo.tenant_attainment("gold", clock.now())
+        assert n == 4 and ratio == pytest.approx(1.0)
+        # the digest stream hash advanced and is a stable hex string
+        assert len(region.rollup_hash) == 64
+        snap = region.telemetry_snapshot()
+        assert snap["slo_judged"] == 4.0
+        assert region.telemetry_percentile("ttft_s", 50) is not None
+        _close(region, clock)
+
+
+def test_region_shed_records_slo_miss():
+    """A request shed at the region tier (brownout/no-cell) with an SLO
+    attached must land in the plane as a MISS — sheds can't hide from
+    attainment."""
+    clock = SimClock()
+    with use_clock(clock):
+        # brownout floor at level 0 sheds nothing; force no-capacity
+        # sheds by killing every cell first
+        region = _region(clock, cells=2, replicas=1)
+        for cell in region.cells:
+            region.kill_cell(cell.name, reason="test outage")
+        r = region.submit([1, 2, 3], max_new_tokens=1, deadline_s=5.0,
+                          tenant="shed-tenant")
+        assert r.is_terminal           # rejected: nowhere to place
+        region.poll()                  # flush + rollup
+        region.poll()                  # shed flushed last poll -> absorb
+        n, ratio = region.slo.tenant_attainment("shed-tenant",
+                                                clock.now())
+        assert n == 1 and ratio == 0.0
+        _close(region, clock)
+
+
+def test_tenant_burn_alert_fires_and_counts():
+    clock = SimClock()
+    with use_clock(clock):
+        region = _region(
+            clock, cells=2, replicas=1,
+            region_cfg={"slo_target": 0.5, "slo_window_s": 50.0,
+                        "slo_fast_window_s": 50.0,
+                        "slo_slow_window_s": 100.0,
+                        "slo_fast_burn": 1.5, "slo_slow_burn": 1.2,
+                        "slo_min_samples": 2})
+        # impossible deadlines: every request judges as a miss
+        reqs = [region.submit([i, 2, 3], max_new_tokens=3,
+                              deadline_s=0.001, tenant="burny")
+                for i in range(1, 7)]
+        _drive(region, clock, reqs)
+        region.poll()
+        log = list(region.slo_alert_log)
+        assert [(r["tenant"], r["window"], r["state"]) for r in log[:2]] \
+            == [("burny", "fast", "firing"), ("burny", "slow", "firing")]
+        assert region.slo.has_fast_burn()
+        assert ("burny", "fast") in region.slo.active_alerts()
+        _close(region, clock)

@@ -410,6 +410,22 @@ def test_static_lock_graph_sees_property_edges(repo_pkg):
     assert ("Region._lock", "ServingCell._lock") in graph
 
 
+def test_weak_resolution_skips_external_call_results(repo_pkg):
+    """``hashlib.sha256(data).digest()`` in router._hash64 is a method
+    on an EXTERNAL object; weak-resolving it to the one package method
+    named ``digest`` (ServingCell.digest) planted a phantom
+    Fleet->Cell edge no runtime path can exercise — which failed the
+    race lane's hot-edge coverage gate. The resolver must leave calls
+    on unresolvable-call results untargeted."""
+    from deepspeed_tpu.analysis.rules.locks import collect_lock_graph
+
+    graph = collect_lock_graph(repo_pkg)
+    assert ("ServingFleet._lock", "ServingCell._lock") not in graph
+    # the REAL Region->Cell path (typed cell receiver) must survive the
+    # narrowing — only the external-receiver guess goes away
+    assert ("Region._lock", "ServingCell._lock") in graph
+
+
 def test_locksan_seam_keeps_lock_model_intact(repo_pkg):
     """Serving locks are built through resilience/locksan.named_rlock;
     the static model must keep seeing them as RLock attributes (the
