@@ -838,6 +838,13 @@ class ChaosConfig:
     corrupt_swap_count: int = 0
     die_at_flip: int = -1
     degrade_version: int = -1
+    # gray-failure faults (docs/fault_tolerance.md "Gray failures"):
+    # every Nth serving KV import raises a recoverable fault (the
+    # adoption falls back to a requeue; 0 disables). The per-replica
+    # k x-slowdowns and stall bursts are runtime-armed on the injector
+    # (degrade_replica / arm_stall_burst), not config keys — they name
+    # replicas that only exist once the fleet is up.
+    flaky_import_every: int = 0
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ChaosConfig":
@@ -868,6 +875,7 @@ class ChaosConfig:
             corrupt_swap_count=int(_take(d, "corrupt_swap_count", 0)),
             die_at_flip=int(_take(d, "die_at_flip", -1)),
             degrade_version=int(_take(d, "degrade_version", -1)),
+            flaky_import_every=int(_take(d, "flaky_import_every", 0)),
         )
         if out.autoscaler_lag_s < 0:
             raise ConfigError(
@@ -877,6 +885,10 @@ class ChaosConfig:
             raise ConfigError(
                 f"resilience.chaos.corrupt_swap_count must be >= 0, got "
                 f"{out.corrupt_swap_count}")
+        if out.flaky_import_every < 0:
+            raise ConfigError(
+                f"resilience.chaos.flaky_import_every must be >= 0, got "
+                f"{out.flaky_import_every}")
         _warn_unknown(d, "resilience.chaos")
         return out
 
@@ -950,6 +962,33 @@ class FleetConfig:
     route_retry_budget: int = 256
     route_backoff_s: float = 0.02
     route_backoff_jitter: float = 0.5
+    # gray-failure resilience plane (docs/fault_tolerance.md "Gray
+    # failures"; serving/health.py) — all default OFF so the behavioral
+    # pins (exact tick-count TTFT gates) are untouched unless opted in.
+    # ``quarantine`` drains a replica whose continuous health score
+    # breaches ``quarantine_threshold`` for ``quarantine_after``
+    # consecutive monitor polls out of the NEW-work routing view (never
+    # below ``min_replicas`` — the capacity floor), dwells
+    # ``quarantine_dwell_s``, then probes it with live traffic and
+    # re-admits after ``quarantine_readmit_polls`` clean polls (a
+    # probation breach doubles the dwell — hysteresis against flap).
+    # ``breakers`` arms per-replica routing circuit breakers
+    # (closed -> open after ``breaker_failures`` consecutive failures,
+    # half-open single probe after ``breaker_cooldown_s``).  ``hedge``
+    # dispatches a backup leg for an interactive request once
+    # ``hedge_ttft_fraction`` of its TTFT deadline has elapsed with no
+    # first token — first token wins, the loser is cancelled with its
+    # KV discarded, and the SLO ledger judges the request once.
+    quarantine: bool = False
+    quarantine_threshold: float = 0.5
+    quarantine_after: int = 3
+    quarantine_dwell_s: float = 8.0
+    quarantine_readmit_polls: int = 3
+    breakers: bool = False
+    breaker_failures: int = 4
+    breaker_cooldown_s: float = 5.0
+    hedge: bool = False
+    hedge_ttft_fraction: float = 0.6
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "FleetConfig":
@@ -981,6 +1020,21 @@ class FleetConfig:
             route_backoff_s=float(_take(d, "route_backoff_s", 0.02)),
             route_backoff_jitter=float(
                 _take(d, "route_backoff_jitter", 0.5)),
+            quarantine=bool(_take(d, "quarantine", False)),
+            quarantine_threshold=float(
+                _take(d, "quarantine_threshold", 0.5)),
+            quarantine_after=int(_take(d, "quarantine_after", 3)),
+            quarantine_dwell_s=float(
+                _take(d, "quarantine_dwell_s", 8.0)),
+            quarantine_readmit_polls=int(
+                _take(d, "quarantine_readmit_polls", 3)),
+            breakers=bool(_take(d, "breakers", False)),
+            breaker_failures=int(_take(d, "breaker_failures", 4)),
+            breaker_cooldown_s=float(
+                _take(d, "breaker_cooldown_s", 5.0)),
+            hedge=bool(_take(d, "hedge", False)),
+            hedge_ttft_fraction=float(
+                _take(d, "hedge_ttft_fraction", 0.6)),
         )
         if out.route_retry_budget < 0:
             raise ConfigError(
@@ -1017,6 +1071,28 @@ class FleetConfig:
             raise ConfigError(
                 f"serving.fleet.sla_window must be >= 1, got "
                 f"{out.sla_window}")
+        if not 0.0 < out.quarantine_threshold <= 1.0:
+            raise ConfigError(
+                f"serving.fleet.quarantine_threshold must be in (0, 1], "
+                f"got {out.quarantine_threshold}")
+        if out.quarantine_after < 1 or out.quarantine_readmit_polls < 1:
+            raise ConfigError(
+                "serving.fleet quarantine_after and "
+                "quarantine_readmit_polls must be >= 1")
+        if out.quarantine_dwell_s <= 0:
+            raise ConfigError(
+                f"serving.fleet.quarantine_dwell_s must be > 0, got "
+                f"{out.quarantine_dwell_s}")
+        if out.breaker_failures < 1 or out.breaker_cooldown_s <= 0:
+            raise ConfigError(
+                "serving.fleet breaker_failures must be >= 1 and "
+                "breaker_cooldown_s > 0")
+        if not 0.0 < out.hedge_ttft_fraction < 1.0:
+            # 0 would hedge EVERY interactive request on submit; 1
+            # would hedge only after the deadline is already blown
+            raise ConfigError(
+                f"serving.fleet.hedge_ttft_fraction must be in (0, 1), "
+                f"got {out.hedge_ttft_fraction}")
         _warn_unknown(d, "serving.fleet")
         return out
 
@@ -1245,6 +1321,10 @@ class ServingConfig:
     poll_interval_s: float = 0.002
     drain_timeout_s: float = 120.0
     stuck_tick_timeout_s: float = 30.0
+    # after this many CONSECUTIVE stuck watchdog polls the engine marks
+    # itself watchdog-unhealthy so the fleet monitor evacuates the
+    # replica (0 = log-only, the pre-escalation behavior)
+    stuck_tick_escalate_polls: int = 3
     tick_retry_limit: int = 1
     speculative: bool = False
     spec_lookahead: int = 4
@@ -1279,6 +1359,8 @@ class ServingConfig:
             poll_interval_s=float(_take(d, "poll_interval_s", 0.002)),
             drain_timeout_s=float(_take(d, "drain_timeout_s", 120.0)),
             stuck_tick_timeout_s=float(_take(d, "stuck_tick_timeout_s", 30.0)),
+            stuck_tick_escalate_polls=int(
+                _take(d, "stuck_tick_escalate_polls", 3)),
             tick_retry_limit=int(_take(d, "tick_retry_limit", 1)),
             speculative=bool(_take(d, "speculative", False)),
             spec_lookahead=int(_take(d, "spec_lookahead", 4)),
@@ -1307,6 +1389,10 @@ class ServingConfig:
             raise ConfigError(
                 f"serving.tick_retry_limit must be >= 0, got "
                 f"{out.tick_retry_limit}")
+        if out.stuck_tick_escalate_polls < 0:
+            raise ConfigError(
+                f"serving.stuck_tick_escalate_polls must be >= 0, got "
+                f"{out.stuck_tick_escalate_polls}")
         if out.default_max_new_tokens < 1:
             raise ConfigError(
                 f"serving.default_max_new_tokens must be >= 1, got "

@@ -39,6 +39,16 @@ event so tests (tests/test_fault_tolerance.py) and the chaos smoke loop
 * :meth:`set_autoscaler_lag` — delays every fleet autoscaler decision by
   a fixed virtual interval (controller lag: real autoscalers observe,
   deliberate and boot capacity minutes behind the demand curve);
+* gray-failure faults (serving/health.py, docs/fault_tolerance.md "Gray
+  failures"): :meth:`degrade_replica` arms a per-replica k x-slowdown
+  (k-1 of every k busy ticks stall — a limping-but-alive straggler),
+  :meth:`arm_stall_burst` stalls a replica's next N busy ticks
+  (intermittent flapping), and ``flaky_import_every`` /
+  :meth:`on_import_kv` fails every Nth serving KV import with a
+  *recoverable* error (the adoption-fallback requeue is the code under
+  test); every injected degraded tick is booked per replica in
+  ``straggler_evidence`` — the DST quarantine-convergence invariant's
+  ground truth;
 * rollout-targeted faults (serving/rollout.py): ``corrupt_swap_count`` /
   :meth:`should_corrupt_swap` corrupts the next N hot-swap weight loads
   (the swap must fall back to the old version and the controller must
@@ -121,7 +131,8 @@ class FaultInjector:
                  autoscaler_lag_s: float = 0.0,
                  corrupt_swap_count: int = 0,
                  die_at_flip: int = -1,
-                 degrade_version: int = -1):
+                 degrade_version: int = -1,
+                 flaky_import_every: int = 0):
         fields = {
             "seed": seed,
             "crash_before_commit_at_save": crash_before_commit_at_save,
@@ -145,6 +156,7 @@ class FaultInjector:
             "corrupt_swap_count": corrupt_swap_count,
             "die_at_flip": die_at_flip,
             "degrade_version": degrade_version,
+            "flaky_import_every": flaky_import_every,
         }
         for name, default in fields.items():
             setattr(self, name,
@@ -159,6 +171,17 @@ class FaultInjector:
         # version's tick parity counter
         self._flip_calls = 0
         self._degrade_calls = 0
+        # gray-failure state (docs/fault_tolerance.md "Gray failures"):
+        # per-replica k x-slowdowns (name -> k, with a per-name busy-tick
+        # counter: k-1 of every k busy ticks stall), finite stall bursts
+        # (name -> remaining stalled ticks), the flaky-import call
+        # counter, and the per-replica ledger of injected degraded ticks
+        # — the DST quarantine-convergence invariant's evidence stream
+        self._degrade_replicas: Dict[str, int] = {}
+        self._degrade_replica_calls: Dict[str, int] = {}
+        self._stall_bursts: Dict[str, int] = {}
+        self._import_calls = 0
+        self.straggler_evidence: Dict[str, int] = {}
         # active network partitions: (group_a, group_b) name sets. Nodes
         # in different groups of any active partition cannot reach each
         # other; nodes a partition does not mention are unaffected by it.
@@ -205,7 +228,8 @@ class FaultInjector:
                  "serving_tick_fail_every", "replica_die_at_tick",
                  "replica_die_index", "cell_die_at_tick",
                  "cell_die_index", "autoscaler_lag_s",
-                 "corrupt_swap_count", "die_at_flip", "degrade_version"}
+                 "corrupt_swap_count", "die_at_flip", "degrade_version",
+                 "flaky_import_every"}
         unknown = set(spec) - known
         if unknown:
             logger.warning(f"{CHAOS_ENV}: ignoring unknown keys {sorted(unknown)}")
@@ -445,6 +469,83 @@ class FaultInjector:
                 return False
             self._degrade_calls += 1
             return self._degrade_calls % 2 == 0
+
+    # -- gray-failure faults (serving/health.py) -------------------------
+    def degrade_replica(self, name: str, k: int) -> None:
+        """Arm a k x-slowdown of one named replica: k-1 of every k of its
+        busy engine ticks stall (virtual time advances, no scheduling
+        progress), so the replica limps at 1/k throughput while passing
+        every binary health check — the canonical gray failure the
+        quarantine plane must detect. ``k < 2`` disarms."""
+        k = int(k)
+        with self._mu:
+            if k < 2:
+                self._degrade_replicas.pop(str(name), None)
+            else:
+                self._degrade_replicas[str(name)] = k
+                self._degrade_replica_calls.setdefault(str(name), 0)
+        if k >= 2:
+            self._count("degraded_tick_armed")
+            logger.warning(f"chaos: replica {name} degraded {k}x "
+                           f"({k - 1} of every {k} busy ticks stall)")
+
+    def arm_stall_burst(self, name: str, n: int) -> None:
+        """Arm an intermittent stall burst: the named replica's next
+        ``n`` busy engine ticks stall outright, then it runs clean —
+        the flapping-straggler pattern hysteresis is gated on."""
+        with self._mu:
+            self._stall_bursts[str(name)] = (
+                self._stall_bursts.get(str(name), 0) + max(0, int(n)))
+        self._count("stall_burst_armed")
+        logger.warning(f"chaos: replica {name} stall burst of {n} ticks")
+
+    def should_degrade_replica(self, name: Optional[str]) -> bool:
+        """Whether THIS busy engine tick of replica ``name`` should
+        stall (burst arms drain first, then the k x-slowdown parity).
+        Every True is booked as straggler evidence against the replica —
+        the DST quarantine-convergence invariant's ground truth."""
+        if name is None:
+            return False
+        name = str(name)
+        kind = None
+        with self._mu:
+            if self._stall_bursts.get(name, 0) > 0:
+                self._stall_bursts[name] -= 1
+                kind = "stall_burst"
+            else:
+                k = self._degrade_replicas.get(name)
+                if k:
+                    calls = self._degrade_replica_calls.get(name, 0) + 1
+                    self._degrade_replica_calls[name] = calls
+                    if calls % k != 0:
+                        kind = "degraded_tick"
+            if kind is not None:
+                self.straggler_evidence[name] = (
+                    self.straggler_evidence.get(name, 0) + 1)
+        if kind is None:
+            return False
+        self._count(kind)
+        return True
+
+    def on_import_kv(self) -> None:
+        """Flaky KV-import hook (serving adoption / disaggregated
+        hand-off): every ``flaky_import_every``-th call raises a
+        recoverable RuntimeError — the importer's fallback path (requeue
+        and re-prefill) is the code under test, so the fault must be
+        catchable, exactly like :class:`TickFault`."""
+        if self.flaky_import_every <= 0:
+            return
+        with self._mu:
+            self._import_calls += 1
+            hit = self._import_calls % self.flaky_import_every == 0
+        if hit:
+            self._count("flaky_import")
+            raise RuntimeError("chaos: injected flaky KV import")
+
+    def straggler_evidence_snapshot(self) -> Dict[str, int]:
+        """Per-replica count of injected degraded/stalled busy ticks."""
+        with self._mu:
+            return dict(self.straggler_evidence)
 
     def on_collective(self, op: str) -> None:
         n = self._collective_calls.get(op, 0) + 1

@@ -707,6 +707,41 @@ def generate_schedule(seed: int) -> Schedule:
     kvq = rng.choice(["none", "none", "int8", "int4"])
     engine_cfg["kv_quant"] = kvq
     serving_cfg["kv_quant"] = kvq
+    # gray-failure plane draws (serving/health.py) — appended AFTER
+    # every pre-existing draw, same regression-corpus rationale as
+    # above. Config and fault draws are INDEPENDENT on purpose:
+    # quarantine may run under clean traffic (the no-flap invariant's
+    # null case) and a straggler may limp with the plane off (the
+    # mitigation-off baseline gray_lane's TTFT gate compares against).
+    if rng.random() < 0.55:
+        fleet_cfg.update(
+            quarantine=True,
+            quarantine_threshold=rng.choice([0.4, 0.5]),
+            quarantine_after=rng.choice([2, 3]),
+            quarantine_dwell_s=rng.choice([6.0, 10.0]),
+            quarantine_readmit_polls=rng.choice([2, 3]))
+    if rng.random() < 0.5:
+        fleet_cfg.update(
+            breakers=True,
+            breaker_failures=rng.choice([3, 4]),
+            breaker_cooldown_s=rng.choice([4.0, 8.0]))
+    if rng.random() < 0.45:
+        fleet_cfg.update(hedge=True,
+                         hedge_ttft_fraction=rng.choice([0.5, 0.6]))
+    if replicas > 1 and rng.random() < 0.45:
+        events.append(SimEvent(
+            t=round(rng.uniform(1.0, horizon * 0.5), 3),
+            kind="degraded_tick",
+            payload={"which": rng.randint(0, 3), "k": rng.randint(2, 4)}))
+    if rng.random() < 0.3:
+        events.append(SimEvent(
+            t=round(rng.uniform(1.0, horizon * 0.6), 3),
+            kind="stall_burst",
+            payload={"which": rng.randint(0, 3), "n": rng.randint(2, 6)}))
+    if rng.random() < 0.25:
+        events.append(SimEvent(
+            t=round(rng.uniform(0.0, horizon * 0.4), 3),
+            kind="flaky_import", payload={"every": rng.choice([2, 3])}))
     return Schedule(seed=seed, horizon=horizon, engine_cfg=engine_cfg,
                     fleet_cfg=fleet_cfg, serving_cfg=serving_cfg,
                     events=events)
@@ -902,6 +937,42 @@ def generate_region_schedule(seed: int) -> RegionSchedule:
                                kind="migrate",
                                payload={"cell": rng.randint(0, 3),
                                         "replica": rng.randint(0, 3)}))
+    # gray-failure plane draws — appended after every pre-existing draw
+    # (same corpus rationale); the region tier composes quarantine,
+    # breakers and hedging with cell outages, partitions and rollouts
+    if rng.random() < 0.5:
+        fleet_cfg.update(
+            quarantine=True,
+            quarantine_threshold=rng.choice([0.4, 0.5]),
+            quarantine_after=rng.choice([2, 3]),
+            quarantine_dwell_s=rng.choice([6.0, 10.0]),
+            quarantine_readmit_polls=rng.choice([2, 3]))
+    if rng.random() < 0.4:
+        fleet_cfg.update(
+            breakers=True,
+            breaker_failures=rng.choice([3, 4]),
+            breaker_cooldown_s=rng.choice([4.0, 8.0]))
+    if rng.random() < 0.35:
+        fleet_cfg.update(hedge=True,
+                         hedge_ttft_fraction=rng.choice([0.5, 0.6]))
+    if rng.random() < 0.4:
+        events.append(SimEvent(
+            t=round(rng.uniform(1.0, horizon * 0.5), 3),
+            kind="degraded_tick",
+            payload={"cell": rng.randint(0, 3),
+                     "which": rng.randint(0, 3),
+                     "k": rng.randint(2, 4)}))
+    if rng.random() < 0.25:
+        events.append(SimEvent(
+            t=round(rng.uniform(1.0, horizon * 0.6), 3),
+            kind="stall_burst",
+            payload={"cell": rng.randint(0, 3),
+                     "which": rng.randint(0, 3),
+                     "n": rng.randint(2, 6)}))
+    if rng.random() < 0.2:
+        events.append(SimEvent(
+            t=round(rng.uniform(0.0, horizon * 0.4), 3),
+            kind="flaky_import", payload={"every": rng.choice([2, 3])}))
     return RegionSchedule(seed=seed, horizon=horizon,
                           engine_cfg=engine_cfg, fleet_cfg=fleet_cfg,
                           serving_cfg=serving_cfg, region_cfg=region_cfg,
@@ -1020,6 +1091,23 @@ class _Trace:
         return v
 
 
+#: virtual seconds a score-breaching replica may stay ACTIVE while the
+#: capacity floor has headroom before quarantine convergence (#15) is
+#: violated — the honest monitor acts on the very poll it observes the
+#: breach, so anything past a few polls is a detector that never fires
+QUARANTINE_SLACK_S = 30.0
+#: virtual seconds the routable pool may transiently sit below the
+#: capacity floor (a death mid-event is repaired at the next monitor
+#: poll's floor-release pass)
+FLOOR_SLACK_S = 5.0
+#: no-flap bound (#16): max quarantine entries per replica inside any
+#: FLAP_WINDOW_S of virtual time. Doubled-dwell hysteresis caps the
+#: honest machine at 5 entries per 100 virtual seconds even with the
+#: shortest drawn dwell and a breach on every probation poll.
+FLAP_WINDOW_S = 100.0
+FLAP_LIMIT = 6
+
+
 class InvariantAuditor:
     """The post-event audits. Each returns a list of violation strings;
     an empty list after every event of every schedule is the soak's
@@ -1027,11 +1115,15 @@ class InvariantAuditor:
 
     def __init__(self, fleet, clock, capture: _CaptureTelemetry,
                  tracer: Optional[Tracer] = None,
-                 vocab: Optional[int] = None) -> None:
+                 vocab: Optional[int] = None,
+                 injector: Optional[FaultInjector] = None) -> None:
         self.fleet = fleet
         self.clock = clock
         self.capture = capture
         self.tracer = tracer
+        # the run's injector: #15's ground truth for WHICH replica the
+        # schedule degraded (straggler_evidence_snapshot)
+        self.injector = injector
         # sim vocab arms invariant #10 (greedy token-identity): the
         # expected stream is recomputable from the prompt alone because
         # the sim model is a pure function of context
@@ -1043,6 +1135,11 @@ class InvariantAuditor:
         # the soak quadratic in run length
         self._trees_checked: set = set()
         self._last_now = clock.now()
+        # gray-plane audit state (#15): replica -> first audit instant
+        # a should-quarantine breach was seen with floor headroom, and
+        # fleet-pool -> first audit instant the floor was seen broken
+        self._q_pending: Dict[str, float] = {}
+        self._floor_breach: Dict[str, float] = {}
 
     def _replicas(self):
         """Every replica under audit. The region subclass widens this to
@@ -1050,6 +1147,25 @@ class InvariantAuditor:
         for free (conservation across cell death, ownership across
         partitions)."""
         return list(self.fleet.replicas)
+
+    def _fleets(self):
+        """Every fleet under audit (the gray-plane invariants #14-#16
+        read per-fleet health/breaker/hedge ledgers). The region
+        subclass widens this to all cells' fleets."""
+        return [self.fleet]
+
+    def _hedge_pairs(self):
+        """Every HedgePair the audited fleets ever minted (live uid rows
+        plus the both-terminal ledger), deduplicated."""
+        pairs = []
+        seen: set = set()
+        for fleet in self._fleets():
+            for p in list(fleet._hedges.values()) + list(fleet._hedge_done):
+                if id(p) in seen:
+                    continue
+                seen.add(id(p))
+                pairs.append(p)
+        return pairs
 
     def audit(self, tracked: List[_Tracked]) -> List[str]:
         from ..serving.request import RequestState
@@ -1092,15 +1208,23 @@ class InvariantAuditor:
             elif len(owners) != 1:
                 v.append(f"[conservation] r{t.ix} ({t.req.state.name}) "
                          f"owned by {owners} — expected exactly one owner")
-        # 4. span / SLO ledger consistency
+        # 4. span / SLO ledger consistency. Hedged requests are judged
+        # PAIR-wise by invariant #14 below (the two legs share one
+        # ledger slot — the winner's); the per-uid rules here cover the
+        # unhedged ones, with shadow uids admitted as known emitters.
+        pairs = self._hedge_pairs()
+        hedged = {p.primary.uid: p for p in pairs}
+        shadow_uids = {p.shadow.uid for p in pairs}
         span_count: Dict[int, int] = {}
         for s in self.capture.spans:
             span_count[s.uid] = span_count.get(s.uid, 0) + 1
-        known = {t.req.uid for t in tracked}
+        known = {t.req.uid for t in tracked} | shadow_uids
         for uid in span_count:
             if uid not in known:
                 v.append(f"[span-ledger] span for unknown uid {uid}")
         for t in tracked:
+            if t.req.uid in hedged:
+                continue
             n = span_count.get(t.req.uid, 0)
             if t.req.is_terminal and n != 1:
                 v.append(f"[span-ledger] r{t.ix} terminal with {n} spans "
@@ -1119,8 +1243,20 @@ class InvariantAuditor:
                      f"{reg.counter('serving/slo_met').value} != {met} "
                      f"met spans")
         # 6. stream-delivery completeness: on_token delivered exactly the
-        # emitted stream, in order, across preempt/retry/failover
+        # emitted stream, in order, across preempt/retry/failover. For a
+        # hedged request the client-visible stream is the WINNER leg's —
+        # the loser may have emitted tokens into its Request before the
+        # gate dropped them, and that is exactly what must never leak.
         for t in tracked:
+            pair = hedged.get(t.req.uid)
+            if pair is not None:
+                w = pair.winner
+                want = list(w.tokens) if w is not None else []
+                if t.delivered != want:
+                    v.append(f"[delivery] r{t.ix} (hedged): delivered "
+                             f"{t.delivered} != winner leg's emitted "
+                             f"{want}")
+                continue
             if t.delivered != list(t.req.tokens):
                 v.append(f"[delivery] r{t.ix}: delivered {t.delivered} != "
                          f"emitted {list(t.req.tokens)}")
@@ -1165,6 +1301,126 @@ class InvariantAuditor:
                 for p in trace_tree_problems(
                         self.tracer.spans_for_trace(root.trace_id)):
                     v.append(f"[trace-tree] r{t.ix}: {p}")
+        v.extend(self._audit_gray(pairs, span_count, now))
+        return v
+
+    def _audit_gray(self, pairs, span_count: Dict[int, int],
+                    now: float) -> List[str]:
+        """The gray-failure plane's invariants (docs/dst.md):
+
+        * **#14 hedge conservation** — of a hedged pair's two legs,
+          exactly one wins; the loser's span/SLO verdict never reaches
+          the ledger (at most one span across the pair, exactly one
+          once both legs are terminal, and it is the winner's).
+        * **#15 quarantine convergence + capacity floor** — a replica
+          whose health machine demands quarantine while the floor has
+          headroom is drained within ``QUARANTINE_SLACK_S``; the
+          routable pool never sits below the floor for more than
+          ``FLOOR_SLACK_S`` (quarantine defers/releases around it).
+        * **#16 no-flap** — doubled-dwell hysteresis bounds quarantine
+          churn: more than ``FLAP_LIMIT`` quarantine entries for one
+          replica inside any ``FLAP_WINDOW_S`` of virtual time means
+          the machine is flapping.
+        """
+        from ..serving.fleet import ReplicaState
+        from ..serving.health import HealthState
+
+        v: List[str] = []
+        # 14. hedge conservation
+        for pair in pairs:
+            cid = pair.primary.client_request_id
+            n = (span_count.get(pair.primary.uid, 0)
+                 + span_count.get(pair.shadow.uid, 0))
+            if n > 1:
+                v.append(f"[hedge] {cid}: {n} spans across the two legs "
+                         f"— the ledger judged the request more than "
+                         f"once")
+            if pair.winner_uid is not None:
+                if pair.winner_uid not in (pair.primary.uid,
+                                           pair.shadow.uid):
+                    v.append(f"[hedge] {cid}: winner uid "
+                             f"{pair.winner_uid} is neither leg")
+                loser = pair.loser
+                if loser is not None and span_count.get(loser.uid, 0):
+                    v.append(f"[hedge] {cid}: decided LOSER leg "
+                             f"{loser.uid} emitted a span — its verdict "
+                             f"must be suppressed")
+            if pair.primary.is_terminal and pair.shadow.is_terminal:
+                if pair.winner_uid is None:
+                    v.append(f"[hedge] {cid}: both legs terminal with "
+                             f"no winner decided")
+                elif n != 1:
+                    v.append(f"[hedge] {cid}: both legs terminal with "
+                             f"{n} spans (exactly one — the winner's — "
+                             f"expected)")
+        # 15. quarantine convergence + capacity floor
+        for fi, fleet in enumerate(self._fleets()):
+            cfg = fleet.config
+            if not cfg.quarantine:
+                continue
+            ftag = fleet.name or f"fleet{fi}"
+            pending_keys: set = set()
+            pools = ((False,) if not cfg.disaggregated else (False, True))
+            for prefill in pools:
+                routable = pool = 0
+                breaching: List[str] = []
+                for r in fleet.replicas:
+                    if (r.state is not ReplicaState.HEALTHY
+                            or (r.role == "prefill") != prefill):
+                        continue
+                    pool += 1
+                    h = fleet._health.get(r.name)
+                    if h is None or h.routable:
+                        routable += 1
+                    if h is not None and h.should_quarantine():
+                        breaching.append(r.name)
+                floor = min(cfg.prefill_replicas if prefill
+                            else cfg.min_replicas, pool)
+                pkey = f"{ftag}/{'prefill' if prefill else 'decode'}"
+                if routable < floor:
+                    first = self._floor_breach.setdefault(pkey, now)
+                    if now - first > FLOOR_SLACK_S:
+                        v.append(f"[quarantine-floor] {pkey}: {routable} "
+                                 f"routable < floor {floor} for "
+                                 f"{now - first:.0f} virtual seconds — "
+                                 f"quarantine drained below the "
+                                 f"capacity floor")
+                else:
+                    self._floor_breach.pop(pkey, None)
+                headroom = routable - 1 >= floor
+                for name in breaching:
+                    key = f"{ftag}/{name}"
+                    if not headroom:
+                        # the floor binds: deferral is the CORRECT
+                        # behavior, restart the convergence timer
+                        continue
+                    pending_keys.add(key)
+                    first = self._q_pending.setdefault(key, now)
+                    if now - first > QUARANTINE_SLACK_S:
+                        v.append(f"[quarantine] {key}: health machine "
+                                 f"demanded quarantine for "
+                                 f"{now - first:.0f} virtual seconds "
+                                 f"with floor headroom, never drained")
+            for key in list(self._q_pending):
+                if key.startswith(f"{ftag}/") and key not in pending_keys:
+                    self._q_pending.pop(key)
+        # 16. no-flap
+        for fleet in self._fleets():
+            for h in fleet._health.values():
+                entries = [t for (t, _frm, to) in h.transitions
+                           if to == HealthState.QUARANTINED]
+                for i in range(len(entries)):
+                    j = i
+                    while (j + 1 < len(entries)
+                           and entries[j + 1] - entries[i]
+                           <= FLAP_WINDOW_S):
+                        j += 1
+                    if j - i + 1 > FLAP_LIMIT:
+                        v.append(f"[flap] {h.name}: {j - i + 1} "
+                                 f"quarantine entries within "
+                                 f"{FLAP_WINDOW_S:.0f} virtual seconds "
+                                 f"— hysteresis is not bounding churn")
+                        break
         return v
 
     def _expected_stream(self, req, n: int) -> List[int]:
@@ -1239,9 +1495,10 @@ class RegionInvariantAuditor(InvariantAuditor):
 
     def __init__(self, region, clock, capture: _CaptureTelemetry,
                  tracer: Optional[Tracer] = None,
-                 vocab: Optional[int] = None) -> None:
+                 vocab: Optional[int] = None,
+                 injector: Optional[FaultInjector] = None) -> None:
         super().__init__(fleet=None, clock=clock, capture=capture,
-                         tracer=tracer, vocab=vocab)
+                         tracer=tracer, vocab=vocab, injector=injector)
         self.region = region
         # rollout-invariant state (#12/#13): per tenant, the noted
         # (submit-order, served-version) entries; the uids whose FIRST
@@ -1257,6 +1514,9 @@ class RegionInvariantAuditor(InvariantAuditor):
         for cell in self.region.cells:
             out.extend(cell.fleet.replicas)
         return out
+
+    def _fleets(self):
+        return [cell.fleet for cell in self.region.cells]
 
     def audit(self, tracked: List[_Tracked]) -> List[str]:
         from ..serving.request import RequestState
@@ -1428,6 +1688,13 @@ class SimReport:
     # region runs only: the brownout admit/shed rows — the soak's
     # strictly-priority-ordered shedding gate reads these
     brownout_log: Optional[List[Dict[str, Any]]] = None
+    # logical ix -> first-token latency in virtual seconds, for
+    # requests that streamed at least one token — gray_lane's p99 TTFT
+    # mitigation-on/off gate reads these
+    ttfts: Dict[int, float] = field(default_factory=dict)
+    # gray-failure plane snapshot (health scores, breakers, hedge
+    # ledger): the fleet's for fleet runs, per-cell for region runs
+    gray: Optional[Dict[str, Any]] = None
 
     @property
     def ok(self) -> bool:
@@ -1495,7 +1762,8 @@ def run_schedule(schedule: Schedule,
                                  dict(schedule.serving_cfg),
                                  preemption_guard=guard, start=False)
             auditor = InvariantAuditor(fleet, clock, capture,
-                                       tracer=tracer, vocab=sim_cfg.vocab)
+                                       tracer=tracer, vocab=sim_cfg.vocab,
+                                       injector=injector)
             events = sorted(schedule.events, key=_event_order)
             i = 0
             while True:
@@ -1562,7 +1830,12 @@ def run_schedule(schedule: Schedule,
         states={t.ix: t.req.state.value for t in tracked},
         span_hash=tracer.canonical_hash(), n_spans=len(tracer.spans()),
         spans=([s.to_dict() for s in tracer.spans()]
-               if violations else None))
+               if violations else None),
+        ttfts={t.ix: round(t.req.t_first_token - t.req.t_submit, 6)
+               for t in tracked
+               if t.req.t_first_token is not None
+               and t.req.t_submit is not None},
+        gray=fleet.gray_snapshot())
 
 
 def _apply_event(fleet, ev: SimEvent, tracked: List[_Tracked], guard,
@@ -1598,6 +1871,18 @@ def _apply_event(fleet, ev: SimEvent, tracked: List[_Tracked], guard,
         fleet.scale_to(int(p["n"]))
     elif ev.kind == "stall":
         clock.advance(float(p.get("dt", 1.0)))
+    elif ev.kind == "degraded_tick":
+        healthy = sorted(r.name for r in fleet.healthy_replicas)
+        if healthy:
+            name = healthy[int(p.get("which", 0)) % len(healthy)]
+            injector.degrade_replica(name, int(p.get("k", 2)))
+    elif ev.kind == "stall_burst":
+        healthy = sorted(r.name for r in fleet.healthy_replicas)
+        if healthy:
+            name = healthy[int(p.get("which", 0)) % len(healthy)]
+            injector.arm_stall_burst(name, int(p.get("n", 1)))
+    elif ev.kind == "flaky_import":
+        injector.flaky_import_every = int(p.get("every", 0))
     else:
         raise ValueError(f"unknown simulation event kind '{ev.kind}'")
 
@@ -1648,7 +1933,8 @@ def run_region_schedule(schedule: RegionSchedule,
                              preemption_guard=guard, start=False)
             auditor = RegionInvariantAuditor(region, clock, capture,
                                              tracer=tracer,
-                                             vocab=sim_cfg.vocab)
+                                             vocab=sim_cfg.vocab,
+                                             injector=injector)
             events = sorted(schedule.events, key=_event_order)
             i = 0
             while True:
@@ -1713,7 +1999,12 @@ def run_region_schedule(schedule: RegionSchedule,
         span_hash=tracer.canonical_hash(), n_spans=len(tracer.spans()),
         spans=([s.to_dict() for s in tracer.spans()]
                if violations else None),
-        brownout_log=list(region.brownout_log))
+        brownout_log=list(region.brownout_log),
+        ttfts={t.ix: round(t.req.t_first_token - t.req.t_submit, 6)
+               for t in tracked
+               if t.req.t_first_token is not None
+               and t.req.t_submit is not None},
+        gray={c.name: c.fleet.gray_snapshot() for c in region.cells})
 
 
 def _apply_region_event(region, ev: SimEvent, tracked: List[_Tracked],
@@ -1801,6 +2092,20 @@ def _apply_region_event(region, ev: SimEvent, tracked: List[_Tracked],
         injector.arm_corrupt_swap(int(p.get("n", 1)))
     elif ev.kind == "flip_death":
         injector.arm_flip_death(int(p.get("ordinal", 1)))
+    elif ev.kind in ("degraded_tick", "stall_burst"):
+        cells = sorted((c for c in region.live_cells),
+                       key=lambda c: c.name)
+        if cells:
+            cell = cells[int(p.get("cell", 0)) % len(cells)]
+            healthy = sorted(r.name for r in cell.fleet.healthy_replicas)
+            if healthy:
+                name = healthy[int(p.get("which", 0)) % len(healthy)]
+                if ev.kind == "degraded_tick":
+                    injector.degrade_replica(name, int(p.get("k", 2)))
+                else:
+                    injector.arm_stall_burst(name, int(p.get("n", 1)))
+    elif ev.kind == "flaky_import":
+        injector.flaky_import_every = int(p.get("every", 0))
     else:
         raise ValueError(f"unknown region simulation event '{ev.kind}'")
 

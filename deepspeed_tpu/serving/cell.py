@@ -82,6 +82,12 @@ class CellDigest:
     kv_demand: float
     in_sla: Optional[float]
     accepting: bool
+    # gray-failure plane (docs/fault_tolerance.md "Gray failures"):
+    # replicas drained out of the NEW-work view by quarantine — still
+    # counted in healthy_replicas (they are alive and serving admitted
+    # work), but region-level detection reads this to spot a graying
+    # cell in O(cells)
+    quarantined: int = 0
 
     @property
     def load_per_replica(self) -> float:

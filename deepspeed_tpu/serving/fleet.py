@@ -58,6 +58,8 @@ from ..resilience.locksan import named_rlock
 from ..resilience.retry import RetryBudget
 from ..telemetry.tracing import get_tracer, request_event
 from ..utils.logging import log_dist, logger
+from .health import (BreakerState, CircuitBreaker, HealthState, HedgePair,
+                     ReplicaHealth)
 from .request import Request, RequestState
 from .router import (NoHealthyReplica, PrefixAffinityRouter, RouterPolicy,
                      _hash64, least_loaded_pick, make_router)
@@ -217,6 +219,19 @@ class ServingFleet:
         self._canary: Optional[Tuple[int, float]] = None
         self._version_sla: Dict[int, collections.deque] = {}
         self._shed_backlog: List[Request] = []   # fleet-rejected, span due
+        # gray-failure resilience plane (serving/health.py;
+        # docs/fault_tolerance.md "Gray failures"): per-replica
+        # continuous health scores with quarantine/probation, routing
+        # circuit breakers, and the hedged-dispatch ledger (BOTH legs'
+        # uids map to their shared HedgePair gate). All three are
+        # monitor-driven and fleet-lock-protected; dead replicas keep
+        # their entries so transition history survives for the DST
+        # no-flap / convergence auditors.
+        self._health: Dict[str, ReplicaHealth] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._hedges: Dict[int, HedgePair] = {}
+        self._hedge_done: List[HedgePair] = []
+        self._hedged_total = 0
         # respawn backoff (ElasticAgent contract: exponential + healthy
         # reset; here per-fleet since replicas are interchangeable)
         self._respawn_after = 0.0
@@ -337,7 +352,17 @@ class ServingFleet:
         are excluded (stop-race retry loops). ``version`` restricts to
         replicas serving exactly that model version — the canary split
         and the version-affine continuation path (docs/serving.md
-        "Rollout, canary, and migration")."""
+        "Rollout, canary, and migration").
+
+        The gray-failure plane filters HERE, on the NEW-work view only,
+        which is what both routers walk — so quarantine and open
+        breakers are consulted ahead of the ring walk without the
+        router ever knowing they exist. Continuations (``live=True``)
+        still reach a quarantined replica: it is degraded, not dead,
+        and moving admitted streams would turn a p99 problem into
+        recompute load."""
+        gray = not live and (self.config.quarantine or self.config.breakers)
+        now = self._clock.now() if gray else 0.0
         out = {}
         for r in self._replicas.values():
             if r.name in refused:
@@ -350,8 +375,25 @@ class ServingFleet:
                 continue
             if version is not None and r.version != version:
                 continue
+            if gray and not self._gray_admits_locked(r.name, now):
+                continue
             out[r.name] = r.load
         return out
+
+    def _gray_admits_locked(self, name: str, now: float) -> bool:
+        """NEW-work eligibility per the gray plane (fleet lock held):
+        quarantined replicas are drained out of the view; an open
+        breaker excludes until its cooldown elapses, then admits the
+        single deterministic half-open probe."""
+        if self.config.quarantine:
+            h = self._health.get(name)
+            if h is not None and not h.routable:
+                return False
+        if self.config.breakers:
+            b = self._breakers.get(name)
+            if b is not None and not b.admits(now):
+                return False
+        return True
 
     # -- versioned serving (docs/serving.md "Rollout, canary, migration") -
     def set_canary(self, version: int, fraction: float) -> None:
@@ -479,7 +521,7 @@ class ServingFleet:
         return self._route(req, requeue=requeue, shed=shed)
 
     def _route(self, req: Request, requeue: bool = False,
-               shed: bool = True) -> bool:
+               shed: bool = True, refused=()) -> bool:
         """Pick a replica and enqueue. ``requeue`` marks the continuation
         of an already-admitted request (fail-over, hand-off fallback): it
         bypasses the fleet and replica admission gates — a draining fleet
@@ -497,8 +539,8 @@ class ServingFleet:
         tracer = get_tracer()
         if requeue:
             request_event(req, "reroute")
-        refused: set = set()
-        backoff = self.config.route_backoff_s
+        refused = set(refused)   # hedge shadows pre-refuse the primary's
+        backoff = self.config.route_backoff_s   # replica (failure domain)
         while True:
             # the router decision is a span of its own on the request's
             # tree: replica pick + (for the affinity ring) hit/miss/spill
@@ -567,6 +609,12 @@ class ServingFleet:
                     route_info = self.router.route_info()
                     self._requests[req.uid] = (req, name)
                     replica = self._replicas[name]
+                    if self.config.breakers:
+                        b = self._breakers.get(name)
+                        if b is not None:
+                            # no-op unless half-open: this request IS
+                            # the breaker's single deterministic probe
+                            b.claim_probe()
             if fail is not None:
                 # failure handling OUTSIDE the fleet lock: the requeue
                 # escalation hook re-routes through the REGION (its lock
@@ -581,6 +629,7 @@ class ServingFleet:
                 self._count("routed")
                 return True
             refused.add(name)      # stopped mid-race: try the next one
+            self._breaker_event(name, ok=False)
             with self._lock:
                 ent = self._requests.get(req.uid)
                 if ent is not None and ent[1] == name:
@@ -754,6 +803,11 @@ class ServingFleet:
         with self._lock:
             replicas = list(self._replicas.values())
             accepting = self._accepting
+            quarantined = sum(
+                1 for r in replicas
+                if r.state == ReplicaState.HEALTHY
+                and (h := self._health.get(r.name)) is not None
+                and h.state == HealthState.QUARANTINED)
         queue = live = pending = healthy = 0
         kv = 0.0
         for r in replicas:
@@ -769,7 +823,8 @@ class ServingFleet:
         return {"queue_depth": queue, "live": live, "pending_work": pending,
                 "healthy_replicas": healthy, "kv_demand": kv,
                 "in_sla": self.in_sla_ratio(),
-                "accepting": accepting and healthy > 0}
+                "accepting": accepting and healthy > 0,
+                "quarantined": quarantined}
 
     def in_sla_ratio(self) -> Optional[float]:
         """Fraction of recent SLO-carrying requests that met their SLO
@@ -798,12 +853,23 @@ class ServingFleet:
 
     # -- replica-driver callbacks (OUTSIDE the replica's serving lock) ---
     def _on_retire(self, req: Request) -> None:
+        # hedge conservation (serving/health.py HedgePair): a terminal
+        # leg decides a still-undecided race; a DECIDED loser's verdict
+        # is suppressed — the SLO ledger judges the client request once,
+        # on the winning leg (the loser's span was already gated at the
+        # replica). Table cleanup below still runs for both legs.
+        gate = getattr(req, "_hedge", None)
+        if gate is not None:
+            gate.settle(req.uid)
+        suppressed = gate is not None and gate.is_suppressed(req.uid)
         # same verdict discipline as the request span: completions judged
         # against their deadlines, sheds with an SLO count as misses,
         # user cancels not judged
         had_slo = (req.deadline_s is not None
                    or req.ttft_deadline_s is not None)
-        if req.state is RequestState.FINISHED:
+        if suppressed:
+            verdict = None
+        elif req.state is RequestState.FINISHED:
             verdict = req.in_slo()
         elif had_slo and not (req.state is RequestState.CANCELLED
                               and req.error is None):
@@ -811,7 +877,7 @@ class ServingFleet:
         else:
             verdict = None
         with self._lock:
-            self._requests.pop(req.uid, None)
+            ent = self._requests.pop(req.uid, None)
             if verdict is not None:
                 self._sla_window.append(bool(verdict))
                 self._note_version_sla(req, bool(verdict))
@@ -825,6 +891,16 @@ class ServingFleet:
             self.telemetry_source.count("slo_judged")
             if verdict:
                 self.telemetry_source.count("slo_met")
+        if self.config.breakers and ent is not None and not suppressed:
+            # breaker evidence from real outcomes: a clean finish closes
+            # (or keeps closed) the serving replica's breaker, an
+            # errored death (tick-fault budget spent, injected fault)
+            # counts against it. Sheds and user cancels are not the
+            # replica's fault and stay neutral.
+            if req.state is RequestState.FINISHED:
+                self._breaker_event(ent[1], ok=True)
+            elif req.state is RequestState.CANCELLED and req.error:
+                self._breaker_event(ent[1], ok=False)
         if self._retire_hook is not None:
             # region bookkeeping, chained OUTSIDE the fleet lock (the
             # hook takes the Region lock; region -> cell -> fleet is the
@@ -1153,6 +1229,9 @@ class ServingFleet:
         self._check_chaos()
         self._check_health()
         self._check_respawn()
+        self._check_gray()
+        self._check_hedges()
+        self._resolve_hedges()
         if self.config.autoscale:
             from ..resilience.chaos import get_fault_injector
 
@@ -1207,7 +1286,20 @@ class ServingFleet:
         """A replica whose driver thread died (unhandled crash, real
         process trouble) is treated exactly like injected death —
         DRAINING replicas included: their backlog still needs a driver,
-        and an unnoticed death would strand it forever."""
+        and an unnoticed death would strand it forever. A replica whose
+        stuck-tick watchdog ESCALATED (N consecutive wedged polls —
+        ``serving.stuck_tick_escalate_polls``) is evacuated the same
+        way: its driver is alive but wedged inside a device call, which
+        is worse — it still looks routable. The escalation check runs
+        in manual-step mode too (the watchdog check itself is driven by
+        tests there); only the thread-liveness check needs threads."""
+        with self._lock:
+            wedged = [r.name for r in self._replicas.values()
+                      if r.state != ReplicaState.DEAD
+                      and r.serving.watchdog_unhealthy]
+        for name in wedged:
+            self._count("watchdog_evacuations")
+            self.kill_replica(name, reason="stuck-tick watchdog escalation")
         if not self._start_drivers:
             return              # manual-step mode: no threads to check
         with self._lock:
@@ -1259,6 +1351,250 @@ class ServingFleet:
         record_restart()
         logger.warning(f"ServingFleet: respawned {role} capacity as "
                        f"{rep.name} ({have}/{floor} healthy)")
+
+    # -- gray-failure plane (docs/fault_tolerance.md "Gray failures") ----
+    def _gray_routable_locked(self, prefill: bool) -> int:
+        """HEALTHY replicas of the given pool still in the NEW-work
+        routing view per the quarantine machine (fleet lock held) — the
+        capacity-floor denominator."""
+        n = 0
+        for r in self._replicas.values():
+            if r.state != ReplicaState.HEALTHY:
+                continue
+            if (r.role == "prefill") != prefill:
+                continue
+            h = self._health.get(r.name)
+            if h is None or h.routable:
+                n += 1
+        return n
+
+    def _check_gray(self) -> None:
+        """One gray-health monitor pass: drain each HEALTHY replica's
+        distress counters into its continuous health score, advance the
+        quarantine/probation machines, and enforce the capacity floor
+        in BOTH directions — a quarantine that would hold the routable
+        pool below ``min_replicas`` is deferred (the breach counter
+        keeps accumulating; the next poll with headroom acts on it),
+        and deaths that strand the pool below the floor release the
+        longest-quarantined survivor back to probation."""
+        cfg = self.config
+        if not cfg.quarantine:
+            return
+        now = self._clock.now()
+        entered: List[str] = []
+        released: List[str] = []
+        with self._lock:
+            for r in list(self._replicas.values()):
+                if r.state != ReplicaState.HEALTHY:
+                    continue
+                h = self._health.get(r.name)
+                if h is None:
+                    h = self._health[r.name] = ReplicaHealth(
+                        r.name,
+                        threshold=cfg.quarantine_threshold,
+                        breach_polls=cfg.quarantine_after,
+                        dwell_s=cfg.quarantine_dwell_s,
+                        readmit_polls=cfg.quarantine_readmit_polls)
+                floor = (cfg.prefill_replicas if r.role == "prefill"
+                         else cfg.min_replicas)
+                headroom = (self._gray_routable_locked(r.role == "prefill")
+                            - (1 if h.routable else 0) >= floor)
+                busy, distress = r.serving.gray_drain()
+                if busy:
+                    h.observe(distress / busy, now, can_quarantine=headroom)
+                elif h.state == HealthState.ACTIVE:
+                    h.idle_decay()
+                else:
+                    # a drained replica serves no NEW work, so idle IS
+                    # its steady state: a zero-distress sample keeps
+                    # the dwell clock and probation re-admission moving
+                    h.observe(0.0, now, can_quarantine=headroom)
+                if h.should_quarantine() and headroom:
+                    h.quarantine(now)
+                    entered.append(r.name)
+            # the floor can break AFTER a quarantine (deaths, drains):
+            # release the longest-quarantined survivors until it holds
+            while self._gray_routable_locked(False) < cfg.min_replicas:
+                q = [h for h in (self._health.get(r.name)
+                                 for r in self._replicas.values()
+                                 if r.state == ReplicaState.HEALTHY
+                                 and r.role != "prefill")
+                     if h is not None
+                     and h.state == HealthState.QUARANTINED]
+                if not q:
+                    break
+                q.sort(key=lambda h: (h.since, h.name))
+                q[0].release(now)
+                released.append(q[0].name)
+        tag = f"ServingFleet{f'[{self.name}]' if self.name else ''}"
+        for name in entered:
+            self._count("quarantines")
+            logger.warning(f"{tag}: quarantined {name} "
+                           f"(gray-failure score breach)")
+        for name in released:
+            self._count("quarantine_floor_releases")
+            logger.warning(f"{tag}: released {name} from quarantine "
+                           f"(capacity floor)")
+
+    def _breaker_event(self, name: str, ok: bool) -> None:
+        """Fold one route/serve outcome into ``name``'s circuit breaker
+        (no-op with breakers off, or for a replica already reaped)."""
+        if not self.config.breakers:
+            return
+        now = self._clock.now()
+        with self._lock:
+            if name not in self._replicas:
+                return
+            b = self._breakers.get(name)
+            if b is None:
+                b = self._breakers[name] = CircuitBreaker(
+                    name, failure_limit=self.config.breaker_failures,
+                    cooldown_s=self.config.breaker_cooldown_s)
+            before = b.state
+            if ok:
+                b.record_success(now)
+            else:
+                b.record_failure(now)
+            opened = (b.state == BreakerState.OPEN
+                      and before != BreakerState.OPEN)
+        if opened:
+            self._count("breaker_opens")
+            logger.warning(
+                f"ServingFleet{f'[{self.name}]' if self.name else ''}: "
+                f"circuit breaker OPEN for {name}")
+
+    def _check_hedges(self) -> None:
+        """Hedged dispatch (docs/serving.md "Gray-failure resilience
+        plane"): an interactive request (TTFT deadline) with no first
+        token by ``hedge_ttft_fraction`` of its TTFT budget gets ONE
+        backup leg dispatched to a second replica through the normal
+        route path. The gate in serving/health.py guarantees
+        conservation: first token wins, the loser's tokens never reach
+        the client, its span/SLO verdict are suppressed and its KV dies
+        un-published."""
+        if not self.config.hedge:
+            return
+        now = self._clock.now()
+        to_hedge: List[Tuple[Request, str]] = []
+        with self._lock:
+            for req, rname in list(self._requests.values()):
+                if (req.ttft_deadline_s is None or req.t_submit is None
+                        or req.is_terminal or req.tokens
+                        or req.t_first_token is not None
+                        or getattr(req, "_hedge", None) is not None):
+                    continue
+                if (now - req.t_submit >= req.ttft_deadline_s
+                        * self.config.hedge_ttft_fraction):
+                    to_hedge.append((req, rname))
+        for req, rname in to_hedge:
+            self._dispatch_hedge(req, rname)
+
+    def _dispatch_hedge(self, primary: Request,
+                        primary_replica: str) -> None:
+        """Build and route the backup leg for ``primary``. The shadow
+        is a fresh Request (own uid) sharing the client_request_id,
+        prompt and deadlines; the primary's replica is pre-refused so
+        the two legs never share a failure domain. Runs WITHOUT the
+        fleet lock — routing takes it per attempt."""
+        shadow = Request(
+            prompt=list(primary.prompt),
+            max_new_tokens=primary.max_new_tokens,
+            eos_token_id=primary.eos_token_id,
+            priority=primary.priority,
+            deadline_s=primary.deadline_s,
+            ttft_deadline_s=primary.ttft_deadline_s,
+            client_request_id=primary.client_request_id,
+            tenant=primary.tenant)
+        shadow._clock = self._clock
+        shadow.t_submit = primary.t_submit   # the client's clock started then
+        pair = HedgePair(primary, shadow)
+        inner = primary.on_token
+        primary.on_token = (lambda tok, _p=pair, _u=primary.uid, _i=inner:
+                            _p.deliver(_u, _i, tok))
+        shadow.on_token = (lambda tok, _p=pair, _u=shadow.uid, _i=inner:
+                           _p.deliver(_u, _i, tok))
+        primary._hedge = pair
+        shadow._hedge = pair
+        if primary.tokens or primary.t_first_token is not None:
+            # the primary raced the gate wiring to its first token: it
+            # won outright — the gate stays (transparent to a winner),
+            # no shadow is dispatched
+            pair.settle(primary.uid)
+            pair.resolved = True
+            return
+        request_event(primary, "hedge", shadow_uid=shadow.uid)
+        with self._lock:
+            self._hedges[primary.uid] = pair
+            self._hedges[shadow.uid] = pair
+            self._hedged_total += 1
+        if self._route(shadow, shed=False, refused=(primary_replica,)):
+            self._count("hedges")
+        else:
+            # nowhere to place the backup: the hedge quietly failed and
+            # the primary continues as the sole (default-winning) leg;
+            # no span, no verdict — the loser is suppressed by contract
+            pair.settle(shadow.uid)
+            pair.resolved = True
+            shadow.error = "hedge shadow unplaceable"
+            shadow.transition(RequestState.REJECTED)
+            self._count("hedge_unplaced")
+
+    def _resolve_hedges(self) -> None:
+        """Cancel decided losers and GC both-terminal pairs. The loser
+        dies with ``_discard_kv`` set: its engine state is SUSPECT (it
+        lost the race for a reason) and is discarded un-published at
+        the replica's cancel boundary."""
+        if not self.config.hedge:
+            return
+        losers: List[Request] = []
+        with self._lock:
+            seen = set()
+            for pair in self._hedges.values():
+                if id(pair) in seen:
+                    continue
+                seen.add(id(pair))
+                if pair.resolved or pair.winner_uid is None:
+                    continue
+                pair.resolved = True
+                loser = pair.loser
+                if loser is not None and not loser.is_terminal:
+                    losers.append(loser)
+        for req in losers:
+            req._discard_kv = True
+            self.cancel(req)
+            self._count("hedge_losses")
+        with self._lock:
+            # GC the uid rows once both legs are terminal; the pair
+            # object survives in _hedge_done — the DST hedge-
+            # conservation auditor replays the whole ledger
+            done = [uid for uid, p in self._hedges.items()
+                    if p.primary.is_terminal and p.shadow.is_terminal]
+            dropped = set()
+            for uid in done:
+                p = self._hedges.pop(uid)
+                if id(p) not in dropped:
+                    dropped.add(id(p))
+                    self._hedge_done.append(p)
+
+    def gray_snapshot(self) -> Dict[str, Any]:
+        """Read-only view of the gray plane (health scores, breakers,
+        hedge ledger) — the DST auditors' and gray-lane gates' window."""
+        with self._lock:
+            pairs = []
+            seen = set()
+            for p in list(self._hedges.values()) + self._hedge_done:
+                if id(p) in seen:
+                    continue
+                seen.add(id(p))
+                pairs.append(p.snapshot())
+            return {
+                "health": {n: h.snapshot()
+                           for n, h in self._health.items()},
+                "breakers": {n: b.snapshot()
+                             for n, b in self._breakers.items()},
+                "hedges": pairs,
+                "hedged_total": self._hedged_total,
+            }
 
     # -- autoscaling -----------------------------------------------------
     def _elastic_config(self):

@@ -261,16 +261,22 @@ class Region:
         reachable = [c for c in alive
                      if is_reachable(self.name, c.name)]
         depth = 0
+        quarantined = 0
         for c in reachable:
             # bind once: a concurrent mark_dead() nulls c.digest
             d = c.digest
             if d is not None:
                 depth += d.queue_depth
+                # gray-failure detection stays O(cells): the per-cell
+                # quarantine count rides the published digest, so the
+                # region-wide graying signal never scans a replica
+                quarantined += d.quarantined
         r = t.registry
         r.gauge("serving/region/cells").set(len(alive))
         r.gauge("serving/region/reachable_cells").set(len(reachable))
         r.gauge("serving/region/queue_depth").set(depth)
         r.gauge("serving/region/brownout_floor").set(floor)
+        r.gauge("serving/region/quarantined_replicas").set(quarantined)
 
     # -- submission ------------------------------------------------------
     def submit(self, prompt: Sequence[int], *,
@@ -395,10 +401,40 @@ class Region:
                 work = self.route_work_last
                 if name is None:
                     tracer.finish_span(span, error="no reachable cell")
+                    # a transiently empty health view (every digest
+                    # stale, browned out mid-heal, a spill racing a
+                    # quarantine) must not reject outright while live
+                    # cells exist: retry the siblings under the
+                    # request's own budget with the existing jittered
+                    # backoff — the sleep runs OUTSIDE the lock below.
+                    # A region with no live reachable cell at all is a
+                    # different animal: nothing a retry can find.
+                    retryable = any(
+                        c.alive and is_reachable(self.name, c.name)
+                        for c in self._cells.values())
+                    if not retryable:
+                        self._reject(req, "no reachable cell with capacity")
+                        return False
+                else:
+                    self._requests[req.uid] = (req, name)
+                    cell = self._cells[name]
+            if name is None:
+                if not route_budget_for(
+                        req, self._fleet_config.route_retry_budget).take(
+                            "region_route"):
+                    request_event(req, "route_budget_exhausted")
                     self._reject(req, "no reachable cell with capacity")
+                    self._flush_shed()
                     return False
-                self._requests[req.uid] = (req, name)
-                cell = self._cells[name]
+                self._count("route_retries")
+                refused.clear()   # a refused cell may have healed by now
+                d = backoff
+                if d > 0:
+                    d *= 1.0 + self._route_rng.uniform(
+                        0.0, self._fleet_config.route_backoff_jitter)
+                    self._clock.sleep(d)
+                backoff = min(backoff * 2.0, 1.0)
+                continue
             accepted = cell.fleet.route_request(req, requeue=requeue,
                                                 shed=False)
             tracer.finish_span(span, cell=name, accepted=accepted,

@@ -22,7 +22,11 @@ decides which replica each incoming prompt lands on. Two policies ship:
 
 Routers are deliberately engine-agnostic: they operate on *names* plus a
 caller-supplied health/load view, so the hash-ring properties are
-testable without building a single engine.
+testable without building a single engine. That view is also where the
+gray-failure plane plugs in: the fleet's ``_view`` drops quarantined
+replicas and open circuit breakers (serving/health.py) BEFORE either
+router walks the ring, so ahead-of-the-ring-walk breaker consultation
+costs the routers nothing and changes no routing code here.
 """
 
 from __future__ import annotations

@@ -263,6 +263,17 @@ class ServingEngine:
         self._in_tick = False
         self._tick_started = 0.0
         self._stuck_reported = False
+        # stuck-tick escalation (docs/fault_tolerance.md "Gray
+        # failures"): consecutive wedged watchdog polls; past the
+        # configured budget the replica marks ITSELF unhealthy and the
+        # fleet monitor evacuates it instead of log-and-hope
+        self._stuck_polls = 0
+        self._watchdog_unhealthy = False
+        # gray-failure evidence: busy engine ticks and the degraded
+        # subset since the fleet monitor last drained them (the per-poll
+        # distress-ratio sample feeding serving/health.py)
+        self._busy_ticks = 0
+        self._distress_ticks = 0
         self._driver: Optional[threading.Thread] = None
         self._watchdog: Optional[threading.Thread] = None
         if getattr(config, "speculative", False) and not self._spec_on:
@@ -763,6 +774,35 @@ class ServingEngine:
             return (len(self._queue), len(self._live),
                     len(self._queue) + len(self._live) + pens)
 
+    def gray_drain(self) -> Tuple[int, int]:
+        """(busy_ticks, distress_ticks) since the previous drain, in one
+        lock acquisition — the fleet monitor folds the ratio into this
+        replica's :class:`~deepspeed_tpu.serving.health.ReplicaHealth`
+        score each poll. Draining (rather than cumulative counters)
+        keeps every poll's sample independent, so one bad burst ages out
+        of the EWMA instead of haunting the lifetime average."""
+        with self._lock:
+            out = (self._busy_ticks, self._distress_ticks)
+            self._busy_ticks = 0
+            self._distress_ticks = 0
+            return out
+
+    @property
+    def watchdog_unhealthy(self) -> bool:
+        """True once the stuck-tick watchdog escalated — the fleet
+        monitor's health sweep evacuates this replica. Lock-free read of
+        a watchdog-thread-owned bool (same sampling contract as
+        ``_in_tick``): a stale read delays evacuation one poll."""
+        return self._watchdog_unhealthy
+
+    def _gray_note(self, distress: bool) -> None:
+        """Book one busy engine tick (and whether it was degraded) for
+        the fleet monitor's distress-ratio sample."""
+        with self._lock:
+            self._busy_ticks += 1
+            if distress:
+                self._distress_ticks += 1
+
     def steal_queued(self, max_n: int) -> List[Request]:
         """Remove up to ``max_n`` requests from the TAIL of the admission
         queue for placement elsewhere (the region's heal-time rebalance
@@ -830,21 +870,47 @@ class ServingEngine:
         timeout = self.config.stuck_tick_timeout_s
         while not self._clock.wait_event(self._stop_evt,
                                          min(1.0, timeout / 4)):
-            if (self._in_tick and not self._stuck_reported
-                    and self._clock.now() - self._tick_started > timeout):
-                self._stuck_reported = True
-                self._count("stuck_ticks")
-                logger.warning(
-                    f"ServingEngine: tick {self._tick_count} stuck for "
-                    f"> {timeout:.0f}s (device call wedged?)")
-                tracer = get_tracer()
-                if tracer.enabled:
-                    # black box of the ticks leading into the wedge
-                    # (watchdog thread; no serving lock held here)
-                    tracer.flight.note("stuck_tick",
-                                       replica=self.replica_id,
-                                       tick=self._tick_count)
-                    tracer.flight.dump("watchdog-stuck-tick")
+            self._watchdog_check()
+
+    def _watchdog_check(self) -> None:
+        """One watchdog poll, factored out of the thread loop so the
+        SimClock regression test can drive it deterministically. A tick
+        wedged past the timeout logs once per tick (as before); after
+        ``stuck_tick_escalate_polls`` CONSECUTIVE wedged polls the
+        replica marks itself watchdog-unhealthy so the fleet monitor
+        evacuates it — a permanently wedged device call is a gray
+        failure no amount of logging fixes."""
+        timeout = self.config.stuck_tick_timeout_s
+        if not (self._in_tick
+                and self._clock.now() - self._tick_started > timeout):
+            # tick finished (or a fresh one started): the escalation
+            # budget demands CONSECUTIVE wedged polls
+            self._stuck_polls = 0
+            return
+        self._stuck_polls += 1
+        if not self._stuck_reported:
+            self._stuck_reported = True
+            self._count("stuck_ticks")
+            logger.warning(
+                f"ServingEngine: tick {self._tick_count} stuck for "
+                f"> {timeout:.0f}s (device call wedged?)")
+            tracer = get_tracer()
+            if tracer.enabled:
+                # black box of the ticks leading into the wedge
+                # (watchdog thread; no serving lock held here)
+                tracer.flight.note("stuck_tick",
+                                   replica=self.replica_id,
+                                   tick=self._tick_count)
+                tracer.flight.dump("watchdog-stuck-tick")
+        escalate = self.config.stuck_tick_escalate_polls
+        if (escalate > 0 and not self._watchdog_unhealthy
+                and self._stuck_polls >= escalate):
+            self._watchdog_unhealthy = True
+            self._count("watchdog_escalations")
+            logger.error(
+                f"ServingEngine: tick {self._tick_count} still wedged "
+                f"after {self._stuck_polls} watchdog polls — marking "
+                f"replica unhealthy for fleet evacuation")
 
     def _check_latch(self) -> None:
         """Preemption-latch poll, at the top of every tick (driver thread
@@ -904,9 +970,15 @@ class ServingEngine:
         from ..resilience.chaos import get_fault_injector
 
         inj = get_fault_injector()
-        if inj is None or not inj.should_degrade_tick(version):
+        if inj is None:
+            return False
+        if not (inj.should_degrade_replica(self.replica_id)
+                or inj.should_degrade_tick(version)):
             return False
         self._count("degraded_ticks")
+        # a degraded busy tick is the canonical distress sample: the
+        # fleet monitor's next gray_drain() sees busy=1, distress=1
+        self._gray_note(distress=True)
         self._flush_spans()
         self._update_gauges()
         return True
@@ -946,6 +1018,9 @@ class ServingEngine:
             return False
         self._tick_count += 1  # dslint: disable=races -- driver-thread-owned counter: only the ticking thread (driver or manual step, never both) increments; the watchdog and fleet chaos poll read it lock-free for diagnostics and tolerate staleness
         self._count("ticks")
+        # a productive tick is a clean distress sample; the fault path
+        # below flips it to distressed inside _on_tick_fault
+        self._gray_note(distress=False)
         try:
             from ..resilience.chaos import get_fault_injector
 
@@ -998,6 +1073,9 @@ class ServingEngine:
             if not self._adoptions:
                 return
             adoptions, self._adoptions = self._adoptions, []
+        from ..resilience.chaos import get_fault_injector
+
+        inj = get_fault_injector()
         deferred = []
         now = self._clock.now()
         for req, export in adoptions:
@@ -1019,6 +1097,11 @@ class ServingEngine:
                 deferred.append((req, export))
                 continue
             try:
+                if inj is not None:
+                    # flaky-import chaos (docs/dst.md `flaky_import`):
+                    # raises a RECOVERABLE fault every Nth import, which
+                    # the fallback below absorbs into a re-prefill
+                    inj.on_import_kv()
                 self._engine.import_kv(req.uid, export)
             except Exception as e:
                 logger.warning(
@@ -1031,6 +1114,9 @@ class ServingEngine:
                               reason=type(e).__name__)
                 with self._lock:
                     self._enqueue_locked(req, requeue=True)
+                    # a failed import costs a re-prefill: distress
+                    # evidence for the gray health score
+                    self._distress_ticks += 1
                 continue
             with self._lock:
                 req.transition(RequestState.PREFILL)
@@ -1073,7 +1159,11 @@ class ServingEngine:
     def _process_cancellations(self) -> None:
         for uid, req in list(self._live.items()):
             if req._cancel_requested:
-                self._release_engine_state(uid, publish=True)
+                # a hedge loser's KV is SUSPECT (the replica lost the
+                # race for a reason): discard it un-published instead of
+                # offering it to the prefix cache
+                self._release_engine_state(
+                    uid, publish=not getattr(req, "_discard_kv", False))
                 del self._live[uid]
                 self._retire(req, RequestState.CANCELLED)
 
@@ -1266,6 +1356,9 @@ class ServingEngine:
                        f"{type(exc).__name__}: {exc}")
         budget_spent = False
         with self._lock:
+            # the busy tick was booked clean in _tick_inner; a faulted
+            # tick is distress evidence for the gray health score
+            self._distress_ticks += 1
             for uid in uids:
                 self._release_engine_state(uid, publish=False)
                 req = self._live.pop(uid, None)
@@ -1555,6 +1648,23 @@ class ServingEngine:
                         f"(request {req.uid})")
 
     def _emit_span(self, req: Request) -> None:
+        gate = getattr(req, "_hedge", None)
+        if gate is not None:
+            # a terminal leg decides a still-undecided hedge race
+            # (primary wins by default — its outcome is what the client
+            # sees; a shadow that dies first just failed to help)
+            gate.settle(req.uid)
+            if gate.is_suppressed(req.uid):
+                # decided loser: the ledger judges the client request
+                # ONCE, on the winning leg — no span, no SLO verdict.
+                # The trace TREE still closes (observability is not the
+                # ledger; an open root would read as a leaked request)
+                finish_request_trace(req, state=req.state.value,
+                                     new_tokens=len(req.tokens),
+                                     error=req.error,
+                                     hedge_suppressed=True)
+                self._count("hedge_suppressed_spans")
+                return
         emit_request_span(self._telemetry, req, digest=self.digest)
 
     def _update_gauges(self) -> None:

@@ -53,7 +53,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 #: attr keys excluded from the canonical hash: process-lifetime counters
 #: (request uids keep incrementing across runs) and filesystem paths
-VOLATILE_ATTRS = frozenset({"uid", "client_request_id", "path"})
+VOLATILE_ATTRS = frozenset({"uid", "client_request_id", "path",
+                            "shadow_uid"})
 
 
 def _clock_time() -> float:

@@ -98,6 +98,23 @@ if [ "$#" -eq 0 ]; then
         smoke_rc=$region_rc
     fi
 
+    # gray-failure lane (CPU evidence lane, docs/fault_tolerance.md
+    # "Gray failures", docs/dst.md): the scripted straggler experiment
+    # (one replica degraded k-fold on virtual time) must quarantine the
+    # straggler within the vtick budget, fire hedged backup legs, and
+    # beat the plane-off p99 TTFT by the gated ratio without losing
+    # work; plus >= 200 seeded gray-chaos schedules (degraded_tick /
+    # stall_burst / flaky_import draws) with zero invariant violations
+    # — hedge conservation, quarantine convergence + capacity floor,
+    # and no-flap included — and bit-identical sampled replays.
+    # Writes GRAY_r01.json.
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/gray_lane.py
+    gray_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$gray_rc
+    fi
+
     # SLO lane (CPU evidence lane, docs/observability.md "Region
     # rollups & SLO alerting"): >= 200 seeded region chaos schedules
     # with every digest observation mirrored into a pooled ground-truth

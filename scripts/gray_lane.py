@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Gray-failure resilience lane: scripted straggler mitigation plus the
+seeded gray-chaos soak (docs/fault_tolerance.md "Gray failures",
+docs/serving.md "Gray-failure resilience plane", docs/dst.md).
+
+CI evidence lane for the gray-failure resilience plane
+(run by run_tests.sh):
+
+* scripted straggler leg — a 3-replica fleet on VIRTUAL time with one
+  replica degraded k-fold (k-1 of every k busy ticks stall: alive,
+  routable, silently eating the p99) serves the same seeded interactive
+  wave twice. Gates: with the plane ON the straggler is QUARANTINED
+  within a bounded virtual-tick budget; hedged backup legs actually
+  fire; p99 TTFT with mitigation on beats the plane-off run by the
+  gated ratio; and both legs finish every offered request that the
+  plane-off run finishes (mitigation must not lose work);
+* soak leg — >= 200 seeded DST schedules drawing the gray config knobs
+  (quarantine / breakers / hedge) and the gray fault kinds
+  (degraded_tick k-fold slowdowns, stall_burst, flaky_import) through
+  the REAL fleet, audited on every event by the full invariant set
+  INCLUDING hedge conservation (#14: the SLO ledger judges a hedged
+  request exactly once, first token wins), quarantine convergence +
+  capacity floor (#15: a sustained breacher leaves the routing view
+  within the slack budget, the routable pool never sits below
+  min_replicas), and no-flap (#16: bounded quarantine churn per
+  window). Gates: zero violations, a replay sample bit-identical on
+  (trace_hash, span_hash), every gray fault kind exercised, and the
+  plane actually engaged somewhere (quarantines > 0, hedges > 0 — a
+  draw that silently stops firing narrows the surface under test);
+* on any soak violation the failing schedule is delta-debugged to a
+  minimal repro and written to GRAY_REPRO_<seed>.json.
+
+Pure host-side python (SimEngine, virtual clock); writes
+GRAY_<round>.json (round via DST_ROUND, default r01).
+
+    python scripts/gray_lane.py [--schedules N] [--seed-base B]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import math
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+sys.path.insert(0, os.path.join(HERE, "scripts"))
+
+os.environ.setdefault("DST_ROUND", "r01")
+
+#: every N-th soak seed is replayed for the determinism gate
+REPLAY_STRIDE = 20
+
+#: scripted leg: the straggler must leave the routing view within this
+#: many virtual ticks of the degradation landing (actual: ~4)
+QUARANTINE_TICK_BUDGET = 50
+
+#: scripted leg: mitigation-on p99 TTFT must be at most this fraction
+#: of the plane-off p99 (actual: ~0.2 at the pinned workload)
+P99_RATIO_GATE = 0.6
+
+#: the new gray fault kinds the generator must keep emitting
+GRAY_KINDS = {"degraded_tick", "stall_burst", "flaky_import"}
+
+
+def _p99(xs):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, math.ceil(0.99 * len(xs)) - 1)]
+
+
+def _straggler_run(gray: bool, *, n_req: int = 40, k: int = 8):
+    """One leg of the scripted straggler experiment: deterministic
+    seeded wave against a fleet with replica-0 degraded k-fold."""
+    from deepspeed_tpu.resilience.chaos import (FaultInjector,
+                                                install_fault_injector)
+    from deepspeed_tpu.resilience.clock import SimClock, use_clock
+    from deepspeed_tpu.resilience.dst import SimEngine
+    from deepspeed_tpu.serving import ServingFleet
+
+    clock = SimClock()
+    inj = FaultInjector()
+    inj.degrade_replica("replica-0", k)
+    install_fault_injector(inj)
+    fleet_cfg = {"replicas": 3, "router": "prefix_affinity",
+                 "respawn": False, "min_replicas": 1}
+    if gray:
+        fleet_cfg.update(quarantine=True, quarantine_threshold=0.5,
+                         quarantine_after=3, quarantine_dwell_s=8.0,
+                         quarantine_readmit_polls=3,
+                         hedge=True, hedge_ttft_fraction=0.5)
+    serving_cfg = {"policy": "slo", "stuck_tick_timeout_s": 0.0,
+                   "drain_timeout_s": 600.0, "poll_interval_s": 0.25}
+    try:
+        with use_clock(clock):
+            fleet = ServingFleet(lambda: SimEngine(), fleet_cfg,
+                                 serving_cfg, start=False, clock=clock)
+            reqs = []
+            quarantined_at = None
+            for t in range(600):
+                if t % 2 == 0 and len(reqs) < n_req:
+                    reqs.append(fleet.submit(
+                        [1 + t, 2, 3, 4], max_new_tokens=8,
+                        ttft_deadline_s=6.0, deadline_s=200.0))
+                fleet.step()
+                clock.advance(1.0)
+                if gray and quarantined_at is None:
+                    snap = fleet.gray_snapshot()
+                    if any(h["state"] == "quarantined"
+                           for h in snap["health"].values()):
+                        quarantined_at = t
+                if len(reqs) >= n_req and all(r.is_terminal for r in reqs):
+                    break
+            snap = fleet.gray_snapshot()
+            ttfts = [r.t_first_token - r.t_submit for r in reqs
+                     if r.t_first_token is not None]
+            finished = sum(1 for r in reqs
+                           if r.state.value == "finished")
+            fleet.close()
+    finally:
+        install_fault_injector(None)
+    return {
+        "offered": n_req,
+        "finished": finished,
+        "first_tokens": len(ttfts),
+        "ttft_p50": sorted(ttfts)[len(ttfts) // 2] if ttfts else None,
+        "ttft_p99": _p99(ttfts) if ttfts else None,
+        "quarantined_at_tick": quarantined_at,
+        "hedged": snap["hedged_total"],
+        "end_vtick": clock.now(),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--schedules", type=int, default=200,
+                    help="number of seeded gray soak schedules (>= 200)")
+    ap.add_argument("--seed-base", type=int, default=3000)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+    if not args.verbose:
+        logging.disable(logging.WARNING)   # the faults ARE the workload
+
+    from deepspeed_tpu.resilience.dst import (dump_repro, generate_schedule,
+                                              run_schedule, shrink_schedule)
+
+    t0 = time.monotonic()
+
+    # -- scripted straggler leg -----------------------------------------
+    off = _straggler_run(False)
+    on = _straggler_run(True)
+    print(f"[gray-lane] straggler off: p99 TTFT {off['ttft_p99']:.1f} vt, "
+          f"{off['finished']}/{off['offered']} finished")
+    print(f"[gray-lane] straggler on:  p99 TTFT {on['ttft_p99']:.1f} vt, "
+          f"{on['finished']}/{on['offered']} finished, quarantined at "
+          f"vtick {on['quarantined_at_tick']}, {on['hedged']} hedges")
+
+    # -- seeded gray soak -----------------------------------------------
+    seeds = range(args.seed_base, args.seed_base + args.schedules)
+    failures = []
+    hashes = {}
+    kinds_seen = set()
+    gray_cfg_seeds = 0
+    quarantine_entries = 0
+    hedged_total = 0
+    breaker_moves = 0
+    totals = {"submitted": 0, "finished": 0, "cancelled": 0,
+              "rejected": 0, "ticks": 0, "events": 0}
+    for seed in seeds:
+        sched = generate_schedule(seed)
+        kinds_seen |= {e.kind for e in sched.events}
+        if any(sched.fleet_cfg.get(key)
+               for key in ("quarantine", "breakers", "hedge")):
+            gray_cfg_seeds += 1
+        report = run_schedule(sched)
+        hashes[seed] = (report.trace_hash, report.span_hash)
+        for key in ("submitted", "finished", "cancelled", "rejected"):
+            totals[key] += getattr(report, key)
+        totals["ticks"] += report.n_ticks
+        totals["events"] += report.n_events
+        gray = report.gray or {}
+        quarantine_entries += sum(
+            1 for h in gray.get("health", {}).values()
+            for _, _frm, to in h["transitions"] if to == "quarantined")
+        hedged_total += gray.get("hedged_total", 0)
+        breaker_moves += sum(len(b["transitions"])
+                             for b in gray.get("breakers", {}).values())
+        if not report.ok:
+            failures.append((seed, report.violations))
+            print(f"[gray-lane] seed {seed}: "
+                  f"{len(report.violations)} violation(s); first: "
+                  f"{report.violations[0]}")
+
+    replayed = 0
+    mismatches = []
+    for seed in range(args.seed_base, args.seed_base + args.schedules,
+                      REPLAY_STRIDE):
+        replayed += 1
+        rep = run_schedule(generate_schedule(seed))
+        if (rep.trace_hash, rep.span_hash) != hashes[seed]:
+            mismatches.append(seed)
+    wall = time.monotonic() - t0
+
+    gates = {
+        # scripted straggler leg
+        "straggler_quarantined_in_budget": (
+            on["quarantined_at_tick"] is not None
+            and on["quarantined_at_tick"] <= QUARANTINE_TICK_BUDGET),
+        "hedges_fired": on["hedged"] > 0,
+        "p99_ttft_mitigated": (
+            off["ttft_p99"] is not None and on["ttft_p99"] is not None
+            and on["ttft_p99"] <= P99_RATIO_GATE * off["ttft_p99"]),
+        "mitigation_loses_no_work": on["finished"] >= off["finished"],
+        # seeded soak
+        "enough_schedules": args.schedules >= 200,
+        "zero_invariant_violations": not failures,
+        "deterministic_replay": not mismatches,
+        "gray_fault_kinds_exercised": GRAY_KINDS <= kinds_seen,
+        "gray_configs_exercised": gray_cfg_seeds > 0,
+        "quarantine_exercised": quarantine_entries > 0,
+        "hedge_exercised": hedged_total > 0,
+    }
+    report = {
+        "metric": "gray_failure_mitigation_and_invariant_violations",
+        "straggler_off": off,
+        "straggler_on": on,
+        "quarantine_tick_budget": QUARANTINE_TICK_BUDGET,
+        "p99_ratio_gate": P99_RATIO_GATE,
+        "schedules": args.schedules,
+        "seed_base": args.seed_base,
+        "replayed_for_determinism": replayed,
+        "replay_mismatch_seeds": mismatches,
+        "fault_kinds_exercised": sorted(kinds_seen),
+        "gray_cfg_seeds": gray_cfg_seeds,
+        "quarantine_entries": quarantine_entries,
+        "hedged_total": hedged_total,
+        "breaker_transitions": breaker_moves,
+        "totals": totals,
+        "failing_seeds": [s for s, _ in failures],
+        "wall_s": round(wall, 2),
+        "gates": gates,
+        "value": len(failures),
+    }
+    from _artifact import write_artifact
+
+    path = write_artifact("GRAY", report, device="host-sim")
+    print(f"[gray-lane] {args.schedules} schedules, "
+          f"{totals['ticks']} virtual ticks, {totals['submitted']} requests; "
+          f"{quarantine_entries} quarantine entries, {hedged_total} hedges, "
+          f"{breaker_moves} breaker transitions in {wall:.1f}s")
+    print(f"[gray-lane] artifact: {path}")
+
+    for seed, violations in failures:
+        try:
+            shrunk = shrink_schedule(generate_schedule(seed))
+        except ValueError:
+            shrunk = generate_schedule(seed)   # flaked? dump it unshrunk
+        repro = os.path.join(HERE, f"GRAY_REPRO_{seed}.json")
+        shrunk_report = run_schedule(shrunk)
+        dump_repro(shrunk, shrunk_report.violations or violations, repro,
+                   timeline=shrunk_report.spans)
+        print(f"[gray-lane] seed {seed}: minimal repro "
+              f"({len(shrunk.events)} events) -> {repro}")
+
+    failed = [g for g, ok in gates.items() if not ok]
+    if failed:
+        print(f"gray lane: FAILED gates {failed}")
+        return 1
+    print(f"gray lane: OK — straggler quarantined at vtick "
+          f"{on['quarantined_at_tick']}, p99 TTFT "
+          f"{on['ttft_p99']:.1f} vs {off['ttft_p99']:.1f} vt unmitigated, "
+          f"{args.schedules} gray chaos schedules clean, "
+          f"{replayed} replays bit-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

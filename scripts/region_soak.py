@@ -64,7 +64,10 @@ REPLAY_STRIDE = 20
 EXPECTED_KINDS = {"submit", "cancel", "tick_fault", "replica_death",
                   "latch", "scale", "stall", "cell_outage", "partition",
                   "heal", "autoscaler_lag", "rollout", "migrate",
-                  "canary_regress", "corrupt_swap", "flip_death"}
+                  "canary_regress", "corrupt_swap", "flip_death",
+                  # gray-failure kinds (ISSUE 18): k-fold slowdowns,
+                  # stall bursts, flaky KV-import faults
+                  "degraded_tick", "stall_burst", "flaky_import"}
 
 
 def main() -> int:

@@ -260,6 +260,17 @@ REGRESSION_SEEDS = [
     38,   # spec drafts + int4 pool + latch + scale + tick faults
     43,   # int8 pool in a disaggregated fleet (quantized hand-off wire)
     55,   # spec drafts + int4 pool + disaggregated hand-off
+    # gray-failure draws (ISSUE 18): the hedge-conservation (#14),
+    # quarantine/capacity-floor (#15) and no-flap (#16) invariants audit
+    # these against the live gray plane on every event
+    5,    # degraded_tick + stall_burst with the gray plane OFF (pinned
+          # baseline: the new fault kinds alone must not violate)
+    7,    # flaky_import with quarantine + breakers + hedge all drawn on
+    17,   # degraded_tick straggler actually quarantined (and held by
+          # the dwell hysteresis — the seed that caught the flap bug)
+    46,   # stall_burst + hedged dispatch fired (one backup leg raced)
+    47,   # route failures open a circuit breaker mid-schedule
+    79,   # degraded_tick + hedged dispatch on the slowed replica
 ]
 
 

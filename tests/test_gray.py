@@ -1,0 +1,469 @@
+"""Gray-failure resilience plane (serving/health.py, the fleet/region
+wiring, and DST invariants #14-#16 in resilience/dst.py).
+
+Covers: the ReplicaHealth quarantine/probation machine (EWMA scoring,
+capacity-floor deferral, dwell doubling on re-entry — the anti-flap
+hysteresis), the per-replica routing CircuitBreaker (half-open single
+probe), the HedgePair conservation gate (first token wins, loser
+suppressed), the stuck-tick watchdog ESCALATION seam driven on a
+SimClock, the region tier's retry-through-siblings behavior when the
+routing view goes transiently empty, generator coverage of the new DST
+fault kinds, and planted-bug runs proving the new auditors have teeth
+(docs/fault_tolerance.md "Gray failures", docs/dst.md).
+
+Everything runs on the host-only SimEngine under a virtual clock —
+deterministic manual stepping, no threads in the assertions.
+"""
+
+import pytest
+
+from deepspeed_tpu.resilience.chaos import install_fault_injector
+from deepspeed_tpu.resilience.clock import SimClock, use_clock
+from deepspeed_tpu.resilience.dst import (SimConfig, SimEngine,
+                                          generate_region_schedule,
+                                          generate_schedule, run_schedule)
+from deepspeed_tpu.serving import Region, RequestState, ServingFleet
+from deepspeed_tpu.serving.health import (BreakerState, CircuitBreaker,
+                                          HealthState, HedgePair,
+                                          ReplicaHealth)
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _no_injector():
+    install_fault_injector(None)
+    yield
+    install_fault_injector(None)
+
+
+# ----------------------------------------------------------------------
+# ReplicaHealth: continuous scoring + quarantine/probation machine
+# ----------------------------------------------------------------------
+
+def _mk_health(**kw):
+    kw.setdefault("threshold", 0.5)
+    kw.setdefault("breach_polls", 2)
+    kw.setdefault("dwell_s", 4.0)
+    kw.setdefault("readmit_polls", 2)
+    # ewma=1.0 makes score == last sample: the state machine under test,
+    # not the smoothing (test_health_floor_release_and_idle_decay covers
+    # the EWMA fold with the production alpha)
+    kw.setdefault("ewma", 1.0)
+    return ReplicaHealth("rep", **kw)
+
+
+def test_health_sustained_breach_arms_quarantine():
+    h = _mk_health()
+    assert h.state == HealthState.ACTIVE and h.routable
+    h.observe(1.0, now=0.0)
+    assert not h.should_quarantine()          # one breach is not sustained
+    h.observe(1.0, now=1.0)
+    assert h.should_quarantine()
+    h.quarantine(now=1.0)
+    assert h.state == HealthState.QUARANTINED
+    assert not h.routable                     # drained from NEW work only
+
+
+def test_health_clean_poll_resets_breach_streak():
+    h = _mk_health()
+    h.observe(1.0, now=0.0)
+    h.observe(0.0, now=1.0)                   # score decays below threshold
+    h.observe(1.0, now=2.0)
+    assert not h.should_quarantine()          # the streak must be CONSECUTIVE
+
+
+def test_health_dwell_probation_readmit_cycle():
+    h = _mk_health()
+    for t in (0.0, 1.0):
+        h.observe(1.0, now=t)
+    h.quarantine(now=1.0)
+    h.observe(0.0, now=2.0)
+    assert h.state == HealthState.QUARANTINED  # dwell not served yet
+    h.observe(0.0, now=6.0)                    # 5s since entry >= dwell 4s
+    assert h.state == HealthState.PROBATION
+    assert h.routable                          # probation traffic IS the probe
+    h.observe(0.0, now=7.0)
+    h.observe(0.0, now=8.0)                    # readmit_polls clean polls
+    assert h.state == HealthState.ACTIVE
+    assert [(frm, to) for _, frm, to in h.transitions] == [
+        ("active", "quarantined"), ("quarantined", "probation"),
+        ("probation", "active")]
+
+
+def test_health_dwell_doubles_on_reentry_and_never_resets():
+    """The anti-flap hysteresis: every RE-quarantine doubles the dwell
+    (capped at 16x base) and a clean readmission does NOT reset it — a
+    dwell reset lets an intermittent straggler flap on a fixed short
+    period (the DST no-flap invariant #16 caught exactly that)."""
+    h = _mk_health(dwell_s=4.0)
+    h.quarantine(now=0.0)
+    assert h.dwell_s == 4.0                   # first entry: base dwell
+    h.release(now=1.0)                        # -> probation
+    h.observe(1.0, now=2.0)                   # probation breach: re-enter
+    assert h.state == HealthState.QUARANTINED
+    assert h.dwell_s == 8.0
+    # ride the full cycle back to ACTIVE, then breach again
+    h.observe(0.0, now=11.0)                  # dwell served -> probation
+    h.observe(0.0, now=12.0)
+    h.observe(0.0, now=13.0)                  # readmitted
+    assert h.state == HealthState.ACTIVE
+    assert h.dwell_s == 8.0                   # readmission kept the dwell
+    h.observe(1.0, now=14.0)
+    h.observe(1.0, now=15.0)
+    h.quarantine(now=15.0)
+    assert h.dwell_s == 16.0                  # doubled across the cycle
+    for _ in range(10):                       # cap at 16x base
+        h.release(now=16.0)
+        h.observe(1.0, now=17.0)
+    assert h.dwell_s == 4.0 * 16.0
+
+
+def test_health_probation_breach_without_headroom_stays_probation():
+    """can_quarantine=False is the caller's capacity floor binding: a
+    probation breach must stay IN probation (serving, clean streak
+    reset) — a quarantine the floor would instantly release is churn."""
+    h = _mk_health()
+    h.quarantine(now=0.0)
+    h.release(now=1.0)
+    h.observe(0.0, now=2.0)                   # one clean poll banked
+    h.observe(1.0, now=3.0, can_quarantine=False)
+    assert h.state == HealthState.PROBATION   # floor held it in place
+    assert h.routable
+    h.observe(0.0, now=4.0)
+    assert h.state == HealthState.PROBATION   # breach reset the streak
+    h.observe(0.0, now=5.0)
+    assert h.state == HealthState.ACTIVE      # readmit_polls fresh cleans
+
+
+def test_health_floor_release_and_idle_decay():
+    h = _mk_health()
+    h.quarantine(now=0.0)
+    h.release(now=1.0)                        # capacity-floor early release
+    assert h.state == HealthState.PROBATION and h.routable
+    h2 = _mk_health(ewma=0.45)
+    h2.observe(1.0, now=0.0)
+    s = h2.score
+    h2.idle_decay()
+    assert 0.0 < h2.score < s                 # idle polls age evidence out
+
+
+# ----------------------------------------------------------------------
+# CircuitBreaker: closed -> open -> half-open single probe
+# ----------------------------------------------------------------------
+
+def test_breaker_opens_on_consecutive_failures_only():
+    b = CircuitBreaker("rep", failure_limit=3, cooldown_s=5.0)
+    b.record_failure(0.0)
+    b.record_failure(0.5)
+    b.record_success(1.0)                     # success resets the streak
+    b.record_failure(1.5)
+    b.record_failure(2.0)
+    assert b.state == BreakerState.CLOSED
+    b.record_failure(2.5)
+    assert b.state == BreakerState.OPEN
+    assert not b.admits(3.0)                  # cooling down
+
+
+def test_breaker_halfopen_admits_exactly_one_probe():
+    b = CircuitBreaker("rep", failure_limit=1, cooldown_s=5.0)
+    b.record_failure(0.0)
+    assert not b.admits(4.9)
+    assert b.admits(5.0)                      # cooldown elapsed: half-open
+    assert b.state == BreakerState.HALF_OPEN
+    b.claim_probe()
+    assert not b.admits(5.1)                  # single probe slot taken
+    b.record_success(5.5)
+    assert b.state == BreakerState.CLOSED
+    assert b.admits(5.6)
+
+
+def test_breaker_probe_failure_reopens_with_fresh_cooldown():
+    b = CircuitBreaker("rep", failure_limit=1, cooldown_s=5.0)
+    b.record_failure(0.0)
+    assert b.admits(5.0)
+    b.claim_probe()
+    b.record_failure(6.0)                     # the probe failed
+    assert b.state == BreakerState.OPEN
+    assert not b.admits(10.9)                 # cooldown restarts at 6.0
+    assert b.admits(11.0)
+
+
+# ----------------------------------------------------------------------
+# HedgePair: the conservation gate
+# ----------------------------------------------------------------------
+
+class _Leg:
+    _uids = iter(range(1, 100))
+
+    def __init__(self):
+        self.uid = next(self._uids)
+        self.client_request_id = "cr-1"
+
+
+def test_hedge_first_token_wins_and_gates_loser():
+    primary, shadow = _Leg(), _Leg()
+    pair = HedgePair(primary, shadow)
+    out = []
+    pair.deliver(shadow.uid, out.append, 7)   # shadow answered first
+    pair.deliver(primary.uid, out.append, 9)  # loser's token is dropped
+    pair.deliver(shadow.uid, out.append, 8)
+    assert out == [7, 8]                      # exactly one leg's stream
+    assert pair.winner is shadow and pair.loser is primary
+    assert pair.is_suppressed(primary.uid)
+    assert not pair.is_suppressed(shadow.uid)
+
+
+def test_hedge_settle_primary_wins_shadow_loses_by_default():
+    # a terminal PRIMARY is the client-visible outcome
+    p1, s1 = _Leg(), _Leg()
+    pair = HedgePair(p1, s1)
+    pair.settle(p1.uid)
+    assert pair.winner is p1
+    # a terminal SHADOW quietly failed; the primary keeps serving
+    p2, s2 = _Leg(), _Leg()
+    pair2 = HedgePair(p2, s2)
+    pair2.settle(s2.uid)
+    assert pair2.winner is p2
+    assert pair2.is_suppressed(s2.uid)
+
+
+# ----------------------------------------------------------------------
+# stuck-tick watchdog escalation (SimClock-driven, no threads)
+# ----------------------------------------------------------------------
+
+def _sim_serving(clock, **cfg):
+    from deepspeed_tpu.serving import ServingEngine
+
+    base = {"policy": "slo", "stuck_tick_timeout_s": 5.0,
+            "stuck_tick_escalate_polls": 3, "drain_timeout_s": 600.0}
+    base.update(cfg)
+    with use_clock(clock):
+        return ServingEngine(SimEngine(), base, start=False)
+
+
+def test_watchdog_escalates_after_consecutive_stuck_polls():
+    clock = SimClock()
+    srv = _sim_serving(clock)
+    # simulate a tick wedged in a device call: the driver set the
+    # sampling fields and never came back
+    srv._tick_started = clock.now()
+    srv._in_tick = True
+    clock.advance(6.0)                        # past stuck_tick_timeout_s
+    srv._watchdog_check()
+    srv._watchdog_check()
+    assert not srv.watchdog_unhealthy         # budget is 3 CONSECUTIVE polls
+    srv._watchdog_check()
+    assert srv.watchdog_unhealthy
+    srv._in_tick = False
+    srv.close()
+
+
+def test_watchdog_escalation_budget_demands_consecutive_polls():
+    clock = SimClock()
+    srv = _sim_serving(clock)
+    srv._tick_started = clock.now()
+    srv._in_tick = True
+    clock.advance(6.0)
+    srv._watchdog_check()
+    srv._watchdog_check()
+    srv._in_tick = False                      # the tick finished after all
+    srv._watchdog_check()                     # clean poll resets the streak
+    srv._tick_started = clock.now()
+    srv._in_tick = True
+    clock.advance(6.0)
+    srv._watchdog_check()
+    srv._watchdog_check()
+    assert not srv.watchdog_unhealthy         # 2 + 2, never 3 in a row
+    srv._in_tick = False
+    srv.close()
+
+
+def test_fleet_evacuates_watchdog_unhealthy_replica():
+    """The monitor's health sweep treats an escalated replica like a
+    dead one: evacuate (orphans failed over) instead of log-and-hope."""
+    clock = SimClock()
+    with use_clock(clock):
+        fleet = ServingFleet(
+            lambda: SimEngine(), {"replicas": 2, "respawn": False},
+            {"policy": "slo", "stuck_tick_timeout_s": 5.0,
+             "stuck_tick_escalate_polls": 3, "drain_timeout_s": 600.0,
+             "poll_interval_s": 0.25},
+            start=False, clock=clock)
+        victim = fleet.replicas[0]
+        req = fleet.submit([1, 2, 3], max_new_tokens=4, deadline_s=200.0)
+        victim.serving._watchdog_unhealthy = True
+        fleet.poll()
+        assert victim.name not in [r.name for r in fleet.healthy_replicas]
+        for _ in range(200):
+            if req.is_terminal:
+                break
+            fleet.step()
+            clock.advance(1.0)
+        # the evacuated replica's work survived on the sibling
+        assert req.state is RequestState.FINISHED
+        fleet.close()
+
+
+# ----------------------------------------------------------------------
+# region tier: transiently empty routing view retries the siblings
+# ----------------------------------------------------------------------
+
+def _region(clock, cells=2, replicas=1):
+    rc = {"cells": cells, "cell_ring_vnodes": 16}
+    fc = {"replicas": replicas, "router": "prefix_affinity",
+          "respawn": False}
+    sc = {"policy": "slo", "stuck_tick_timeout_s": 0.0,
+          "drain_timeout_s": 600.0, "poll_interval_s": 0.25}
+    return Region(lambda: SimEngine(SimConfig()), rc, fc, sc, start=False,
+                  clock=clock)
+
+
+def test_region_retries_transiently_empty_view_then_places(monkeypatch):
+    """Every digest stale / browned out mid-heal / a spill racing a
+    quarantine: _pick_cell sees nothing, but live reachable cells exist
+    — the route loop must burn jittered backoff on the virtual clock
+    and retry the siblings instead of rejecting."""
+    clock = SimClock()
+    misses = {"n": 0}
+    orig = Region._pick_cell
+
+    def flaky_pick(self, prompt, refused):
+        if misses["n"] < 2:
+            misses["n"] += 1
+            return None                       # transiently empty view
+        return orig(self, prompt, refused)
+
+    monkeypatch.setattr(Region, "_pick_cell", flaky_pick)
+    with use_clock(clock):
+        region = _region(clock)
+        req = region.submit([1, 2, 3], max_new_tokens=2, deadline_s=500.0)
+        assert req.state is not RequestState.REJECTED
+        assert misses["n"] == 2               # it DID retry through the gap
+        assert clock.now() > 0.0              # backoff burned virtual time
+        for _ in range(200):
+            if req.is_terminal:
+                break
+            region.step()
+            clock.advance(1.0)
+        assert req.state is RequestState.FINISHED
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+
+
+def test_region_rejects_after_retry_budget_exhausted(monkeypatch):
+    """A view that never heals is bounded by the request's own route
+    budget: terminal REJECTED span, never a silent hang."""
+    clock = SimClock()
+    monkeypatch.setattr(Region, "_pick_cell",
+                        lambda self, prompt, refused: None)
+    with use_clock(clock):
+        region = _region(clock)
+        req = region.submit([1, 2, 3], max_new_tokens=2, deadline_s=500.0)
+        assert req.state is RequestState.REJECTED
+        assert "no reachable cell" in (req.error or "")
+        clock.pump = region.step
+        region.close(timeout=30.0)
+        clock.pump = None
+
+
+# ----------------------------------------------------------------------
+# DST generator coverage: the new gray fault kinds actually fire
+# ----------------------------------------------------------------------
+
+def test_generators_emit_gray_fault_kinds():
+    new_kinds = {"degraded_tick", "stall_burst", "flaky_import"}
+    fleet_kinds, region_kinds = set(), set()
+    fleet_cfgs = region_cfgs = 0
+    for seed in range(40):
+        s = generate_schedule(seed)
+        fleet_kinds |= {e.kind for e in s.events}
+        if s.fleet_cfg.get("quarantine") or s.fleet_cfg.get("hedge") \
+                or s.fleet_cfg.get("breakers"):
+            fleet_cfgs += 1
+        r = generate_region_schedule(seed)
+        region_kinds |= {e.kind for e in r.events}
+        if r.fleet_cfg.get("quarantine") or r.fleet_cfg.get("hedge") \
+                or r.fleet_cfg.get("breakers"):
+            region_cfgs += 1
+    assert new_kinds <= fleet_kinds
+    assert new_kinds <= region_kinds
+    assert fleet_cfgs > 0 and region_cfgs > 0
+
+
+# ----------------------------------------------------------------------
+# the new auditors have teeth (planted bugs)
+# ----------------------------------------------------------------------
+
+def _gray_schedule(seed, **fleet_cfg):
+    sched = generate_schedule(seed)
+    sched.fleet_cfg.update(fleet_cfg)
+    return sched
+
+
+def test_auditor_catches_quarantine_ignoring_capacity_floor(monkeypatch):
+    """Plant the bug the floor rule exists to stop: a fleet whose
+    headroom check always says yes quarantines the routable pool below
+    min_replicas and parks it there — invariant #15 must fire."""
+    monkeypatch.setattr(ServingFleet, "_gray_routable_locked",
+                        lambda self, prefill: 99)
+    sched = _gray_schedule(17, quarantine=True, quarantine_threshold=0.4,
+                           quarantine_after=2, quarantine_dwell_s=200.0,
+                           quarantine_readmit_polls=3)
+    report = run_schedule(sched)
+    assert not report.ok
+    assert any("[quarantine-floor]" in v for v in report.violations), \
+        report.violations
+
+
+def test_auditor_catches_hedge_double_judging(monkeypatch):
+    """Plant a suppression gate that never suppresses: the loser leg's
+    span + SLO verdict land in the ledger next to the winner's, so the
+    hedge-conservation invariant #14 must see two judgments for one
+    client request."""
+    monkeypatch.setattr(HedgePair, "is_suppressed",
+                        lambda self, uid: False)
+    sched = _gray_schedule(79, hedge=True, hedge_ttft_fraction=0.5)
+    report = run_schedule(sched)
+    assert not report.ok
+    assert any("[hedge]" in v for v in report.violations), report.violations
+
+
+def test_auditor_catches_hedge_double_delivery(monkeypatch):
+    """Plant a gate that waves every token through: both legs stream to
+    the client. The delivered stream no longer equals the winner leg's
+    emitted stream — the hedged delivery invariant #6 must fire."""
+    monkeypatch.setattr(
+        HedgePair, "deliver",
+        lambda self, leg_uid, inner, token: inner and inner(token))
+    sched = _gray_schedule(79, hedge=True, hedge_ttft_fraction=0.5)
+    report = run_schedule(sched)
+    assert not report.ok
+    assert any("[delivery]" in v or "[hedge]" in v
+               for v in report.violations), report.violations
+
+
+def test_auditor_catches_quarantine_flap(monkeypatch):
+    """Plant the original flap bug: readmission resets the dwell to
+    base and re-entry never doubles it, so an intermittent straggler
+    cycles quarantine -> probation -> active -> breach on a fixed short
+    period. The no-flap invariant #16 must bound the churn."""
+    orig = ReplicaHealth._move
+
+    def resetting_move(self, to, now):
+        orig(self, to, now)
+        self.dwell_s = self.base_dwell_s      # the bug: no hysteresis
+
+    monkeypatch.setattr(ReplicaHealth, "_move", resetting_move)
+    # pin headroom open so the capacity floor can't park the replica in
+    # probation (the OTHER half of the anti-flap design) — the dwell
+    # hysteresis alone must be what bounds churn here
+    monkeypatch.setattr(ServingFleet, "_gray_routable_locked",
+                        lambda self, prefill: 99)
+    sched = _gray_schedule(17, quarantine=True, quarantine_threshold=0.4,
+                           quarantine_after=1, quarantine_dwell_s=1.0,
+                           quarantine_readmit_polls=1)
+    report = run_schedule(sched)
+    assert not report.ok
+    assert any("[flap]" in v for v in report.violations), report.violations
