@@ -845,6 +845,16 @@ class ChaosConfig:
     # (degrade_replica / arm_stall_burst), not config keys — they name
     # replicas that only exist once the fleet is up.
     flaky_import_every: int = 0
+    # global-KV-tier faults (docs/serving.md "Global KV tier"): every
+    # Nth directory publish also injects one bogus residency entry (a
+    # directory lie — routing must detect the miss and fall back);
+    # every Nth prefix export corrupts the wire payload while keeping
+    # the stamped checksum (the importer's verify() must catch it);
+    # every Nth cold-tier put is dropped (host memory pressure — the
+    # prefix degrades to re-prefill, never double-frees). 0 disables.
+    stale_directory_every: int = 0
+    corrupt_adopt_every: int = 0
+    cold_pressure_every: int = 0
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ChaosConfig":
@@ -876,6 +886,9 @@ class ChaosConfig:
             die_at_flip=int(_take(d, "die_at_flip", -1)),
             degrade_version=int(_take(d, "degrade_version", -1)),
             flaky_import_every=int(_take(d, "flaky_import_every", 0)),
+            stale_directory_every=int(_take(d, "stale_directory_every", 0)),
+            corrupt_adopt_every=int(_take(d, "corrupt_adopt_every", 0)),
+            cold_pressure_every=int(_take(d, "cold_pressure_every", 0)),
         )
         if out.autoscaler_lag_s < 0:
             raise ConfigError(
@@ -889,6 +902,12 @@ class ChaosConfig:
             raise ConfigError(
                 f"resilience.chaos.flaky_import_every must be >= 0, got "
                 f"{out.flaky_import_every}")
+        for knob in ("stale_directory_every", "corrupt_adopt_every",
+                     "cold_pressure_every"):
+            if getattr(out, knob) < 0:
+                raise ConfigError(
+                    f"resilience.chaos.{knob} must be >= 0, got "
+                    f"{getattr(out, knob)}")
         _warn_unknown(d, "resilience.chaos")
         return out
 
@@ -1044,10 +1063,11 @@ class FleetConfig:
             raise ConfigError(
                 "serving.fleet route_backoff_s and route_backoff_jitter "
                 "must be >= 0")
-        if out.router not in ("least_loaded", "prefix_affinity"):
+        if out.router not in ("least_loaded", "prefix_affinity",
+                              "residency"):
             raise ConfigError(
-                f"serving.fleet.router must be 'least_loaded' or "
-                f"'prefix_affinity', got '{out.router}'")
+                f"serving.fleet.router must be 'least_loaded', "
+                f"'prefix_affinity' or 'residency', got '{out.router}'")
         if out.replicas < 1:
             raise ConfigError(
                 f"serving.fleet.replicas must be >= 1, got {out.replicas}")
@@ -1283,6 +1303,61 @@ class RolloutConfig:
 
 
 @dataclass
+class KVTierConfig:
+    """The ``serving.kv_tier`` block: the global KV tier
+    (docs/serving.md "Global KV tier"). Default OFF — with
+    ``enabled=False`` no directory, adoption pen, or cold tier is
+    constructed and old traces/seeds replay bit-identically.
+
+    ``publish_interval_s`` is the residency-publication cadence (each
+    replica's driver snapshots its prefix-cache keys at most this often,
+    piggybacked on the fleet's poll); ``directory_staleness_s`` bounds
+    how old a directory entry may be before routing stops trusting it —
+    it must be at least the publish interval, or every entry would
+    expire before its holder could refresh it. ``adoption`` gates
+    cross-replica prefix adoption (directory hit on another replica ->
+    quantized pages on the wire); ``cold_tier``/``cold_capacity_pages``
+    gate the host-memory spill store for evicted prefixes."""
+
+    enabled: bool = False
+    publish_interval_s: float = 1.0
+    directory_staleness_s: float = 5.0
+    adoption: bool = True
+    cold_tier: bool = True
+    cold_capacity_pages: int = 256
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "KVTierConfig":
+        if not d:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_take(d, "enabled", False)),
+            publish_interval_s=float(_take(d, "publish_interval_s", 1.0)),
+            directory_staleness_s=float(
+                _take(d, "directory_staleness_s", 5.0)),
+            adoption=bool(_take(d, "adoption", True)),
+            cold_tier=bool(_take(d, "cold_tier", True)),
+            cold_capacity_pages=int(_take(d, "cold_capacity_pages", 256)),
+        )
+        if out.publish_interval_s <= 0:
+            raise ConfigError(
+                f"serving.kv_tier.publish_interval_s must be > 0, got "
+                f"{out.publish_interval_s}")
+        if out.directory_staleness_s < out.publish_interval_s:
+            raise ConfigError(
+                f"serving.kv_tier.directory_staleness_s must be >= "
+                f"publish_interval_s ({out.publish_interval_s}), got "
+                f"{out.directory_staleness_s}")
+        if out.cold_tier and out.cold_capacity_pages < 1:
+            raise ConfigError(
+                f"serving.kv_tier.cold_capacity_pages must be >= 1 when "
+                f"the cold tier is enabled, got {out.cold_capacity_pages}")
+        _warn_unknown(d, "serving.kv_tier")
+        return out
+
+
+@dataclass
 class ServingConfig:
     """The ``serving`` block: knobs for the request front-end over the
     ragged engine (docs/serving.md).
@@ -1339,6 +1414,7 @@ class ServingConfig:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     region: RegionConfig = field(default_factory=RegionConfig)
     rollout: RolloutConfig = field(default_factory=RolloutConfig)
+    kv_tier: KVTierConfig = field(default_factory=KVTierConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
@@ -1349,6 +1425,7 @@ class ServingConfig:
             fleet=FleetConfig.from_dict(_take(d, "fleet", None)),
             region=RegionConfig.from_dict(_take(d, "region", None)),
             rollout=RolloutConfig.from_dict(_take(d, "rollout", None)),
+            kv_tier=KVTierConfig.from_dict(_take(d, "kv_tier", None)),
             max_queue=int(_take(d, "max_queue", 256)),
             policy=str(_take(d, "policy", "slo")),
             kv_pressure=float(_take(d, "kv_pressure", 0.90)),

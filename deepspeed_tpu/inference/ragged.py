@@ -132,7 +132,7 @@ class PrefixCache:
     benign case of re-writing the final shared position with bit-identical
     K/V (same tokens, same absolute positions, same params)."""
 
-    def __init__(self, block_size: int):
+    def __init__(self, block_size: int, on_evict=None):
         import collections
 
         self.block_size = block_size
@@ -145,6 +145,11 @@ class PrefixCache:
         self._block_refs: Dict[int, int] = {}
         self.hits = 0
         self.misses = 0
+        # optional eviction hook ``(key_tuple, blocks) -> None`` fired
+        # BEFORE the evicted entry's refs release (its pages are still
+        # valid to read) — the global KV tier's directory-invalidate +
+        # cold-spill seam. None (the default) changes nothing.
+        self.on_evict = on_evict
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -164,6 +169,23 @@ class PrefixCache:
                 return k * bs, ent
         self.misses += 1
         return 0, []
+
+    def lookup(self, tokens: Sequence[int]) -> Tuple[Optional[Tuple[int, ...]],
+                                                     List[int]]:
+        """Longest full-block prefix ENTRY covering ``tokens`` — unlike
+        :meth:`match` there is no leave-one-token-to-prefill cap, because
+        adoption/export wants whole cache entries (the requester's
+        routing key is already a full-block prefix). Refreshes LRU
+        recency (a donor should not evict what it is donating) but does
+        not count hits/misses. Returns (key, blocks) or (None, [])."""
+        bs = self.block_size
+        for k in range(len(tokens) // bs, 0, -1):
+            key = tuple(int(t) for t in tokens[: k * bs])
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+                return key, ent
+        return None, []
 
     def _hold(self, key, blocks, allocator: BlockedAllocator) -> None:
         allocator.retain(blocks)
@@ -195,7 +217,12 @@ class PrefixCache:
             self._hold(kkey, held[:kk], allocator)
 
     def _evict_one(self, allocator: BlockedAllocator) -> None:
-        _, blocks = self._entries.popitem(last=False)   # LRU
+        key, blocks = self._entries.popitem(last=False)   # LRU
+        if self.on_evict is not None:
+            # hook runs while the entry's pages are still referenced:
+            # the cold-spill copy must read them before they can return
+            # to the free list and be overwritten
+            self.on_evict(key, blocks)
         allocator.release(blocks)
         for b in blocks:
             self._block_refs[b] -= 1
@@ -654,6 +681,19 @@ class RaggedInferenceEngine:
         # per-uid memoized n-gram draft indices (draft_tokens): extended
         # lazily on append, truncated by trim(), dropped on flush/discard
         self._ngram_idx: Dict[int, NgramIndex] = {}
+        # global-KV-tier seams (docs/serving.md "Global KV tier"), all
+        # inert until enable_kv_tier() attaches them: the fleet's
+        # host-memory cold tier, the directory-invalidate callback
+        # (fired synchronously on eviction so a directory entry never
+        # outlives its pages), and the per-engine adoption counters the
+        # DST auditor reads
+        self._cold_tier = None
+        self._on_prefix_invalidate = None
+        self._kv_tier_member = ""
+        self.kvtier_cold_spills = 0
+        self.kvtier_cold_readmits = 0
+        self.kvtier_adopt_imports = 0
+        self.kvtier_corrupt_landed = 0
         # sampling streams: decode steps fold a GLOBAL step counter into the
         # decode key, so sampled output is invariant to how decode_steps
         # calls chunk the token budget; prefill first-tokens get their own
@@ -975,6 +1015,244 @@ class RaggedInferenceEngine:
         if t.enabled:
             t.registry.counter("inference/kv_imports").inc()
 
+    # -- global KV tier (docs/serving.md "Global KV tier") ---------------
+    def enable_kv_tier(self, *, member: str = "", cold_tier=None,
+                       on_invalidate=None) -> None:
+        """Attach this engine to the fleet's global KV tier:
+        ``cold_tier`` receives evicted prefixes (host-memory spill),
+        ``on_invalidate(hash)`` drops the directory entry synchronously
+        at eviction time (an entry must never outlive its pages). Both
+        hooks are leaf-locked, so firing them under the driver's
+        serving lock is legal in the documented lock order."""
+        self._kv_tier_member = str(member)
+        self._cold_tier = cold_tier
+        self._on_prefix_invalidate = on_invalidate
+        if self.prefix_cache is not None and (
+                cold_tier is not None or on_invalidate is not None):
+            self.prefix_cache.on_evict = self._on_prefix_evict
+
+    def _on_prefix_evict(self, key: Tuple[int, ...],
+                         blocks: List[int]) -> None:
+        """PrefixCache eviction hook: directory invalidation FIRST (the
+        entry must be gone before the pages can be reused), then the
+        cold-tier spill (a host copy gathered while the evicted entry's
+        refs still pin the pages)."""
+        if self._on_prefix_invalidate is not None:
+            from ..serving.kvtier import prefix_hash
+
+            self._on_prefix_invalidate(prefix_hash(key))
+        cold = self._cold_tier
+        if cold is not None:
+            export = self._gather_prefix_export(key, list(blocks))
+            if cold.put(export):
+                self.kvtier_cold_spills += 1
+
+    def prefix_residency_hashes(self) -> List[int]:
+        """Hashes of every resident prefix-cache entry — the residency
+        set a replica publishes into the fleet's prefix directory.
+        Driver-thread only (reads the cache's entry map directly)."""
+        if self.prefix_cache is None:
+            return []
+        from ..serving.kvtier import prefix_hash
+
+        return [prefix_hash(k) for k in self.prefix_cache._entries]
+
+    def _gather_prefix_export(self, key: Tuple[int, ...],
+                              blocks: List[int]):
+        """Host-copy ``blocks`` (one gather per layer leaf, quantized
+        payload + scales exactly as pooled) into a checksummed
+        :class:`~deepspeed_tpu.serving.kvtier.PrefixExport`."""
+        from ..serving.kvtier import PrefixExport
+
+        c = self.model.config
+        cfg = self.config
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
+        k = np.stack([np.asarray(leaf[idx]) for leaf in self.kv_pool[0]])
+        v = np.stack([np.asarray(leaf[idx]) for leaf in self.kv_pool[1]])
+        scales = None
+        if self._kv_bits:
+            ks = np.stack([np.asarray(leaf[idx])
+                           for leaf in self.kv_pool[2]])
+            vs = np.stack([np.asarray(leaf[idx])
+                           for leaf in self.kv_pool[3]])
+            scales = (ks, vs)
+        wire = int(k.nbytes + v.nbytes)
+        if scales is not None:
+            wire += int(scales[0].nbytes + scales[1].nbytes)
+        # logical = the dense (unquantized) bytes the same pages would
+        # move — the CommsLogger compression-ratio denominator
+        logical = (2 * len(blocks) * c.n_layers * c.n_kv_heads
+                   * cfg.kv_block_size * c.head_dim
+                   * jnp.dtype(cfg.dtype).itemsize)
+        return PrefixExport(
+            tokens=key, n_pages=len(blocks),
+            block_size=cfg.kv_block_size, n_layers=c.n_layers,
+            n_kv_heads=c.n_kv_heads, head_dim=c.head_dim,
+            dtype=str(jnp.dtype(cfg.dtype)), kv_quant=cfg.kv_quant,
+            pages=(k, v), scales=scales,
+            wire_bytes=wire, logical_bytes=logical,
+            source=self._kv_tier_member)
+
+    def export_prefix(self, tokens: Sequence[int]):
+        """Snapshot the longest cached full-block prefix of ``tokens``
+        for cross-replica adoption (quantized pages + scales on the
+        wire, ZeRO++-style). Returns None on a cache miss. The pages
+        are retained across the host gather so an eviction mid-export
+        cannot free them under the copy; the transfer lands in the
+        bytes-on-wire ledger as a ``kv_adopt`` row next to the
+        disaggregated hand-off's ``kv_handoff``."""
+        if self.prefix_cache is None:
+            return None
+        key, blocks = self.prefix_cache.lookup(tokens)
+        if not blocks:
+            return None
+        blocks = list(blocks)
+        self.allocator.retain(blocks)
+        try:
+            export = self._gather_prefix_export(key, blocks)
+        finally:
+            self.allocator.release(blocks)
+        t = self._telemetry
+        if t.enabled:
+            t.registry.counter("inference/prefix_exports").inc()
+            t.registry.counter("inference/prefix_export_pages").inc(
+                len(blocks))
+            t.registry.counter("inference/prefix_export_bytes").inc(
+                export.wire_bytes)
+        from ..comm.comm import record_collective
+
+        record_collective("kv_adopt", export.logical_bytes,
+                          export.wire_bytes)
+        from ..resilience.chaos import get_fault_injector
+
+        inj = get_fault_injector()
+        if inj is not None and inj.on_prefix_export():
+            # adoption-wire corruption: flip one token AFTER the
+            # checksum was stamped — the importer's verify() must catch
+            # the mismatch and fall back to local prefill
+            export.tokens = ((export.tokens[0] ^ 0x1,)
+                             + export.tokens[1:])
+        return export
+
+    def import_prefix(self, export) -> bool:
+        """Adopt an exported PREFIX into this engine's prefix cache (no
+        live sequence — the counterpart of :meth:`import_kv` for the
+        global KV tier; cold-tier re-admission uses the same path).
+        Verifies the checksum FIRST (a corrupted adoption must never
+        land — DST invariant #19), then geometry, then allocates,
+        scatters and publishes; the cache ends holding the only refs,
+        so ``block_balance_report`` stays exact. Returns False when the
+        prefix is already resident; raises ValueError / PoolExhausted
+        (recoverable: the caller degrades to local prefill)."""
+        if self.prefix_cache is None:
+            raise ValueError("prefix cache disabled; nothing to adopt into")
+        cfg = self.config
+        c = self.model.config
+        if not export.verify():
+            if not getattr(self, "_kvtier_skip_verify", False):
+                from ..serving.kvtier import CorruptExport
+                raise CorruptExport(
+                    "prefix export failed checksum verification "
+                    "(corrupted in transit)")
+            # planted-bug seam (tests/DST only): verification disabled —
+            # the landed-corruption counter is invariant #19's witness
+            self.kvtier_corrupt_landed += 1
+        want = (cfg.kv_block_size, c.n_layers, c.n_kv_heads, c.head_dim,
+                str(jnp.dtype(cfg.dtype)), cfg.kv_quant)
+        if want != export.geometry():
+            raise ValueError(
+                f"prefix KV geometry mismatch: engine (block,layers,hkv,"
+                f"hd,dtype,kv_quant)={want} vs export {export.geometry()}")
+        if self._kv_bits and export.scales is None:
+            raise ValueError(
+                f"export tagged kv_quant={export.kv_quant} carries no "
+                f"scales")
+        need = export.n_pages
+        if need <= 0 or need != len(export.tokens) // cfg.kv_block_size \
+                or len(export.tokens) % cfg.kv_block_size:
+            raise ValueError(
+                f"prefix export carries {need} pages for "
+                f"{len(export.tokens)} tokens (full blocks required)")
+        if len(export.tokens) > cfg.max_context:
+            raise ValueError(
+                f"prefix length {len(export.tokens)} exceeds max_context "
+                f"{cfg.max_context}")
+        if tuple(export.tokens) in self.prefix_cache._entries:
+            return False            # already resident
+        if need > self.allocator.free_blocks:
+            self.prefix_cache.evict_for(self.allocator, need)
+        blocks = self.allocator.allocate(need)    # may raise PoolExhausted
+        try:
+            B = 1
+            while B < need:
+                B *= 2
+            B = min(B, self.max_pages)
+            dst = np.full((B,), cfg.n_kv_blocks, np.int32)
+            dst[:need] = blocks
+            k, v = export.pages
+            ks = vs = None
+            if self._kv_bits:
+                ks, vs = export.scales
+            if B > need:
+                pad = np.zeros((k.shape[0], B - need) + k.shape[2:],
+                               k.dtype)
+                k = np.concatenate([k, pad], axis=1)
+                v = np.concatenate([v, pad], axis=1)
+                if self._kv_bits:
+                    spad = np.zeros((ks.shape[0], B - need) + ks.shape[2:],
+                                    ks.dtype)
+                    ks = np.concatenate([ks, spad], axis=1)
+                    vs = np.concatenate([vs, spad], axis=1)
+            if self._kv_bits:
+                self.kv_pool = self._write_pages(
+                    self.kv_pool, jnp.asarray(dst), jnp.asarray(k),
+                    jnp.asarray(v), jnp.asarray(ks), jnp.asarray(vs))
+            else:
+                self.kv_pool = self._write_pages(
+                    self.kv_pool, jnp.asarray(dst), jnp.asarray(k),
+                    jnp.asarray(v))
+        except BaseException:
+            self.allocator.release(blocks)
+            raise
+        # publish takes the cache's own retains (one per nested level),
+        # then the allocation ref drops — the cache holds the ONLY refs
+        self.prefix_cache.publish(export.tokens, blocks,
+                                  len(export.tokens), self.allocator)
+        self.allocator.release(blocks)
+        self.kvtier_adopt_imports += 1
+        t = self._telemetry
+        if t.enabled:
+            t.registry.counter("inference/prefix_imports").inc()
+            t.registry.counter("inference/prefix_import_pages").inc(need)
+        return True
+
+    def _cold_readmit(self, tokens: Sequence[int]) -> None:
+        """Probe the cold tier for the longest spilled full-block prefix
+        of ``tokens`` that is not already device-resident, and re-admit
+        it through :meth:`import_prefix` (the same checksum/geometry
+        path as remote adoption) so the admission match finds it.
+        Best-effort: pool pressure or a failed verify degrades to plain
+        prefill — degraded, never lost."""
+        bs = self.config.kv_block_size
+        for k in range((len(tokens) - 1) // bs, 0, -1):
+            key = tuple(int(t) for t in tokens[: k * bs])
+            if key in self.prefix_cache._entries:
+                return              # device cache already at least as good
+            export = self._cold_tier.get(key)
+            if export is None:
+                continue
+            try:
+                if self.import_prefix(export):
+                    self.kvtier_cold_readmits += 1
+                    t = self._telemetry
+                    if t.enabled:
+                        t.registry.counter(
+                            "inference/prefix_cold_readmits").inc()
+            except (ValueError, RuntimeError):
+                # PoolExhausted / corrupted entry: drop to plain prefill
+                pass
+            return
+
     def _write_pages(self, pools, dst, k, v, ks=None, vs=None):
         """Scatter imported pages into every layer's K/V leaf (one jitted
         donated program; the import-side half of the hand-off seam). With
@@ -1091,6 +1369,10 @@ class RaggedInferenceEngine:
             if new:
                 seq.prompt_len = len(seq.tokens)
             if new and self.prefix_cache is not None and seq.tokens:
+                if self._cold_tier is not None:
+                    # cold-tier re-admission first, so the match below
+                    # can adopt a spilled prefix the device pool lost
+                    self._cold_readmit(seq.tokens)
                 # adopt the longest cached full-block prefix: its KV pages
                 # are shared (retained), and prefill starts past them
                 shared, blocks = self.prefix_cache.match(seq.tokens)

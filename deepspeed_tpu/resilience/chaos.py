@@ -132,7 +132,10 @@ class FaultInjector:
                  corrupt_swap_count: int = 0,
                  die_at_flip: int = -1,
                  degrade_version: int = -1,
-                 flaky_import_every: int = 0):
+                 flaky_import_every: int = 0,
+                 stale_directory_every: int = 0,
+                 corrupt_adopt_every: int = 0,
+                 cold_pressure_every: int = 0):
         fields = {
             "seed": seed,
             "crash_before_commit_at_save": crash_before_commit_at_save,
@@ -157,6 +160,9 @@ class FaultInjector:
             "die_at_flip": die_at_flip,
             "degrade_version": degrade_version,
             "flaky_import_every": flaky_import_every,
+            "stale_directory_every": stale_directory_every,
+            "corrupt_adopt_every": corrupt_adopt_every,
+            "cold_pressure_every": cold_pressure_every,
         }
         for name, default in fields.items():
             setattr(self, name,
@@ -182,6 +188,18 @@ class FaultInjector:
         self._stall_bursts: Dict[str, int] = {}
         self._import_calls = 0
         self.straggler_evidence: Dict[str, int] = {}
+        # global-KV-tier fault state (docs/serving.md "Global KV tier"):
+        # publish/export/cold-put call counters for the every-Nth knobs,
+        # plus the ground-truth ledgers the DST auditor reads — the set
+        # of (member, hash) directory lies currently injected (so the
+        # entries-never-outlive-pages invariant can exempt them) and the
+        # count of corrupted exports produced (every one must be caught
+        # by the importer's checksum — none may land)
+        self._directory_publishes = 0
+        self._prefix_exports = 0
+        self._cold_puts = 0
+        self.injected_stale: set = set()
+        self.corrupted_exports = 0
         # active network partitions: (group_a, group_b) name sets. Nodes
         # in different groups of any active partition cannot reach each
         # other; nodes a partition does not mention are unaffected by it.
@@ -229,7 +247,8 @@ class FaultInjector:
                  "replica_die_index", "cell_die_at_tick",
                  "cell_die_index", "autoscaler_lag_s",
                  "corrupt_swap_count", "die_at_flip", "degrade_version",
-                 "flaky_import_every"}
+                 "flaky_import_every", "stale_directory_every",
+                 "corrupt_adopt_every", "cold_pressure_every"}
         unknown = set(spec) - known
         if unknown:
             logger.warning(f"{CHAOS_ENV}: ignoring unknown keys {sorted(unknown)}")
@@ -541,6 +560,68 @@ class FaultInjector:
         if hit:
             self._count("flaky_import")
             raise RuntimeError("chaos: injected flaky KV import")
+
+    def on_directory_publish(self, member: str) -> Optional[int]:
+        """Stale-directory-entry hook (global KV tier): every
+        ``stale_directory_every``-th residency publish returns a bogus
+        prefix hash for the publisher to ALSO claim — a directory lie
+        (no pages back it). The (member, hash) pair is remembered in
+        ``injected_stale`` as the DST auditor's exemption ground truth;
+        routing must treat the lie as any other stale entry (fall back
+        to the affinity ring / local prefill, never wedge)."""
+        if self.stale_directory_every <= 0:
+            return None
+        with self._mu:
+            self._directory_publishes += 1
+            hit = (self._directory_publishes
+                   % self.stale_directory_every == 0)
+            if hit:
+                # deterministic bogus hash: derived from the publish
+                # ordinal so replays inject the identical lie
+                bogus = (0xDEAD0000_00000000
+                         | (self._directory_publishes & 0xFFFFFFFF))
+                self.injected_stale.add((member, bogus))
+        if not hit:
+            return None
+        self._count("stale_directory")
+        return bogus
+
+    def on_prefix_export(self) -> bool:
+        """Adoption-wire-corruption hook (global KV tier): every
+        ``corrupt_adopt_every``-th prefix export should have its wire
+        content corrupted AFTER the checksum is stamped. Returns True
+        when the caller must corrupt; ``corrupted_exports`` counts the
+        ground truth for the none-may-land invariant (#19)."""
+        if self.corrupt_adopt_every <= 0:
+            return False
+        with self._mu:
+            self._prefix_exports += 1
+            hit = self._prefix_exports % self.corrupt_adopt_every == 0
+            if hit:
+                self.corrupted_exports += 1
+        if hit:
+            self._count("corrupt_adopt")
+        return hit
+
+    def on_cold_put(self) -> bool:
+        """Cold-tier-pressure hook (global KV tier): every
+        ``cold_pressure_every``-th cold-tier admission is dropped —
+        the evicted prefix is simply lost to the cold tier (host under
+        memory pressure) and later demand re-prefills. Returns True
+        when the put must be dropped."""
+        if self.cold_pressure_every <= 0:
+            return False
+        with self._mu:
+            self._cold_puts += 1
+            hit = self._cold_puts % self.cold_pressure_every == 0
+        if hit:
+            self._count("cold_pressure")
+        return hit
+
+    def injected_stale_snapshot(self) -> set:
+        """The (member, bogus-hash) directory lies currently injected."""
+        with self._mu:
+            return set(self.injected_stale)
 
     def straggler_evidence_snapshot(self) -> Dict[str, int]:
         """Per-replica count of injected degraded/stalled busy ticks."""

@@ -58,7 +58,8 @@ from ..telemetry.registry import MetricsRegistry
 from ..telemetry.telemetry import Telemetry, set_telemetry
 from ..telemetry.tracing import Tracer, trace_tree_problems, use_tracer
 from ..utils.logging import logger
-from .chaos import FaultInjector, TickFault, install_fault_injector
+from .chaos import (FaultInjector, TickFault, get_fault_injector,
+                    install_fault_injector)
 from .clock import SimClock, use_clock
 
 __all__ = ["SimConfig", "SimEngine", "SimKVExport", "SimEvent", "Schedule",
@@ -149,6 +150,15 @@ class SimEngine:
         # per-uid memoized n-gram indices + the acceptance-stats dict
         self._ngram_idx: Dict[int, NgramIndex] = {}
         self.spec_stats = {"proposed": 0, "accepted": 0, "rounds": 0}
+        # global KV tier seams (mirrors RaggedInferenceEngine; wired by
+        # ServingEngine.enable_kv_tier when serving.kv_tier is on)
+        self._cold_tier = None
+        self._on_prefix_invalidate = None
+        self._kv_tier_member = ""
+        self.kvtier_cold_spills = 0
+        self.kvtier_cold_readmits = 0
+        self.kvtier_adopt_imports = 0
+        self.kvtier_corrupt_landed = 0
 
     # -- capacity queries (formulas identical to the ragged engine) -----
     def _available_blocks(self) -> int:
@@ -321,6 +331,125 @@ class SimEngine:
             prompt_len=int(export.prompt_len))
         self._resume_uids.discard(uid)
 
+    # -- global KV tier (payload-free mirror of the ragged engine) -------
+    def enable_kv_tier(self, *, member: str = "", cold_tier=None,
+                       on_invalidate=None) -> None:
+        """Same seam as the ragged engine: record the tier hooks and
+        attach the eviction callback. Sim exports carry no pages — the
+        checksum covers the token stream, which is exactly what the
+        injected wire corruption flips."""
+        self._kv_tier_member = member
+        self._cold_tier = cold_tier
+        self._on_prefix_invalidate = on_invalidate
+        if self.prefix_cache is not None and (
+                cold_tier is not None or on_invalidate is not None):
+            self.prefix_cache.on_evict = self._on_prefix_evict
+
+    def _sim_geometry(self):
+        cfg = self.config
+        return (cfg.kv_block_size, 1, 1, 1, "sim", cfg.kv_quant)
+
+    def _make_prefix_export(self, key, blocks):
+        from ..serving.kvtier import PrefixExport
+
+        cfg = self.config
+        return PrefixExport(
+            tokens=key, n_pages=len(blocks),
+            block_size=cfg.kv_block_size, n_layers=1, n_kv_heads=1,
+            head_dim=1, dtype="sim", kv_quant=cfg.kv_quant,
+            wire_bytes=len(blocks) * cfg.kv_block_size,
+            logical_bytes=2 * len(blocks) * cfg.kv_block_size,
+            source=self._kv_tier_member)
+
+    def _on_prefix_evict(self, key, blocks) -> None:
+        # invalidate FIRST (the directory entry must not outlive the
+        # pages), then spill a host copy — same order as the real engine
+        if self._on_prefix_invalidate is not None:
+            from ..serving.kvtier import prefix_hash
+
+            self._on_prefix_invalidate(prefix_hash(key))
+        if self._cold_tier is not None:
+            if self._cold_tier.put(self._make_prefix_export(key, blocks)):
+                self.kvtier_cold_spills += 1
+
+    def prefix_residency_hashes(self) -> List[int]:
+        if self.prefix_cache is None:
+            return []
+        from ..serving.kvtier import prefix_hash
+
+        return [prefix_hash(k) for k in self.prefix_cache._entries]
+
+    def export_prefix(self, tokens: Sequence[int]):
+        """Donor side of cross-replica adoption: longest resident
+        full-block prefix of ``tokens`` as a payload-free PrefixExport
+        (None on a miss). The ``corrupt_adopt`` chaos knob flips a
+        token AFTER the checksum is stamped — the importer's verify
+        must catch it."""
+        if self.prefix_cache is None:
+            return None
+        key, blocks = self.prefix_cache.lookup(tokens)
+        if key is None:
+            return None
+        export = self._make_prefix_export(key, blocks)
+        inj = get_fault_injector()
+        if inj is not None and inj.on_prefix_export():
+            export.tokens = ((export.tokens[0] ^ 0x1,) + export.tokens[1:])
+        return export
+
+    def import_prefix(self, export) -> bool:
+        """Importer side: checksum FIRST (invariant #19), geometry,
+        capacity, publish — identical discipline to the ragged engine,
+        with the same ``_kvtier_skip_verify`` planted-bug seam."""
+        from ..serving.kvtier import CorruptExport
+
+        if self.prefix_cache is None:
+            raise ValueError("prefix cache disabled; nothing to adopt into")
+        cfg = self.config
+        if not export.verify():
+            if not getattr(self, "_kvtier_skip_verify", False):
+                raise CorruptExport(
+                    "prefix export failed checksum verification "
+                    "(corrupted in transit)")
+            self.kvtier_corrupt_landed += 1
+        if export.geometry() != self._sim_geometry():
+            raise ValueError(
+                f"prefix KV geometry mismatch: engine "
+                f"{self._sim_geometry()} vs export {export.geometry()}")
+        need = export.n_pages
+        if need <= 0 or need != len(export.tokens) // cfg.kv_block_size \
+                or len(export.tokens) % cfg.kv_block_size:
+            raise ValueError(
+                f"prefix export carries {need} pages for "
+                f"{len(export.tokens)} tokens (full blocks required)")
+        if len(export.tokens) > cfg.max_context:
+            raise ValueError("prefix length exceeds max_context")
+        if tuple(export.tokens) in self.prefix_cache._entries:
+            return False
+        if need > self.allocator.free_blocks:
+            self.prefix_cache.evict_for(self.allocator, need)
+        blocks = self.allocator.allocate(need)    # may raise PoolExhausted
+        self.prefix_cache.publish(list(export.tokens), blocks,
+                                  len(export.tokens), self.allocator)
+        self.allocator.release(blocks)
+        self.kvtier_adopt_imports += 1
+        return True
+
+    def _cold_readmit(self, tokens: Sequence[int]) -> None:
+        bs = self.config.kv_block_size
+        for k in range((len(tokens) - 1) // bs, 0, -1):
+            key = tuple(int(t) for t in tokens[:k * bs])
+            if key in self.prefix_cache._entries:
+                return
+            export = self._cold_tier.get(key)
+            if export is None:
+                continue
+            try:
+                if self.import_prefix(export):
+                    self.kvtier_cold_readmits += 1
+            except (ValueError, RuntimeError):
+                pass
+            return
+
     # -- the step --------------------------------------------------------
     def _admit_tokens(self, uids: Sequence[int],
                       tokens: Sequence[Sequence[int]]) -> None:
@@ -340,6 +469,11 @@ class SimEngine:
             if new:
                 seq.prompt_len = len(seq.tokens)
                 if self.prefix_cache is not None and seq.tokens:
+                    if self._cold_tier is not None:
+                        # re-admission BEFORE the match: a spilled prefix
+                        # comes back through the checksummed import path
+                        # and the match below finds it like a local one
+                        self._cold_readmit(seq.tokens)
                     shared, blocks = self.prefix_cache.match(seq.tokens)
                     if shared:
                         self.allocator.retain(blocks)
@@ -742,6 +876,70 @@ def generate_schedule(seed: int) -> Schedule:
         events.append(SimEvent(
             t=round(rng.uniform(0.0, horizon * 0.4), 3),
             kind="flaky_import", payload={"every": rng.choice([2, 3])}))
+    # global KV tier draws (serving/kvtier.py; docs/dst.md #17-#19) —
+    # appended at the very end, same regression-corpus rationale: the
+    # directory, residency routing, cross-replica adoption and the cold
+    # tier run with their three fault kinds (stale directory entries,
+    # adoption-wire corruption, cold-tier pressure drops). Independent
+    # of every earlier draw, so old seeds replay bit-identically with
+    # the tier off.
+    if rng.random() < 0.45:
+        serving_cfg["kv_tier"] = {
+            "enabled": True,
+            "publish_interval_s": rng.choice([0.5, 1.0]),
+            "directory_staleness_s": rng.choice([3.0, 6.0]),
+            "adoption": rng.random() < 0.8,
+            "cold_tier": rng.random() < 0.8,
+            "cold_capacity_pages": rng.choice([16, 64, 128]),
+        }
+        # a tiered seed must actually EXERCISE the tier: residency
+        # routing needs a prefix router and a second replica, adoption
+        # needs concurrent same-prefix load spilling off the affinity
+        # pick, and cold spill/readmit needs pool pressure. Tiered
+        # seeds are new schedules, so reshaping them here does not
+        # perturb the pre-existing corpus.
+        fleet_cfg["router"] = rng.choice(["prefix_affinity", "residency"])
+        if not fleet_cfg.get("disaggregated"):
+            fleet_cfg["replicas"] = max(fleet_cfg["replicas"], 2)
+        engine_cfg["n_kv_blocks"] = rng.choice([20, 28, 40])
+        # stragglers land deep in the run, AFTER pressure evictions
+        # spilled the shared prefixes — the cold-readmit path's
+        # trigger. The tail of the burst REPEATS earlier burst prompts
+        # verbatim: a repeat's block-aligned prefix keys are exactly
+        # the keys the earlier request's cache levels spilled under
+        # pressure, so the repeat rides cold re-admission (or the
+        # device cache, when the level survived) instead of a cold
+        # re-prefill.
+        burst_prompts: List[List[int]] = []
+        for j in range(rng.randint(4, 8)):
+            if burst_prompts and rng.random() < 0.4:
+                prompt = list(rng.choice(burst_prompts))
+            else:
+                prompt = list(rng.choice(prefixes)) + [
+                    rng.randrange(1, vocab)
+                    for _ in range(rng.randint(1, 3))]
+                burst_prompts.append(prompt)
+            events.append(SimEvent(
+                t=round(rng.uniform(horizon * 0.1, horizon * 0.95), 3),
+                kind="submit",
+                payload={"ix": n_req + j, "prompt": prompt,
+                         "max_new": rng.randint(1, 8),
+                         "priority": rng.randint(0, 2)}))
+        if rng.random() < 0.5:
+            events.append(SimEvent(
+                t=round(rng.uniform(1.0, horizon * 0.6), 3),
+                kind="stale_directory",
+                payload={"every": rng.choice([2, 3])}))
+        if rng.random() < 0.5:
+            events.append(SimEvent(
+                t=round(rng.uniform(1.0, horizon * 0.6), 3),
+                kind="corrupt_adopt",
+                payload={"every": rng.choice([1, 2])}))
+        if rng.random() < 0.4:
+            events.append(SimEvent(
+                t=round(rng.uniform(1.0, horizon * 0.6), 3),
+                kind="cold_pressure",
+                payload={"every": rng.choice([2, 3])}))
     return Schedule(seed=seed, horizon=horizon, engine_cfg=engine_cfg,
                     fleet_cfg=fleet_cfg, serving_cfg=serving_cfg,
                     events=events)
@@ -973,6 +1171,58 @@ def generate_region_schedule(seed: int) -> RegionSchedule:
         events.append(SimEvent(
             t=round(rng.uniform(0.0, horizon * 0.4), 3),
             kind="flaky_import", payload={"every": rng.choice([2, 3])}))
+    # global KV tier draws — appended at the very end (see
+    # generate_schedule); at region scale the tier additionally
+    # composes with cell outages/partitions (whole-member directory
+    # drops) and the cell-residency routing preference
+    if rng.random() < 0.40:
+        serving_cfg["kv_tier"] = {
+            "enabled": True,
+            "publish_interval_s": rng.choice([0.5, 1.0]),
+            "directory_staleness_s": rng.choice([3.0, 6.0]),
+            "adoption": rng.random() < 0.8,
+            "cold_tier": rng.random() < 0.8,
+            "cold_capacity_pages": rng.choice([16, 64, 128]),
+        }
+        # same reshaping as the fleet tier: tiered region seeds get a
+        # prefix router, a second replica per cell, pool pressure, and
+        # a shared-prefix burst so the directory/adoption/cold paths
+        # run hot (tiered seeds are new schedules — no corpus impact)
+        fleet_cfg["router"] = rng.choice(["prefix_affinity", "residency"])
+        if not fleet_cfg.get("disaggregated"):
+            fleet_cfg["replicas"] = max(fleet_cfg["replicas"], 2)
+        engine_cfg["n_kv_blocks"] = rng.choice([20, 28, 40])
+        burst_prompts: List[List[int]] = []
+        for _ in range(rng.randint(4, 8)):
+            if burst_prompts and rng.random() < 0.4:
+                prompt = list(rng.choice(burst_prompts))
+            else:
+                prompt = list(rng.choice(prefixes)) + [
+                    rng.randrange(1, vocab)
+                    for _ in range(rng.randint(1, 3))]
+                burst_prompts.append(prompt)
+            events.append(SimEvent(
+                t=round(rng.uniform(horizon * 0.1, horizon * 0.95), 3),
+                kind="submit",
+                payload={"ix": ix, "prompt": prompt,
+                         "max_new": rng.randint(1, 8),
+                         "priority": rng.randint(0, 2)}))
+            ix += 1
+        if rng.random() < 0.5:
+            events.append(SimEvent(
+                t=round(rng.uniform(1.0, horizon * 0.6), 3),
+                kind="stale_directory",
+                payload={"every": rng.choice([2, 3])}))
+        if rng.random() < 0.5:
+            events.append(SimEvent(
+                t=round(rng.uniform(1.0, horizon * 0.6), 3),
+                kind="corrupt_adopt",
+                payload={"every": rng.choice([1, 2])}))
+        if rng.random() < 0.4:
+            events.append(SimEvent(
+                t=round(rng.uniform(1.0, horizon * 0.6), 3),
+                kind="cold_pressure",
+                payload={"every": rng.choice([2, 3])}))
     return RegionSchedule(seed=seed, horizon=horizon,
                           engine_cfg=engine_cfg, fleet_cfg=fleet_cfg,
                           serving_cfg=serving_cfg, region_cfg=region_cfg,
@@ -1302,6 +1552,7 @@ class InvariantAuditor:
                         self.tracer.spans_for_trace(root.trace_id)):
                     v.append(f"[trace-tree] r{t.ix}: {p}")
         v.extend(self._audit_gray(pairs, span_count, now))
+        v.extend(self._audit_kvtier())
         return v
 
     def _audit_gray(self, pairs, span_count: Dict[int, int],
@@ -1421,6 +1672,85 @@ class InvariantAuditor:
                                  f"{FLAP_WINDOW_S:.0f} virtual seconds "
                                  f"— hysteresis is not bounding churn")
                         break
+        return v
+
+    def _audit_kvtier(self) -> List[str]:
+        """The global KV tier's invariants (docs/dst.md):
+
+        * **#17 directory-residency containment** — a directory entry
+          never outlives its pages: every (member, hash) entry names a
+          LIVE (non-DEAD) replica whose prefix cache currently holds
+          that full-block prefix. The only exemption is a hash the
+          fault injector itself planted (``stale_directory`` lies) —
+          those must age out via the staleness bound, never be trusted,
+          and are bookkept in ``injector.injected_stale``.
+        * **#18 cold-tier accounting + integrity** — the host cold
+          tier's page accounting is exact (``used == sum(entries)``,
+          ``used <= capacity``) and every resident export still passes
+          its checksum (spills gather from live pages, so a cold entry
+          that fails verify() was corrupted INSIDE the tier).
+        * **#19 corruption never lands** — a prefix export that fails
+          checksum verification is NEVER imported into a device pool:
+          ``kvtier_corrupt_landed`` stays zero on every engine (the
+          ``corrupt_adopt`` fault kind feeds the wire-corruption side;
+          the ``_kvtier_skip_verify`` seam is the planted-bug tooth).
+        """
+        from ..serving.fleet import ReplicaState
+
+        v: List[str] = []
+        injected = (self.injector.injected_stale_snapshot()
+                    if self.injector is not None else set())
+        for fi, fleet in enumerate(self._fleets()):
+            tier = getattr(fleet, "kv_tier", None)
+            if tier is None:
+                continue
+            ftag = fleet.name or f"fleet{fi}"
+            reps = {r.name: r for r in fleet.replicas}
+            # 17. directory-residency containment
+            for member in tier.directory.members():
+                rep = reps.get(member)
+                if rep is None or rep.state is ReplicaState.DEAD:
+                    v.append(f"[kv-directory] {ftag}: entries for "
+                             f"{'unknown' if rep is None else 'dead'} "
+                             f"member {member} — the entries outlived "
+                             f"their replica")
+                    continue
+                resident = set(rep.engine.prefix_residency_hashes()) \
+                    if hasattr(rep.engine, "prefix_residency_hashes") \
+                    else set()
+                for h in tier.directory.entries_for(member):
+                    if h not in resident and (member, h) not in injected:
+                        v.append(f"[kv-directory] {ftag}/{member}: entry "
+                                 f"{h:#018x} not resident in the "
+                                 f"member's prefix cache — the entry "
+                                 f"outlived its pages")
+            # 18. cold-tier accounting + integrity
+            cold = tier.cold
+            if cold is not None:
+                pages = cold.entry_pages()
+                used = cold.used_pages
+                if used != sum(pages):
+                    v.append(f"[kv-cold] {ftag}: used_pages {used} != "
+                             f"sum of entries {sum(pages)} — page "
+                             f"accounting drifted")
+                if used > cold.capacity_pages:
+                    v.append(f"[kv-cold] {ftag}: used_pages {used} over "
+                             f"capacity {cold.capacity_pages} — LRU "
+                             f"pressure valve failed")
+                for e in cold.entries_snapshot():
+                    if not e.verify():
+                        v.append(f"[kv-cold] {ftag}: entry for "
+                                 f"{len(e.tokens)}-token prefix fails "
+                                 f"checksum — corrupted inside the "
+                                 f"cold tier")
+        # 19. corruption never lands (all replicas, dead included — a
+        # corrupt import that landed before the kill still landed)
+        for rep in self._replicas():
+            landed = getattr(rep.engine, "kvtier_corrupt_landed", 0)
+            if landed:
+                v.append(f"[kv-adopt] {rep.name}: {landed} corrupt "
+                         f"prefix export(s) imported into the device "
+                         f"pool — verify-before-import is breached")
         return v
 
     def _expected_stream(self, req, n: int) -> List[int]:
@@ -1883,6 +2213,12 @@ def _apply_event(fleet, ev: SimEvent, tracked: List[_Tracked], guard,
             injector.arm_stall_burst(name, int(p.get("n", 1)))
     elif ev.kind == "flaky_import":
         injector.flaky_import_every = int(p.get("every", 0))
+    elif ev.kind == "stale_directory":
+        injector.stale_directory_every = int(p.get("every", 0))
+    elif ev.kind == "corrupt_adopt":
+        injector.corrupt_adopt_every = int(p.get("every", 0))
+    elif ev.kind == "cold_pressure":
+        injector.cold_pressure_every = int(p.get("every", 0))
     else:
         raise ValueError(f"unknown simulation event kind '{ev.kind}'")
 
@@ -2106,6 +2442,12 @@ def _apply_region_event(region, ev: SimEvent, tracked: List[_Tracked],
                     injector.arm_stall_burst(name, int(p.get("n", 1)))
     elif ev.kind == "flaky_import":
         injector.flaky_import_every = int(p.get("every", 0))
+    elif ev.kind == "stale_directory":
+        injector.stale_directory_every = int(p.get("every", 0))
+    elif ev.kind == "corrupt_adopt":
+        injector.corrupt_adopt_every = int(p.get("every", 0))
+    elif ev.kind == "cold_pressure":
+        injector.cold_pressure_every = int(p.get("every", 0))
     else:
         raise ValueError(f"unknown region simulation event '{ev.kind}'")
 

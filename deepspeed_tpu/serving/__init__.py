@@ -31,6 +31,14 @@ from .cell import (  # noqa: F401
     check_reachable,
 )
 from .fleet import Replica, ReplicaState, ServingFleet  # noqa: F401
+from .kvtier import (  # noqa: F401
+    ColdTier,
+    CorruptExport,
+    KVTier,
+    PrefixDirectory,
+    PrefixExport,
+    prefix_hash,
+)
 from .region import Region  # noqa: F401
 from .rollout import (  # noqa: F401
     RolloutController,
@@ -47,6 +55,7 @@ from .router import (  # noqa: F401
     LeastLoadedRouter,
     NoHealthyReplica,
     PrefixAffinityRouter,
+    ResidencyAwareRouter,
     RouterPolicy,
     make_router,
     prefix_key,

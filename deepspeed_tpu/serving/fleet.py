@@ -61,8 +61,9 @@ from ..utils.logging import log_dist, logger
 from .health import (BreakerState, CircuitBreaker, HealthState, HedgePair,
                      ReplicaHealth)
 from .request import Request, RequestState
-from .router import (NoHealthyReplica, PrefixAffinityRouter, RouterPolicy,
-                     _hash64, least_loaded_pick, make_router)
+from .router import (NoHealthyReplica, PrefixAffinityRouter,
+                     ResidencyAwareRouter, RouterPolicy, _hash64,
+                     least_loaded_pick, make_router, prefix_key)
 from .server import ServingEngine, stream_tokens
 
 
@@ -243,6 +244,30 @@ class ServingFleet:
                 config.router, block_size=self._probe_block_size(),
                 vnodes=config.affinity_vnodes,
                 spill_load=config.affinity_spill_load)
+        # global KV tier (docs/serving.md "Global KV tier"): one prefix
+        # directory (+ optional fleet-wide host cold tier) shared by
+        # every replica; built BEFORE the spawn loop so replicas wire
+        # their eviction/spill hooks at construction. With the tier on,
+        # an affinity router is upgraded in place to the residency-aware
+        # subclass — same ring, same spill valve, directory consulted
+        # first — and an explicitly "residency"-configured (or injected
+        # residency-aware) router just gets the directory attached.
+        self.kv_tier = None
+        kv_cfg = getattr(serving_config, "kv_tier", None)
+        if kv_cfg is not None and kv_cfg.enabled:
+            from .kvtier import KVTier
+
+            self.kv_tier = KVTier(kv_cfg)
+            if isinstance(self.router, ResidencyAwareRouter):
+                self.router.set_directory(self.kv_tier.directory,
+                                          self._clock.now)
+            elif isinstance(self.router, PrefixAffinityRouter):
+                self.router = ResidencyAwareRouter(
+                    block_size=self.router.block_size,
+                    vnodes=self.router.vnodes,
+                    spill_load=self.router.spill_load,
+                    directory=self.kv_tier.directory,
+                    now_fn=self._clock.now)
         if config.disaggregated:
             for _ in range(config.prefill_replicas):
                 self._spawn(role="prefill")
@@ -266,7 +291,7 @@ class ServingFleet:
         # replicas share one config, so any instance answers. No replica
         # exists yet at router-construction time, so build one eagerly
         # only when the router actually needs the block size.
-        if self.config.router != "prefix_affinity":
+        if self.config.router not in ("prefix_affinity", "residency"):
             return 16
         eng = self._factory()
         with self._lock:
@@ -326,6 +351,8 @@ class ServingFleet:
         # respawn or migration replacement must not resurrect the config
         # default and silently widen (or shrink) the canary
         serving.model_version = fleet_version
+        if self.kv_tier is not None:
+            serving.enable_kv_tier(self.kv_tier, name)
         rep = Replica(name, engine, serving, role=role)
         with self._lock:
             self._replicas[name] = rep
@@ -602,6 +629,22 @@ class ServingFleet:
                         self._count("affinity_hits"
                                     if self.router.last_was_primary
                                     else "affinity_misses")
+                    if isinstance(self.router, ResidencyAwareRouter) \
+                            and self.router.last_outcome is not None:
+                        # per-outcome routing ledger (docs/serving.md
+                        # "Global KV tier" fallback matrix): registry
+                        # counters are the operator surface, the digest
+                        # copy rides the fleet→cell→region rollup so the
+                        # region can report global-vs-local hit rates
+                        outcome = {"residency": "residency_hit",
+                                   "affinity": "affinity_hit",
+                                   "directory_stale": "directory_stale"}[
+                                       self.router.last_outcome]
+                        t = self._telemetry
+                        if t.enabled:
+                            t.registry.counter(
+                                f"serving/route/{outcome}").inc()
+                        self.telemetry_source.count(f"route/{outcome}")
                     # router verdict captured under the lock (router
                     # state mutates per route()); the span finishes only
                     # after the enqueue, so a refused pick is marked as
@@ -627,6 +670,8 @@ class ServingFleet:
                                accepted=accepted, **route_info)
             if accepted:
                 self._count("routed")
+                if self.kv_tier is not None:
+                    self._maybe_adopt_prefix(req, name)
                 return True
             refused.add(name)      # stopped mid-race: try the next one
             self._breaker_event(name, ok=False)
@@ -683,6 +728,68 @@ class ServingFleet:
                     f"{req.uid}")
         self._reject(req, reason)
         return False
+
+    # -- global KV tier (docs/serving.md "Global KV tier") ---------------
+    def _maybe_adopt_prefix(self, req: Request, target: str) -> None:
+        """Best-effort cross-replica prefix prefetch, fired AFTER the
+        request was accepted (never on its critical path): when the
+        directory says a DIFFERENT healthy replica holds the prompt's
+        full-block prefix, pen a prefix export on that donor; its driver
+        gathers the quantized pages outside its lock and the on_ready
+        callback pens the import on the target's driver. Every leg is
+        droppable — a dead donor, refused pen, failed gather, corrupt
+        wire or full pool all end in the target prefilling locally.
+        Runs OUTSIDE the fleet lock (takes it briefly for the replica
+        lookup); the donor's driver later runs on_ready, which only
+        touches the target's own pen lock."""
+        tier = self.kv_tier
+        if tier is None or not tier.config.adoption:
+            return
+        router = self.router
+        if not isinstance(router, ResidencyAwareRouter):
+            return
+        if router.last_outcome == "residency":
+            return                 # the target already holds the prefix
+        key = prefix_key(req.prompt, router.block_size)
+        if len(key) < router.block_size:
+            return                 # nothing a prefix cache could hold
+        fresh, _ = tier.directory.holders(_hash64(",".join(map(str, key))),
+                                          self._clock.now())
+        donor_serving = target_serving = None
+        with self._lock:
+            tgt = self._replicas.get(target)
+            if tgt is not None and tgt.state != ReplicaState.DEAD:
+                target_serving = tgt.serving
+            for m in fresh:
+                if m == target:
+                    continue
+                rep = self._replicas.get(m)
+                if rep is not None and rep.state == ReplicaState.HEALTHY:
+                    donor_serving = rep.serving
+                    break
+        if donor_serving is None or target_serving is None:
+            return
+
+        def _on_ready(export, _t=target_serving):
+            if export is None:
+                return             # donor evicted it meanwhile: plain miss
+            _t.adopt_prefix(export)
+
+        if donor_serving.request_prefix_export(list(key), _on_ready):
+            self._count("adopt_prefetches")
+            self.telemetry_source.count("kvtier/adopt_requested")
+
+    def _kvtier_drop(self, name: str) -> None:
+        """Directory scrub at the replica-death/retire boundary: the
+        member's entries must never outlive its pages (DST invariant
+        #17). Idempotent; the directory lock is a leaf, so this is legal
+        under the fleet lock."""
+        if self.kv_tier is not None:
+            # call through .directory (not KVTier.drop_member): the
+            # static race/lock analyzer resolves this receiver chain,
+            # so the fleet->directory leaf edge lands in the lock graph
+            # the runtime sanitizer cross-validates against
+            self.kv_tier.directory.drop_member(name)
 
     def stream(self, prompt: Sequence[int], **kwargs):
         """Generator yielding tokens as they are emitted (see
@@ -1057,6 +1164,7 @@ class ServingFleet:
                 if rep.state != ReplicaState.DEAD:
                     rep.state = ReplicaState.DEAD
                     self.router.on_leave(rep.name)
+                    self._kvtier_drop(rep.name)
         self._stop_evt.set()
         if self._monitor is not None:
             self._monitor.join(timeout=5.0)
@@ -1104,6 +1212,7 @@ class ServingFleet:
                 return False
             rep.state = ReplicaState.DEAD
             self.router.on_leave(name)
+            self._kvtier_drop(name)
         logger.warning(f"ServingFleet: replica {name} died ({reason})")
         rep.serving.kill()
         orphans = rep.serving.evacuate()
@@ -1146,6 +1255,7 @@ class ServingFleet:
         replacement.serving.model_version = version
         with self._lock:
             victim.state = ReplicaState.DEAD
+            self._kvtier_drop(name)
         victim.serving.kill()
         queued, exports = victim.serving.migrate_out()
         self._count("migrations")
@@ -1232,6 +1342,7 @@ class ServingFleet:
         self._check_gray()
         self._check_hedges()
         self._resolve_hedges()
+        self._publish_residency()
         if self.config.autoscale:
             from ..resilience.chaos import get_fault_injector
 
@@ -1255,6 +1366,37 @@ class ServingFleet:
                 self.autoscale_once()
         self._flush_shed()
         self._update_gauges()
+
+    def _publish_residency(self) -> None:
+        """Push every live replica's last residency snapshot into the
+        prefix directory (docs/serving.md "Global KV tier"). Rides the
+        existing monitor cadence — no extra thread, no extra wakeups —
+        and stamps entries with the snapshot's CAPTURE time, so a
+        replica whose driver stopped snapshotting ages past the
+        staleness bound instead of looking perpetually fresh. The
+        ``stale_directory`` chaos knob injects a deterministic bogus
+        hash here (recorded in the injector's ground-truth ledger, so
+        the DST auditor can tell an injected lie from a real leak)."""
+        tier = self.kv_tier
+        if tier is None:
+            return
+        from ..resilience.chaos import get_fault_injector
+
+        inj = get_fault_injector()
+        with self._lock:
+            live = [(r.name, r.serving)
+                    for r in self._replicas.values()
+                    if r.state != ReplicaState.DEAD]
+        for name, serving in live:
+            snap = serving.residency_snapshot()
+            if snap is None:
+                continue
+            hashes, t = snap
+            if inj is not None:
+                bogus = inj.on_directory_publish(name)
+                if bogus is not None:
+                    hashes = list(hashes) + [bogus]
+            tier.directory.publish(name, hashes, t)
 
     def _monitor_loop(self) -> None:
         while not self._clock.wait_event(self._stop_evt,
@@ -1673,6 +1815,7 @@ class ServingFleet:
                        if r.state == ReplicaState.DRAINING and r.load == 0]
             for r in drained:
                 r.state = ReplicaState.DEAD
+                self._kvtier_drop(r.name)
         for r in drained:
             r.serving.close(timeout=5.0)
             # a continuation enqueued in the window between the DEAD flip

@@ -363,9 +363,47 @@ class Region:
             if digests[alt].load_per_replica \
                     < digests[chosen].load_per_replica:
                 chosen = alt
+        # global KV tier, cell tier (docs/serving.md "Global KV tier"):
+        # when the walk's choice holds no fresh residency for this
+        # prefix but another eligible cell's fleet directory does,
+        # prefer that cell. An O(cells) leaf-lock peek — the same
+        # accounting unit as the walk and the spill scan — and purely
+        # advisory: a lying directory just lands the request on a cell
+        # that prefills locally.
+        tiered = any(getattr(c.fleet, "kv_tier", None) is not None
+                     for c in self._cells.values())
+        if (tiered and chosen is not None
+                and not self._cell_has_residency(chosen, h)):
+            for name in sorted(self._cells):
+                if name == chosen:
+                    continue
+                d = digests.get(name)
+                if d is None:
+                    d = self._cell_eligible(name, refused, work)
+                    if d is None:
+                        continue
+                    digests[name] = d
+                if self._cell_has_residency(name, h):
+                    chosen = name
+                    self._count("cell_residency_hits")
+                    break
         self.route_work_last = work[0]
         self.route_work_total += work[0]
         return chosen
+
+    def _cell_has_residency(self, name: str, h: int) -> bool:
+        """True when ``name``'s fleet runs the global KV tier AND its
+        prefix directory holds a bounded-staleness-fresh entry for the
+        prompt's prefix hash (cells publish replica residency in the
+        same hash space the rings walk). The directory lock is a LEAF,
+        so this peek is legal under the region lock."""
+        cell = self._cells.get(name)
+        if cell is None:
+            return False
+        tier = getattr(cell.fleet, "kv_tier", None)
+        if tier is None:
+            return False
+        return tier.directory.has_fresh(h, self._clock.now())
 
     def _route_request(self, req: Request, requeue: bool = False) -> bool:
         """Tier-one placement loop. New work passes the brownout gate;
@@ -760,6 +798,23 @@ class Region:
                     r.gauge(
                         f"serving/region/slo/{tenant}/attainment"
                     ).set(ratio)
+            # global-vs-local prefix hit rate (docs/serving.md "Global
+            # KV tier"): the per-outcome routing counters ride the
+            # fleet→cell→region digests absorbed above, so the region
+            # can report what share of prefix-routable work landed on a
+            # directory-confirmed holder vs the plain affinity ring
+            res = self._tel_rollup.counter("route/residency_hit")
+            aff = self._tel_rollup.counter("route/affinity_hit")
+            stale = self._tel_rollup.counter("route/directory_stale")
+            routed = res + aff + stale
+            if routed > 0:
+                r.gauge("serving/region/kvtier/global_hit_share").set(
+                    res / routed)
+                r.gauge("serving/region/kvtier/directory_stale_share").set(
+                    stale / routed)
+            cold = self._tel_rollup.counter("route/cold_readmit")
+            if cold > 0:
+                r.gauge("serving/region/kvtier/cold_readmits").set(cold)
 
     def _emit_slo_alerts(self, transitions: List[Dict[str, Any]]) -> None:
         """Mirror SLO alert transitions into the registry and flight

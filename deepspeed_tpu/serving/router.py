@@ -260,6 +260,75 @@ class PrefixAffinityRouter(RouterPolicy):
                 "spilled": self.last_spilled}
 
 
+class ResidencyAwareRouter(PrefixAffinityRouter):
+    """Prefix-affinity routing that consults the global KV tier's
+    :class:`~.kvtier.PrefixDirectory` FIRST (docs/serving.md "Global KV
+    tier"): when a bounded-staleness-fresh directory entry says some
+    healthy replica already holds the prompt's full-block prefix, the
+    request routes to the least-loaded such holder — *residency* beats
+    pure hash affinity, because the pages are where they are, not where
+    the ring says they should be (failover, spills and adoption all move
+    pages off the ring owner). The fallback matrix:
+
+    * fresh holder in the health view  -> residency pick
+    * entries exist but all stale      -> affinity ring (outcome
+      ``directory_stale`` — the directory lied or lagged; the ring is
+      never wrong about *where to build* the prefix, only about where it
+      already exists)
+    * no entry / no healthy holder     -> affinity ring (plain miss)
+    * residency pick over ``spill_load`` while someone idles -> affinity
+      ring path with its spill valve (residency is a throughput
+      optimisation, not a hostage situation — same rule as affinity)
+
+    The directory is attached after construction (``set_directory``) —
+    the fleet builds it only when ``serving.kv_tier.enabled``; without
+    one this router IS a ``PrefixAffinityRouter``, bit-for-bit."""
+
+    name = "residency"
+
+    def __init__(self, block_size: int, vnodes: int = 64,
+                 spill_load: int = 0, directory=None, now_fn=None):
+        super().__init__(block_size=block_size, vnodes=vnodes,
+                         spill_load=spill_load)
+        self.directory = directory
+        self.now_fn = now_fn if now_fn is not None else (lambda: 0.0)
+        # set by route(): "residency" | "affinity" | "directory_stale"
+        self.last_outcome: Optional[str] = None
+
+    def set_directory(self, directory, now_fn) -> None:
+        self.directory = directory
+        self.now_fn = now_fn
+
+    def route(self, replicas: Dict[str, float],
+              prompt: Sequence[int]) -> str:
+        if not replicas:
+            raise NoHealthyReplica("no healthy replica to route to")
+        stale_only = False
+        if self.directory is not None:
+            h = self._hash_for(prompt)
+            fresh, stale_only = self.directory.holders(h, self.now_fn())
+            eligible = [m for m in fresh if m in replicas]
+            if eligible:
+                chosen = min(eligible, key=lambda n: (replicas[n], n))
+                over = (self.spill_load > 0
+                        and replicas[chosen] >= self.spill_load
+                        and min(replicas.values()) < replicas[chosen])
+                if not over:
+                    self.last_spilled = False
+                    self.last_was_primary = \
+                        (chosen == self.owner_from_hash(h))
+                    self.last_outcome = "residency"
+                    return chosen
+        chosen = super().route(replicas, prompt)
+        self.last_outcome = "directory_stale" if stale_only else "affinity"
+        return chosen
+
+    def route_info(self) -> Dict[str, Any]:
+        info = super().route_info()
+        info["outcome"] = self.last_outcome
+        return info
+
+
 def make_router(name: str, *, block_size: int = 16, vnodes: int = 64,
                 spill_load: int = 0) -> RouterPolicy:
     """Router factory for config-driven selection."""
@@ -268,5 +337,8 @@ def make_router(name: str, *, block_size: int = 16, vnodes: int = 64,
     if name == "prefix_affinity":
         return PrefixAffinityRouter(block_size=block_size, vnodes=vnodes,
                                     spill_load=spill_load)
-    raise ValueError(f"unknown router '{name}' "
-                     "(expected 'least_loaded' or 'prefix_affinity')")
+    if name == "residency":
+        return ResidencyAwareRouter(block_size=block_size, vnodes=vnodes,
+                                    spill_load=spill_load)
+    raise ValueError(f"unknown router '{name}' (expected 'least_loaded', "
+                     "'prefix_affinity' or 'residency')")

@@ -257,6 +257,18 @@ class ServingEngine:
         self._adoptions: List[tuple] = []        # (req, KVExport) to import
         self._handoff_backlog: List[tuple] = []  # (req, KVExport) to ship
         self._handoffs_in_flight = 0             # popped, export not done
+        # global KV tier pens (docs/serving.md "Global KV tier"). Unlike
+        # _adoptions these hold NO requests and no allocator refs —
+        # adoption is best-effort prefetch, never owed work — so they are
+        # excluded from pending_work/_idle_locked and dropping them at
+        # kill/close is free. Processed on the driver thread only.
+        self._prefix_export_requests: List[tuple] = []  # (tokens, on_ready)
+        self._prefix_adoptions: List[Any] = []          # PrefixExport
+        self._kv_tier = None                     # fleet's KVTier (or None)
+        self._kv_member = ""                     # our name in the directory
+        self._residency: Optional[tuple] = None  # (hashes, t_captured)
+        self._last_residency_pub = float("-inf")
+        self._cold_readmits_seen = 0
         self._last_gauges: Optional[tuple] = None
         self._stop_evt = threading.Event()
         self._tick_count = 0
@@ -457,6 +469,74 @@ class ServingEngine:
             self._adoptions.append((req, kv_export))
         return True
 
+    # -- global KV tier surface (docs/serving.md "Global KV tier") -------
+    def enable_kv_tier(self, tier, member: str) -> None:
+        """Attach this replica to the fleet's :class:`KVTier`: engine
+        hooks (cold-tier spill + synchronous directory invalidation on
+        eviction) plus the residency-publish cadence state. Called by
+        the fleet at spawn, before traffic routes here; the directory
+        invalidate closure takes only the directory's LEAF lock, so it
+        is legal from the eviction path under the engine's own locks."""
+        eng = self._engine
+        if not hasattr(eng, "enable_kv_tier"):
+            return
+        with self._lock:
+            self._kv_tier = tier
+            self._kv_member = member
+        eng.enable_kv_tier(
+            member=member,
+            cold_tier=tier.cold,
+            on_invalidate=self._kvtier_invalidate)
+
+    def _kvtier_invalidate(self, h: int) -> None:
+        """Eviction hook: remove the hash from the directory AND from
+        the pending residency snapshot. The second half closes a
+        publish race — the fleet's poll republishes the snapshot
+        captured at the last publish tick, and without the scrub an
+        eviction landing between capture and publish would resurrect
+        the entry after its pages were freed (the exact
+        entry-outlives-pages shape invariant #17 hunts)."""
+        with self._lock:
+            tier, member = self._kv_tier, self._kv_member
+            if self._residency is not None and h in self._residency[0]:
+                hashes, t = self._residency
+                self._residency = ([x for x in hashes if x != h], t)
+        if tier is not None:
+            tier.directory.invalidate(member, h)
+
+    def request_prefix_export(self, tokens, on_ready) -> bool:
+        """Donor-side adoption pen: the DRIVER pops this at its next
+        tick and runs the engine's prefix gather OUTSIDE the serving
+        lock, then calls ``on_ready(export_or_None)`` (also outside the
+        lock, donor driver thread). Best-effort: a killed/closed driver
+        refuses (False) and a dropped pen simply never fires on_ready —
+        the importer side prefills locally, degraded but never lost."""
+        with self._lock:
+            if self._stop_evt.is_set() or self._kv_tier is None:
+                return False
+            self._prefix_export_requests.append((list(tokens), on_ready))
+        return True
+
+    def adopt_prefix(self, export) -> bool:
+        """Importer-side adoption pen: the driver verifies the export's
+        checksum and imports it into the prefix cache at its next tick
+        (engine state is driver-thread-confined, same rule as
+        :meth:`adopt`). Holds no request and no pool references."""
+        with self._lock:
+            if self._stop_evt.is_set() or self._kv_tier is None:
+                return False
+            self._prefix_adoptions.append(export)
+        return True
+
+    def residency_snapshot(self) -> Optional[tuple]:
+        """(prefix hashes, t_captured) from the driver's last publish
+        tick, or None before the first one. The fleet's poll stamps the
+        directory with t_captured — NOT poll time — so a wedged driver's
+        entries age past the staleness bound instead of being kept
+        artificially fresh."""
+        with self._lock:
+            return self._residency
+
     def stop_admission(self) -> None:
         """Close the front door (submissions reject) without touching the
         backlog — the graceful scale-down shape: the fleet stops routing
@@ -592,6 +672,9 @@ class ServingEngine:
             self._live.clear()
             self._adoptions.clear()
             self._handoff_backlog.clear()
+            # kv-tier pens hold no requests/refs: drop, never migrate
+            self._prefix_export_requests.clear()
+            self._prefix_adoptions.clear()
             self._requests.clear()
             for req in queued:
                 self._engine.clear_resume(req.uid)
@@ -639,6 +722,8 @@ class ServingEngine:
             self._live.clear()
             self._adoptions.clear()
             self._handoff_backlog.clear()
+            self._prefix_export_requests.clear()
+            self._prefix_adoptions.clear()
             self._requests.clear()
             for req in orphans:
                 # these uids never come back to THIS engine
@@ -1008,6 +1093,7 @@ class ServingEngine:
         if self._maybe_degrade_tick():
             return True
         self._import_adoptions()
+        self._service_kv_tier()
         with self._lock:
             self._process_cancellations()
             capacity = self._admit()
@@ -1135,6 +1221,92 @@ class ServingEngine:
         if deferred:
             with self._lock:
                 self._adoptions.extend(deferred)
+
+    def _service_kv_tier(self) -> None:
+        """Drain the global-KV-tier pens and refresh the residency
+        snapshot (driver thread only — the engine's pool and prefix
+        cache are single-writer). All engine work runs OUTSIDE the
+        serving lock: a prefix gather/scatter is a multi-page copy and
+        the lock guards only request structures. Failures here never
+        touch a request — adoption is prefetch; the worst outcome is
+        the local prefill that would have happened anyway."""
+        with self._lock:
+            tier = self._kv_tier
+            if tier is None:
+                return
+            exports, self._prefix_export_requests = \
+                self._prefix_export_requests, []
+            adoptions, self._prefix_adoptions = self._prefix_adoptions, []
+        from .kvtier import CorruptExport
+
+        for tokens, on_ready in exports:
+            export = None
+            try:
+                export = self._engine.export_prefix(tokens)
+            except (ValueError, RuntimeError) as e:
+                # donor isolation: a gather fault costs only this
+                # prefetch, never the donor's tick
+                logger.warning(
+                    f"ServingEngine: prefix export failed "
+                    f"({type(e).__name__}: {e}); adoption skipped")
+            if export is not None:
+                self._count("prefix_donated")
+                self.digest.count("kvtier/donated")
+            try:
+                on_ready(export)
+            except Exception:  # dslint: disable=exception-discipline -- fleet-callback isolation: same contract as on_token above
+                logger.exception(
+                    "ServingEngine: prefix-export on_ready callback "
+                    "failed")
+        for export in adoptions:
+            try:
+                if self._engine.import_prefix(export):
+                    self._count("prefix_adopted")
+                    self.digest.count("kvtier/adopted")
+            except CorruptExport:
+                # the checksum gate fired: the wire lied. Counted apart
+                # from plain fallbacks — corruption detected-and-refused
+                # is the invariant (#19); landing silently would not be
+                self._count("prefix_adopt_corrupt")
+                self.digest.count("kvtier/adopt_corrupt")
+            except (ValueError, RuntimeError) as e:
+                # geometry mismatch / pool exhaustion: degrade to local
+                # prefill (the request was never parked on this pen)
+                self._count("prefix_adopt_fallbacks")
+                self.digest.count("kvtier/adopt_fallback")
+                logger.warning(
+                    f"ServingEngine: prefix adoption failed "
+                    f"({type(e).__name__}: {e}); serving by local "
+                    f"prefill")
+        self._snapshot_residency(tier)
+
+    def _snapshot_residency(self, tier) -> None:
+        """Refresh the residency snapshot on the publish cadence (driver
+        thread). Reads the engine's prefix-cache keys without any
+        serving lock — the cache is driver-owned — then swaps the
+        published tuple under the lock for the fleet's poll to read.
+        Cold-readmit deltas ride the same cadence into the routing
+        counters (serving/route/cold_readmit, satellite of the
+        residency/affinity outcome set)."""
+        now = self._clock.now()
+        with self._lock:
+            if (now - self._last_residency_pub
+                    < tier.config.publish_interval_s):
+                return
+            self._last_residency_pub = now
+        eng = self._engine
+        hashes = (eng.prefix_residency_hashes()
+                  if hasattr(eng, "prefix_residency_hashes") else [])
+        readmits = int(getattr(eng, "kvtier_cold_readmits", 0))
+        with self._lock:
+            delta = readmits - self._cold_readmits_seen
+            self._cold_readmits_seen = readmits
+            self._residency = (hashes, now)
+        if delta > 0:
+            t = self._telemetry
+            if t.enabled:
+                t.registry.counter("serving/route/cold_readmit").inc(delta)
+            self.digest.count("route/cold_readmit", delta)
 
     def _export_handoffs(self, reqs: List[Request]) -> None:
         """Export + release engine state for requests leaving through the

@@ -115,6 +115,23 @@ if [ "$#" -eq 0 ]; then
         smoke_rc=$gray_rc
     fi
 
+    # global KV tier lane (CPU evidence lane, docs/serving.md "Global
+    # KV tier", docs/dst.md): the scripted shared-prefix A/B (global
+    # tier ON vs per-replica caching only, virtual time) must beat the
+    # baseline's global prefix hit rate and mean TTFT by the gated
+    # ratios with zero KV page leaks on BOTH legs; plus >= 200 seeded
+    # kv-chaos schedules (stale_directory / corrupt_adopt /
+    # cold_pressure draws) with zero invariant violations — directory-
+    # residency containment, cold-tier accounting, and verify-before-
+    # import included — and bit-identical sampled replays.
+    # Writes KVTIER_r01.json.
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python scripts/kvtier_lane.py
+    kvtier_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        smoke_rc=$kvtier_rc
+    fi
+
     # SLO lane (CPU evidence lane, docs/observability.md "Region
     # rollups & SLO alerting"): >= 200 seeded region chaos schedules
     # with every digest observation mirrored into a pooled ground-truth

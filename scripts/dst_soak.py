@@ -163,7 +163,10 @@ def main() -> int:
                       "latch", "scale", "stall",
                       # gray-failure kinds (ISSUE 18): k-fold slowdowns,
                       # stall bursts, flaky KV-import faults
-                      "degraded_tick", "stall_burst", "flaky_import"}
+                      "degraded_tick", "stall_burst", "flaky_import",
+                      # global-KV-tier kinds (ISSUE 20): directory lies,
+                      # adoption-wire corruption, cold-tier pressure
+                      "stale_directory", "corrupt_adopt", "cold_pressure"}
     gates = {
         "enough_schedules": args.schedules >= 200,
         "zero_invariant_violations": not failures,

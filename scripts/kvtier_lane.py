@@ -1,0 +1,358 @@
+#!/usr/bin/env python
+"""Global KV tier lane: scripted shared-prefix A/B plus the seeded
+kv-tier chaos soak (docs/serving.md "Global KV tier", docs/dst.md).
+
+CI evidence lane for the global KV tier (run by run_tests.sh):
+
+* scripted A/B leg — a 3-replica SimEngine fleet on VIRTUAL time serves
+  the same seeded shared-prefix wave twice: per-replica caching only
+  (kv_tier OFF) vs the global tier ON (residency routing + cross-
+  replica adoption + host cold tier). The per-replica KV pools are
+  sized so the working set of user prefixes thrashes; with the tier
+  OFF every eviction is a full re-prefill, with it ON evicted prefixes
+  spill to the cold tier and re-admit through the checksummed import
+  path (and spilled-over replicas adopt from donors). Gates: the
+  global prefix hit rate beats the per-replica baseline by the gated
+  ratio, mean TTFT beats the baseline by the gated ratio, the tier
+  loses no work, the tier actually engaged (spills + readmits > 0),
+  and BOTH legs end with zero KV page leaks;
+* soak leg — >= 200 seeded fleet DST schedules plus a region sample,
+  drawing the kv-tier config knobs and the tier fault kinds
+  (stale_directory lies, corrupt_adopt wire flips, cold_pressure
+  drops) through the REAL fleet, audited on every event by the full
+  invariant set INCLUDING directory-residency containment (#17: an
+  entry never outlives its pages), cold-tier accounting (#18: pages
+  conserved, capacity respected, checksums intact), and
+  verify-before-import (#19: a corrupt export never lands). Gates:
+  zero violations, sampled replays bit-identical on
+  (trace_hash, span_hash), every tier fault kind exercised, and the
+  tier engaged somewhere (spills, adoptions and readmits all > 0);
+* on any soak violation the failing schedule is delta-debugged to a
+  minimal repro and written to KVTIER_REPRO_<seed>.json.
+
+Pure host-side python (SimEngine, virtual clock); writes
+KVTIER_<round>.json (round via DST_ROUND, default r01).
+
+    python scripts/kvtier_lane.py [--schedules N] [--seed-base B]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import math
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+sys.path.insert(0, os.path.join(HERE, "scripts"))
+
+os.environ.setdefault("DST_ROUND", "r01")
+
+#: every N-th fleet soak seed (and M-th region seed) replayed for the
+#: determinism gate
+REPLAY_STRIDE = 20
+REGION_REPLAY_STRIDE = 10
+
+#: scripted leg: tier-ON global hit rate must beat per-replica caching
+#: by at least this ratio (actual at the pinned workload: ~3x)
+HIT_RATIO_GATE = 1.5
+
+#: scripted leg: tier-ON mean TTFT must be at most this fraction of the
+#: per-replica-caching mean (actual: ~0.6)
+TTFT_RATIO_GATE = 0.85
+
+#: the tier fault kinds the generator must keep emitting
+TIER_KINDS = {"stale_directory", "corrupt_adopt", "cold_pressure"}
+
+#: shared user prefix length in tokens (12 full blocks at block size 4):
+#: a cold prefill takes several 16-token-budget ticks, a prefix hit one
+PREFIX_TOKENS = 48
+
+
+def _p95(xs):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, math.ceil(0.95 * len(xs)) - 1)]
+
+
+def _shared_prefix_run(tiered: bool, *, n_users: int = 8, n_req: int = 60):
+    """One leg of the scripted A/B: a seeded shared-prefix wave against
+    3 replicas whose KV pools are too small to hold every user prefix."""
+    import numpy as np
+
+    from deepspeed_tpu.resilience.clock import SimClock, use_clock
+    from deepspeed_tpu.resilience.dst import SimConfig, SimEngine
+    from deepspeed_tpu.serving import ServingFleet
+
+    class _MeteredEngine(SimEngine):
+        """Honest engine that records, per fresh admission, how many
+        prompt tokens the prefix cache served (the hit-rate witness)."""
+
+        def __init__(self, cfg):
+            super().__init__(cfg)
+            self.admit_log = []
+
+        def _admit_tokens(self, uids, tokens):
+            fresh = [u for u in uids if u not in self.seqs]
+            super()._admit_tokens(uids, tokens)
+            for u in fresh:
+                self.admit_log.append(self.seqs[u].seen)
+
+    clock = SimClock()
+    engines = []
+
+    def factory():
+        eng = _MeteredEngine(SimConfig(token_budget=16, max_seqs=1,
+                                       kv_block_size=4, n_kv_blocks=20,
+                                       max_context=96))
+        engines.append(eng)
+        return eng
+
+    serving_cfg = {"policy": "slo", "stuck_tick_timeout_s": 0.0,
+                   "drain_timeout_s": 600.0, "poll_interval_s": 0.25}
+    if tiered:
+        serving_cfg["kv_tier"] = {"enabled": True,
+                                  "publish_interval_s": 1.0,
+                                  "directory_staleness_s": 10.0,
+                                  "cold_capacity_pages": 1024}
+    rng = np.random.default_rng(11)
+    prefixes = [rng.integers(1, 48, PREFIX_TOKENS).tolist()
+                for _ in range(n_users)]
+    with use_clock(clock):
+        fleet = ServingFleet(factory,
+                             {"replicas": 3, "router": "prefix_affinity",
+                              "respawn": False},
+                             serving_cfg, start=False, clock=clock)
+        reqs = []
+        for t in range(2000):
+            while len(reqs) < n_req and len(reqs) <= t // 3:
+                u = int(rng.integers(0, n_users))
+                tail = rng.integers(1, 48, 4).tolist()
+                reqs.append(fleet.submit(prefixes[u] + tail,
+                                         max_new_tokens=4,
+                                         deadline_s=1000.0))
+            fleet.step()
+            clock.advance(1.0)
+            if len(reqs) >= n_req and all(r.is_terminal for r in reqs):
+                break
+        ttfts = [r.t_first_token - r.t_submit for r in reqs
+                 if r.t_first_token is not None]
+        finished = sum(1 for r in reqs if r.state.value == "finished")
+        tier = fleet.kv_tier
+        cold_stats = tier.cold.stats() if tier and tier.cold else None
+        # leak audit: release every cached prefix, then every page must
+        # be back in the pool — on BOTH legs
+        leaks = []
+        for eng in engines:
+            if eng.prefix_cache is not None:
+                eng.prefix_cache.drop_all(eng.allocator)
+            if eng.allocator.free_blocks != eng.config.n_kv_blocks:
+                leaks.append((eng.config.n_kv_blocks
+                              - eng.allocator.free_blocks))
+        if tier and tier.cold:
+            if tier.cold.used_pages != sum(tier.cold.entry_pages()):
+                leaks.append("cold-tier accounting drift")
+        fleet.close()
+    admits = [s for eng in engines for s in eng.admit_log]
+    hits = sum(1 for s in admits if s >= PREFIX_TOKENS)
+    return {
+        "offered": n_req,
+        "finished": finished,
+        "admissions": len(admits),
+        "prefix_hits": hits,
+        "hit_rate": round(hits / max(1, len(admits)), 4),
+        "ttft_mean": (round(sum(ttfts) / len(ttfts), 2) if ttfts
+                      else None),
+        "ttft_p95": _p95(ttfts) if ttfts else None,
+        "adoptions": sum(e.kvtier_adopt_imports for e in engines),
+        "cold_spills": sum(e.kvtier_cold_spills for e in engines),
+        "cold_readmits": sum(e.kvtier_cold_readmits for e in engines),
+        "cold_stats": cold_stats,
+        "leaked_pages": leaks,
+        "end_vtick": clock.now(),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--schedules", type=int, default=200,
+                    help="number of seeded fleet soak schedules (>= 200)")
+    ap.add_argument("--region-schedules", type=int, default=20)
+    ap.add_argument("--seed-base", type=int, default=4000)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+    if not args.verbose:
+        logging.disable(logging.WARNING)   # the faults ARE the workload
+
+    from deepspeed_tpu.resilience.dst import (SimConfig, SimEngine,
+                                              dump_repro,
+                                              generate_region_schedule,
+                                              generate_schedule,
+                                              run_region_schedule,
+                                              run_schedule,
+                                              shrink_schedule)
+
+    t0 = time.monotonic()
+
+    # -- scripted shared-prefix A/B leg ---------------------------------
+    off = _shared_prefix_run(False)
+    on = _shared_prefix_run(True)
+    print(f"[kvtier-lane] per-replica: hit rate {off['hit_rate']:.2f}, "
+          f"mean TTFT {off['ttft_mean']:.1f} vt, "
+          f"{off['finished']}/{off['offered']} finished")
+    print(f"[kvtier-lane] global tier: hit rate {on['hit_rate']:.2f}, "
+          f"mean TTFT {on['ttft_mean']:.1f} vt, "
+          f"{on['finished']}/{on['offered']} finished, "
+          f"{on['adoptions']} adoptions, {on['cold_spills']} spills, "
+          f"{on['cold_readmits']} readmits")
+
+    # -- seeded kv-tier soak --------------------------------------------
+    failures = []
+    hashes = {}
+    kinds_seen = set()
+    tiered_seeds = 0
+    activity = {"adoptions": 0, "cold_spills": 0, "cold_readmits": 0}
+    totals = {"submitted": 0, "finished": 0, "ticks": 0, "events": 0}
+    for seed in range(args.seed_base, args.seed_base + args.schedules):
+        sched = generate_schedule(seed)
+        kinds_seen |= {e.kind for e in sched.events}
+        if sched.serving_cfg.get("kv_tier", {}).get("enabled"):
+            tiered_seeds += 1
+        engines = []
+
+        def factory(_cfg=SimConfig(**sched.engine_cfg), _engines=engines):
+            eng = SimEngine(_cfg)
+            _engines.append(eng)
+            return eng
+
+        report = run_schedule(sched, engine_factory=factory)
+        hashes[seed] = (report.trace_hash, report.span_hash)
+        activity["adoptions"] += sum(e.kvtier_adopt_imports
+                                     for e in engines)
+        activity["cold_spills"] += sum(e.kvtier_cold_spills
+                                       for e in engines)
+        activity["cold_readmits"] += sum(e.kvtier_cold_readmits
+                                         for e in engines)
+        totals["submitted"] += report.submitted
+        totals["finished"] += report.finished
+        totals["ticks"] += report.n_ticks
+        totals["events"] += report.n_events
+        if not report.ok:
+            failures.append((seed, report.violations))
+            print(f"[kvtier-lane] seed {seed}: "
+                  f"{len(report.violations)} violation(s); first: "
+                  f"{report.violations[0]}")
+
+    replayed = 0
+    mismatches = []
+    for seed in range(args.seed_base, args.seed_base + args.schedules,
+                      REPLAY_STRIDE):
+        replayed += 1
+        rep = run_schedule(generate_schedule(seed))
+        if (rep.trace_hash, rep.span_hash) != hashes[seed]:
+            mismatches.append(seed)
+
+    # -- region sample (tier entries ride the cell rollup) --------------
+    region_failures = []
+    region_hashes = {}
+    region_tiered = 0
+    rbase = args.seed_base + 1000
+    for seed in range(rbase, rbase + args.region_schedules):
+        sched = generate_region_schedule(seed)
+        if sched.serving_cfg.get("kv_tier", {}).get("enabled"):
+            region_tiered += 1
+        report = run_region_schedule(sched)
+        region_hashes[seed] = (report.trace_hash, report.span_hash)
+        if not report.ok:
+            region_failures.append((seed, report.violations))
+            print(f"[kvtier-lane] region seed {seed}: "
+                  f"{report.violations[0]}")
+    region_replayed = 0
+    for seed in range(rbase, rbase + args.region_schedules,
+                      REGION_REPLAY_STRIDE):
+        region_replayed += 1
+        rep = run_region_schedule(generate_region_schedule(seed))
+        if (rep.trace_hash, rep.span_hash) != region_hashes[seed]:
+            mismatches.append(seed)
+    wall = time.monotonic() - t0
+
+    gates = {
+        # scripted A/B leg
+        "global_hit_rate_beats_local": (
+            on["hit_rate"] >= HIT_RATIO_GATE * max(off["hit_rate"], 1e-9)),
+        "ttft_beats_local": (
+            off["ttft_mean"] is not None and on["ttft_mean"] is not None
+            and on["ttft_mean"] <= TTFT_RATIO_GATE * off["ttft_mean"]),
+        "tier_loses_no_work": on["finished"] >= off["finished"],
+        "tier_engaged_in_ab": (on["cold_spills"] > 0
+                               and on["cold_readmits"] > 0),
+        "zero_kv_page_leaks": (not on["leaked_pages"]
+                               and not off["leaked_pages"]),
+        # seeded soak
+        "enough_schedules": args.schedules >= 200,
+        "zero_invariant_violations": (not failures
+                                      and not region_failures),
+        "deterministic_replay": not mismatches,
+        "tier_fault_kinds_exercised": TIER_KINDS <= kinds_seen,
+        "tier_configs_exercised": (tiered_seeds > 0
+                                   and region_tiered > 0),
+        "soak_tier_engaged": all(v > 0 for v in activity.values()),
+    }
+    report = {
+        "metric": "kv_tier_hit_rate_ttft_and_invariant_violations",
+        "per_replica_caching": off,
+        "global_tier": on,
+        "hit_ratio_gate": HIT_RATIO_GATE,
+        "ttft_ratio_gate": TTFT_RATIO_GATE,
+        "schedules": args.schedules,
+        "region_schedules": args.region_schedules,
+        "seed_base": args.seed_base,
+        "tiered_seeds": tiered_seeds,
+        "region_tiered_seeds": region_tiered,
+        "replayed_for_determinism": replayed + region_replayed,
+        "replay_mismatch_seeds": mismatches,
+        "fault_kinds_exercised": sorted(kinds_seen),
+        "soak_activity": activity,
+        "totals": totals,
+        "failing_seeds": [s for s, _ in failures + region_failures],
+        "wall_s": round(wall, 2),
+        "gates": gates,
+        "value": len(failures) + len(region_failures),
+    }
+    from _artifact import write_artifact
+
+    path = write_artifact("KVTIER", report, device="host-sim")
+    print(f"[kvtier-lane] {args.schedules}+{args.region_schedules} "
+          f"schedules ({tiered_seeds}+{region_tiered} tiered), "
+          f"{totals['ticks']} virtual ticks; soak activity "
+          f"{activity} in {wall:.1f}s")
+    print(f"[kvtier-lane] artifact: {path}")
+
+    for seed, violations in failures:
+        try:
+            shrunk = shrink_schedule(generate_schedule(seed))
+        except ValueError:
+            shrunk = generate_schedule(seed)   # flaked? dump it unshrunk
+        repro = os.path.join(HERE, f"KVTIER_REPRO_{seed}.json")
+        shrunk_report = run_schedule(shrunk)
+        dump_repro(shrunk, shrunk_report.violations or violations, repro,
+                   timeline=shrunk_report.spans)
+        print(f"[kvtier-lane] seed {seed}: minimal repro "
+              f"({len(shrunk.events)} events) -> {repro}")
+
+    failed = [g for g, ok in gates.items() if not ok]
+    if failed:
+        print(f"kvtier lane: FAILED gates {failed}")
+        return 1
+    print(f"kvtier lane: OK — global hit rate {on['hit_rate']:.2f} vs "
+          f"{off['hit_rate']:.2f} per-replica, mean TTFT "
+          f"{on['ttft_mean']:.1f} vs {off['ttft_mean']:.1f} vt, "
+          f"{args.schedules} kv-chaos schedules clean, "
+          f"{replayed + region_replayed} replays bit-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,7 +67,10 @@ EXPECTED_KINDS = {"submit", "cancel", "tick_fault", "replica_death",
                   "canary_regress", "corrupt_swap", "flip_death",
                   # gray-failure kinds (ISSUE 18): k-fold slowdowns,
                   # stall bursts, flaky KV-import faults
-                  "degraded_tick", "stall_burst", "flaky_import"}
+                  "degraded_tick", "stall_burst", "flaky_import",
+                  # global-KV-tier kinds (ISSUE 20): directory lies,
+                  # adoption-wire corruption, cold-tier pressure
+                  "stale_directory", "corrupt_adopt", "cold_pressure"}
 
 
 def main() -> int:
